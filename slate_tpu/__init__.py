@@ -1,0 +1,46 @@
+"""slate_tpu — TPU-native distributed dense linear algebra.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the
+reference SLATE library (distributed tiled BLAS3, LU/Cholesky/QR solvers,
+least squares, two-stage eigenvalue/SVD, mixed-precision refinement, matrix
+generation, ScaLAPACK-compatible surface), designed for TPU pods: tiles are
+mesh-sharded arrays, MPI broadcast/reduce becomes ICI collectives under
+shard_map, CUDA tile kernels become Pallas/XLA kernels, and the OpenMP
+lookahead DAG becomes XLA-pipelined static schedules.
+"""
+
+from . import func
+from .enums import (
+    Diag,
+    GridOrder,
+    Layout,
+    MethodCholQR,
+    MethodEig,
+    MethodGels,
+    MethodGemm,
+    MethodHemm,
+    MethodLU,
+    MethodSVD,
+    MethodTrsm,
+    Norm,
+    NormScope,
+    Op,
+    Option,
+    Side,
+    Target,
+    TileKind,
+)
+from .exceptions import (
+    DimensionError,
+    DistributedException,
+    NumericalError,
+    OptionError,
+    SlateError,
+)
+from .options import get_option, normalize_options
+from .parallel.grid import ProcessGrid, default_grid, set_default_grid
+from .parallel.layout import TileLayout
+
+__version__ = "0.1.0"
+
+__all__ = [name for name in dir() if not name.startswith("_")]

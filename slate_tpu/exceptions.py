@@ -1,0 +1,42 @@
+"""Exception hierarchy (reference: include/slate/Exception.hh).
+
+The MPI/CUDA-specific exception subclasses of the reference map onto a
+single DistributedException here: XLA collective failures surface as jax
+runtime errors and are wrapped where we can add context.
+"""
+
+from __future__ import annotations
+
+
+class SlateError(Exception):
+    """Base error for slate_tpu (reference: slate::Exception, Exception.hh)."""
+
+
+class DimensionError(SlateError):
+    """Shape/conformability violation in a routine's arguments."""
+
+
+class OptionError(SlateError):
+    """Bad Option key/value."""
+
+
+class DistributedException(SlateError):
+    """Failure in the distributed runtime (mesh/collective layer).
+
+    Reference analogue: slate::MpiException (mpi.hh:16-35)."""
+
+
+class NumericalError(SlateError):
+    """Numerical failure carrying an `info` code, e.g. a non-SPD matrix in
+    potrf or a singular U(i,i) in getrf (reference: internal::reduce_info +
+    info returns, src/internal/internal_reduce_info.cc)."""
+
+    def __init__(self, message: str, info: int = 0):
+        super().__init__(message)
+        self.info = int(info)
+
+
+def slate_assert(cond: bool, message: str = "assertion failed") -> None:
+    """Host-side invariant check (reference: slate_assert, Exception.hh)."""
+    if not cond:
+        raise SlateError(message)

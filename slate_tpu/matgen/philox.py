@@ -1,0 +1,248 @@
+"""Philox-2x64 counter-based RNG keyed by global element index (i, j).
+
+Reproduces the reference generator's semantics exactly (reference:
+matgen/random.cc:43-100 philox_2x64, rand_to_real, generate_float): the
+value of element (i, j) depends only on (seed, i, j), never on tiling or
+process count, which is what makes rank-count-independent verification
+possible (SURVEY §4).
+
+Implemented twice with identical bit-exact results:
+  * numpy (vectorized uint64) — host-side generation for compat buffers;
+  * jax (uint32-pair arithmetic) — device-side generation inside jit,
+    usable under shard_map so every process generates only its local tiles.
+
+The jax path avoids uint64 entirely (TPUs have no native 64-bit integer
+units) by carrying each 64-bit lane as a (hi32, lo32) pair.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Constants from Salmon et al. 2011 (reference: random.cc:55-58).
+SEED_INC = 0xD2B74407B1CE6E93
+MULTIPLIER = 0x9E3779B97F4A7C15
+ROUNDS = 10
+_MASK32 = 0xFFFFFFFF
+
+# ---------------------------------------------------------------------------
+# numpy path (uint64)
+# ---------------------------------------------------------------------------
+
+
+def _mul64_np(a: np.ndarray, b: int):
+    """Exact 64x64 -> 128 product as (lo, hi), overflow-free in uint64."""
+    b = np.uint64(b)
+    mask = np.uint64(_MASK32)
+    s32 = np.uint64(32)
+    ah, al = a >> s32, a & mask
+    bh, bl = b >> s32, b & mask
+    albl = al * bl
+    mid = ah * bl + (albl >> s32)
+    mid2 = al * bh + (mid & mask)
+    hi = ah * bh + (mid >> s32) + (mid2 >> s32)
+    lo = a * b  # wrapping
+    return lo, hi
+
+
+def philox_2x64_np(i: np.ndarray, j: np.ndarray, seed: int):
+    """128 pseudorandom bits per counter {i, j} (reference: random.cc:43-77)."""
+    with np.errstate(over="ignore"):
+        L = np.asarray(i, dtype=np.uint64)
+        R = np.asarray(j, dtype=np.uint64)
+        L, R = np.broadcast_arrays(L, R)
+        key = np.uint64(seed)
+        inc = np.uint64(SEED_INC)
+        for r in range(ROUNDS):
+            if r != 0:
+                key = key + inc
+            lo, hi = _mul64_np(R, MULTIPLIER)
+            L, R = lo, hi ^ key ^ L
+    return L, R
+
+
+def _bits_to_unit_np(bits: np.ndarray, dtype) -> np.ndarray:
+    """bits -> [0, 1) keeping the top `digits` bits (reference: random.cc:82-90)."""
+    digits = np.finfo(dtype).nmant + 1
+    shifted = (bits >> np.uint64(64 - digits)).astype(np.float64)
+    return (shifted / float(1 << digits)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# jax path: 64-bit lanes as (hi, lo) uint32 pairs
+# ---------------------------------------------------------------------------
+
+
+def _u32(x):
+    return jnp.asarray(x, jnp.uint32)
+
+
+def _mul32_wide(a, b):
+    """32x32 -> 64 product of uint32 arrays as (hi, lo) uint32."""
+    a_hi, a_lo = a >> 16, a & 0xFFFF
+    b_hi, b_lo = b >> 16, b & 0xFFFF
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> 16) + (lh & 0xFFFF) + (hl & 0xFFFF)
+    lo = (ll & 0xFFFF) | ((mid & 0xFFFF) << 16)
+    hi = hh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _add64(a, b):
+    """(hi,lo) + (hi,lo) with carry, mod 2^64."""
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    hi = a[0] + b[0] + carry
+    return hi, lo
+
+
+def _mul64_pair(a, b_const):
+    """64x64 -> 128 as (hi,lo) pairs; a is a (hi,lo) pair, b a python int."""
+    bh = _u32((b_const >> 32) & _MASK32)
+    bl = _u32(b_const & _MASK32)
+    ah, al = a
+    # partial products
+    p0h, p0l = _mul32_wide(al, bl)  # al*bl -> bits [0,64)
+    p1h, p1l = _mul32_wide(al, bh)  # al*bh -> bits [32,96)
+    p2h, p2l = _mul32_wide(ah, bl)  # ah*bl -> bits [32,96)
+    p3h, p3l = _mul32_wide(ah, bh)  # ah*bh -> bits [64,128)
+    # low 64: p0 + (p1l + p2l) << 32
+    lo_hi, lo_lo = _add64((p0h, p0l), (p1l, jnp.zeros_like(p0l)))
+    lo_hi2, lo_lo2 = _add64((lo_hi, lo_lo), (p2l, jnp.zeros_like(p0l)))
+    # carries into high 64 from the two (x << 32) adds
+    c1 = (lo_hi < p0h).astype(jnp.uint32)
+    c2 = (lo_hi2 < lo_hi).astype(jnp.uint32)
+    hi_hi, hi_lo = _add64((p3h, p3l), (jnp.zeros_like(p0l), p1h))
+    hi_hi, hi_lo = _add64((hi_hi, hi_lo), (jnp.zeros_like(p0l), p2h))
+    hi_hi, hi_lo = _add64((hi_hi, hi_lo), (jnp.zeros_like(p0l), c1 + c2))
+    return (hi_hi, hi_lo), (lo_hi2, lo_lo2)
+
+
+def _split64(x):
+    """int array -> (hi32, lo32) uint32 pair; works with x64 on or off."""
+    x = jnp.asarray(x)
+    lo = x.astype(jnp.uint32)  # wrapping cast, no 0xFFFFFFFF literal needed
+    if x.dtype.itemsize == 8:
+        hi = (x >> 32).astype(jnp.uint32)
+    else:
+        hi = jnp.zeros(x.shape, jnp.uint32)
+    return hi, lo
+
+
+def philox_2x64_jnp(i, j, seed: int):
+    """jax version of philox_2x64; i, j int arrays (< 2^63 as pairs).
+
+    Returns ((L_hi, L_lo), (R_hi, R_lo)) uint32 pairs.
+    """
+    i, j = jnp.broadcast_arrays(jnp.asarray(i), jnp.asarray(j))
+    L = _split64(i)
+    R = _split64(j)
+    key = (seed >> 32) & _MASK32, seed & _MASK32
+    for r in range(ROUNDS):
+        if r != 0:
+            # key += SEED_INC (python-side 64-bit constant fold per round)
+            k64 = (((key[0] << 32) | key[1]) + SEED_INC) & 0xFFFFFFFFFFFFFFFF
+            key = (k64 >> 32, k64 & _MASK32)
+        hi128, lo128 = _mul64_pair(R, MULTIPLIER)
+        new_R = (hi128[0] ^ _u32(key[0]) ^ L[0], hi128[1] ^ _u32(key[1]) ^ L[1])
+        L, R = lo128, new_R
+    return L, R
+
+
+def _bits_to_unit_jnp(bits_pair, dtype) -> jnp.ndarray:
+    """(hi, lo) uint32 pair -> [0, 1) float of `dtype`, bit-matching numpy."""
+    hi, lo = bits_pair
+    digits = jnp.finfo(dtype).nmant + 1
+    if digits <= 32:
+        kept = hi >> (32 - digits)
+        return (kept.astype(jnp.float32) / np.float32(1 << digits)).astype(dtype)
+    # float64 path: 53 kept bits = hi (32) + top 21 of lo
+    kept_hi = hi.astype(jnp.float64) * float(1 << 21)
+    kept_lo = (lo >> (64 - digits)).astype(jnp.float64)
+    return ((kept_hi + kept_lo) / float(1 << digits)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Distribution sampling (reference: random.cc:110-160 generate_float)
+# ---------------------------------------------------------------------------
+
+DISTS = (
+    "uniform",         # [0, 1)
+    "uniform_signed",  # (-1, 1)
+    "normal",          # Box-Muller
+    "unit_disk",
+    "unit_circle",
+    "binary",
+    "binary_signed",
+)
+
+
+def _apply_dist(f1, f2, dist: str, dtype, xp):
+    two_pi = xp.asarray(2 * np.pi, dtype=dtype)
+    two = xp.asarray(2, dtype=dtype)
+    one_c = xp.asarray(1, dtype=dtype)
+    if dist == "uniform":
+        re, im = f1, f2
+    elif dist == "uniform_signed":
+        re, im = two * f1 - one_c, two * f2 - one_c
+    elif dist == "normal":
+        mag = xp.sqrt(-two * xp.log1p(-f1))
+        arg = two_pi * f2
+        re, im = mag * xp.cos(arg), mag * xp.sin(arg)
+    elif dist == "unit_disk":
+        mag = xp.sqrt(f1)
+        arg = two_pi * f2
+        re, im = mag * xp.cos(arg), mag * xp.sin(arg)
+    elif dist == "unit_circle":
+        arg = two_pi * f2
+        re, im = xp.cos(arg), xp.sin(arg)
+    elif dist == "binary":
+        one = xp.ones_like(f1)
+        re, im = xp.where(f1 >= 0.5, one, 0 * one), xp.where(f2 >= 0.5, one, 0 * one)
+    elif dist == "binary_signed":
+        one = xp.ones_like(f1)
+        re, im = xp.where(f1 >= 0.5, one, -one), xp.where(f2 >= 0.5, one, -one)
+    else:
+        raise ValueError(f"unknown distribution {dist!r}")
+    return re, im
+
+
+def random_np(dist: str, seed: int, i, j, dtype=np.float64) -> np.ndarray:
+    """Element values at global indices (i, j); real or complex dtype.
+
+    Matches reference generate_float<scalar_t, dist>(seed, i, j)
+    (random.cc:104-160): one philox call per element; float1 -> re,
+    float2 -> im (imaginary discarded for real types).
+    """
+    dtype = np.dtype(dtype)
+    if dtype.kind == "c":
+        real_t = np.float32 if dtype == np.complex64 else np.float64
+    else:
+        real_t = dtype.type
+    bits1, bits2 = philox_2x64_np(i, j, seed)
+    f1 = _bits_to_unit_np(bits1, real_t)
+    f2 = _bits_to_unit_np(bits2, real_t)
+    re, im = _apply_dist(f1, f2, dist, real_t, np)
+    if dtype.kind == "c":
+        return (re + 1j * im).astype(dtype)
+    return re.astype(dtype)
+
+
+def random_jnp(dist: str, seed: int, i, j, dtype=jnp.float32) -> jnp.ndarray:
+    """jax twin of random_np; bit-identical for f32/f64 (complex composed)."""
+    dtype = jnp.dtype(dtype)
+    if dtype.kind == "c":
+        real_t = jnp.float32 if dtype == jnp.complex64 else jnp.float64
+    else:
+        real_t = dtype
+    bits1, bits2 = philox_2x64_jnp(i, j, seed)
+    f1 = _bits_to_unit_jnp(bits1, real_t)
+    f2 = _bits_to_unit_jnp(bits2, real_t)
+    re, im = _apply_dist(f1, f2, dist, real_t, jnp)
+    if dtype.kind == "c":
+        return (re + 1j * im).astype(dtype)
+    return re.astype(dtype)

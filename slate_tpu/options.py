@@ -1,0 +1,78 @@
+"""Per-call Options map (reference: types.hh:32-80 OptionValue/Options,
+option defaults resolved at use-site, e.g. gemmC.cc:55).
+
+Options are a plain dict {Option|str: value}; `get_option` resolves defaults
+exactly like the reference's use-site `get_option( opts, Option::X, default )`.
+String keys are accepted for ergonomics ("lookahead" == Option.Lookahead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Union
+
+from .enums import Option
+from .exceptions import OptionError
+
+OptionKey = Union[Option, str]
+Options = Mapping[OptionKey, Any]
+
+_DEFAULTS = {
+    Option.ChunkSize: 1,
+    Option.Lookahead: 1,
+    Option.BlockSize: 256,
+    Option.InnerBlocking: 16,
+    Option.MaxPanelThreads: 1,
+    Option.Tolerance: None,  # resolved per-dtype at use site (epsilon-based)
+    Option.Target: None,  # Target.Devices at use site
+    Option.HoldLocalWorkspace: False,
+    Option.Depth: 2,
+    Option.MaxIterations: 30,
+    Option.UseFallbackSolver: True,
+    Option.PivotThreshold: 1.0,
+    Option.PrintVerbose: 0,
+    Option.PrintEdgeItems: 16,
+    Option.PrintWidth: 10,
+    Option.PrintPrecision: 4,
+    Option.MaxUnrolledTiles: 256,
+    Option.UseShardMap: True,
+}
+
+
+def _canon(key: OptionKey) -> Option:
+    if isinstance(key, Option):
+        return key
+    k = str(key).strip().lower()
+    for opt in Option:
+        if opt.value == k or opt.name.lower() == k:
+            return opt
+    raise OptionError(f"unknown option key: {key!r}")
+
+
+def normalize_options(opts: Optional[Options]) -> dict:
+    """Canonicalize user-provided option keys to Option enum members."""
+    out: dict = {}
+    for key, val in (opts or {}).items():
+        out[_canon(key)] = val
+    return out
+
+
+def get_option(opts: Optional[Options], key: OptionKey, default: Any = None) -> Any:
+    """Use-site default resolution (reference pattern: get_option(opts, k, d)).
+
+    Unknown keys in ``opts`` are ignored here; use ``normalize_options`` at
+    driver entry to reject typos loudly.
+    """
+    key = _canon(key)
+    if opts:
+        if key in opts:
+            return opts[key]
+        for k, v in opts.items():
+            try:
+                kc = _canon(k)
+            except OptionError:
+                continue
+            if kc is key:
+                return v
+    if default is not None:
+        return default
+    return _DEFAULTS.get(key)

@@ -1,0 +1,131 @@
+"""Process grid over a jax device mesh.
+
+TPU-native replacement for the reference's MPI p x q process grid
+(reference: BaseMatrix.hh:80-122, func.hh:207).  A ``ProcessGrid`` wraps a
+``jax.sharding.Mesh`` with axes ``('p', 'q')``; the 2D block-cyclic tile
+distribution is realized by storing tiles in owner-major ("storage") order
+(see layout.py) so a plain block NamedSharding over ('p', 'q') yields the
+cyclic distribution.  Collectives ride mesh sub-axes over ICI/DCN instead of
+MPI communicators.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..enums import GridOrder
+from ..exceptions import DistributedException
+
+ROW_AXIS = "p"
+COL_AXIS = "q"
+
+
+def _factor_2d(n: int) -> tuple:
+    """Most-square p x q factorization of n, p <= q."""
+    p = int(math.isqrt(n))
+    while n % p != 0:
+        p -= 1
+    return p, n // p
+
+
+@dataclass(frozen=True)
+class ProcessGrid:
+    """A p x q grid of devices with named mesh axes ('p', 'q').
+
+    ``order`` controls how linear device order maps to the grid, mirroring
+    the reference's GridOrder for BLACS compatibility (enums.hh:524):
+    Col => device k sits at (k % p, k // p); Row => (k // q, k % q).
+    """
+
+    mesh: Mesh
+    order: GridOrder = GridOrder.Col
+
+    @property
+    def p(self) -> int:
+        return self.mesh.shape[ROW_AXIS]
+
+    @property
+    def q(self) -> int:
+        return self.mesh.shape[COL_AXIS]
+
+    @property
+    def size(self) -> int:
+        return self.p * self.q
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_devices(
+        devices: Optional[Sequence] = None,
+        p: Optional[int] = None,
+        q: Optional[int] = None,
+        order: GridOrder = GridOrder.Col,
+    ) -> "ProcessGrid":
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if (p is not None and p <= 0) or (q is not None and q <= 0):
+            raise DistributedException(f"grid dims must be positive, got {p}x{q}")
+        if p is None and q is None:
+            p, q = _factor_2d(n)
+        elif p is None:
+            p = n // q
+        elif q is None:
+            q = n // p
+        if p * q != n:
+            raise DistributedException(
+                f"grid {p}x{q} does not match device count {n}"
+            )
+        dev = np.asarray(devices, dtype=object)
+        if order == GridOrder.Col:
+            dev = dev.reshape(q, p).T  # device k at (k % p, k // p)
+        else:
+            dev = dev.reshape(p, q)
+        return ProcessGrid(Mesh(dev, (ROW_AXIS, COL_AXIS)), order)
+
+    @staticmethod
+    def single(device=None) -> "ProcessGrid":
+        """1x1 grid on one device (the degenerate, no-comm case)."""
+        dev = device if device is not None else jax.devices()[0]
+        return ProcessGrid.from_devices([dev], p=1, q=1)
+
+    # -- shardings ----------------------------------------------------------
+
+    def tile_sharding(self) -> NamedSharding:
+        """Sharding for a (P, Q, mb, nb) storage-order tile array."""
+        return NamedSharding(self.mesh, PartitionSpec(ROW_AXIS, COL_AXIS))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def row_sharding(self) -> NamedSharding:
+        """Sharding for arrays distributed over process rows only."""
+        return NamedSharding(self.mesh, PartitionSpec(ROW_AXIS))
+
+    def col_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(COL_AXIS))
+
+
+_default_grid: Optional[ProcessGrid] = None
+
+
+def default_grid() -> ProcessGrid:
+    """Module-level default grid: 1x1 on the first device.
+
+    Multi-device runs should construct an explicit ProcessGrid; the default
+    keeps the single-chip path zero-config.
+    """
+    global _default_grid
+    if _default_grid is None:
+        _default_grid = ProcessGrid.single()
+    return _default_grid
+
+
+def set_default_grid(grid: ProcessGrid) -> None:
+    global _default_grid
+    _default_grid = grid

@@ -1,0 +1,235 @@
+"""Tile layout: 2D block-cyclic distribution as owner-major storage.
+
+This module is the TPU-native replacement for the reference's
+MatrixStorage + 2D block-cyclic index maps (reference:
+include/slate/internal/MatrixStorage.hh:151, func.hh:100-265,
+BaseMatrix.hh:211-223 tileRank/tileDevice).
+
+Design: a distributed matrix is ONE jax array of tiles with shape
+
+    (P, Q, mb, nb),   P = p * mtl,  Q = q * ntl
+
+stored in *owner-major* (cyclic-permuted) order: global tile (i, j) lives at
+storage slot (srow(i), scol(j)) with
+
+    srow(i) = (i % p) * mtl + i // p        (mtl = ceil(mt / p))
+    scol(j) = (j % q) * ntl + j // q        (ntl = ceil(nt / q))
+
+A plain block NamedSharding over mesh axes ('p', 'q') then gives process
+(r, c) exactly its block-cyclic tile set {i : i % p == r} x {j : j % q == c},
+contiguously, as local shard (mtl, ntl, mb, nb) — the same local layout
+ScaLAPACK uses.  Inside ``shard_map`` each process sees precisely its local
+tile stack, so one fused XLA dot per bulk step replaces the reference's
+batched-BLAS groups (internal_gemm.cc:455-511).
+
+Edge tiles are padded to uniform (mb, nb); SURVEY §7 hard-part (4).  Padding
+rows/cols are zero, and factorization drivers locally splice an identity
+into the padded diagonal so static-shape kernels stay nonsingular.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+@dataclass(frozen=True)
+class TileLayout:
+    """Static index math for an m x n matrix tiled mb x nb on a p x q grid."""
+
+    m: int
+    n: int
+    mb: int
+    nb: int
+    p: int = 1
+    q: int = 1
+
+    # -- tile counts --------------------------------------------------------
+
+    @property
+    def mt(self) -> int:
+        return ceil_div(self.m, self.mb)
+
+    @property
+    def nt(self) -> int:
+        return ceil_div(self.n, self.nb)
+
+    @property
+    def mtl(self) -> int:
+        """Local (per process-row) padded tile-row count."""
+        return ceil_div(self.mt, self.p)
+
+    @property
+    def ntl(self) -> int:
+        return ceil_div(self.nt, self.q)
+
+    @property
+    def P(self) -> int:
+        """Padded global tile-row count (= p * mtl)."""
+        return self.p * self.mtl
+
+    @property
+    def Q(self) -> int:
+        return self.q * self.ntl
+
+    @property
+    def storage_shape(self) -> Tuple[int, int, int, int]:
+        return (self.P, self.Q, self.mb, self.nb)
+
+    # -- per-tile queries (reference: BaseMatrix.hh:211-223, func.hh) -------
+
+    def tileMb(self, i: int) -> int:
+        """Row count of tile row i (short last tile; func.hh:39-43)."""
+        return self.m - i * self.mb if (i + 1) * self.mb > self.m else self.mb
+
+    def tileNb(self, j: int) -> int:
+        return self.n - j * self.nb if (j + 1) * self.nb > self.n else self.nb
+
+    def tileRank(self, i: int, j: int) -> Tuple[int, int]:
+        """Owning (process-row, process-col) of tile (i, j)."""
+        return (i % self.p, j % self.q)
+
+    def tileIsLocal(self, i: int, j: int, r: int, c: int) -> bool:
+        return self.tileRank(i, j) == (r, c)
+
+    # -- storage permutation -------------------------------------------------
+
+    def srow(self, i):
+        """Storage row slot of global tile-row i (works on ints or traced)."""
+        return (i % self.p) * self.mtl + i // self.p
+
+    def scol(self, j):
+        return (j % self.q) * self.ntl + j // self.q
+
+    def lrow(self, s):
+        """Inverse of srow: global tile-row stored at slot s."""
+        return (s % self.mtl) * self.p + s // self.mtl
+
+    def lcol(self, s):
+        return (s % self.ntl) * self.q + s // self.ntl
+
+    @cached_property
+    def row_gather(self) -> np.ndarray:
+        """index array g with storage[s] = natural[g[s]] (natural padded to P)."""
+        return np.array([self.lrow(s) for s in range(self.P)], dtype=np.int32)
+
+    @cached_property
+    def col_gather(self) -> np.ndarray:
+        return np.array([self.lcol(s) for s in range(self.Q)], dtype=np.int32)
+
+    @cached_property
+    def row_scatter(self) -> np.ndarray:
+        """index array h with natural[i] = storage[h[i]]."""
+        return np.array([self.srow(i) for i in range(self.P)], dtype=np.int32)
+
+    @cached_property
+    def col_scatter(self) -> np.ndarray:
+        return np.array([self.scol(j) for j in range(self.Q)], dtype=np.int32)
+
+    # -- masks for ragged edges ---------------------------------------------
+
+    @cached_property
+    def row_mask_np(self) -> np.ndarray:
+        """(P, mb) bool: valid rows of each storage tile-row slot."""
+        mask = np.zeros((self.P, self.mb), dtype=bool)
+        for s in range(self.P):
+            i = self.lrow(s)
+            if i < self.mt:
+                mask[s, : self.tileMb(i)] = True
+        return mask
+
+    @cached_property
+    def col_mask_np(self) -> np.ndarray:
+        mask = np.zeros((self.Q, self.nb), dtype=bool)
+        for s in range(self.Q):
+            j = self.lcol(s)
+            if j < self.nt:
+                mask[s, : self.tileNb(j)] = True
+        return mask
+
+    def element_mask(self) -> jnp.ndarray:
+        """(P, Q, mb, nb) bool mask of valid (non-padding) elements."""
+        rm = jnp.asarray(self.row_mask_np)[:, None, :, None]
+        cm = jnp.asarray(self.col_mask_np)[None, :, None, :]
+        return rm & cm
+
+    # -- global element index maps ------------------------------------------
+
+    @cached_property
+    def global_rows_np(self) -> np.ndarray:
+        """(P, mb) int32: global row index of each storage element row
+        (padding slots point past m; clip before use)."""
+        out = np.zeros((self.P, self.mb), dtype=np.int32)
+        for s in range(self.P):
+            i = self.lrow(s)
+            out[s] = i * self.mb + np.arange(self.mb)
+        return out
+
+    @cached_property
+    def global_cols_np(self) -> np.ndarray:
+        out = np.zeros((self.Q, self.nb), dtype=np.int32)
+        for s in range(self.Q):
+            j = self.lcol(s)
+            out[s] = j * self.nb + np.arange(self.nb)
+        return out
+
+    # -- derived layouts -----------------------------------------------------
+
+    def transposed(self) -> "TileLayout":
+        """Layout of A^T: dims, tiles and grid swap."""
+        return TileLayout(self.n, self.m, self.nb, self.mb, self.q, self.p)
+
+    def with_grid(self, p: int, q: int) -> "TileLayout":
+        return TileLayout(self.m, self.n, self.mb, self.nb, p, q)
+
+
+# ---------------------------------------------------------------------------
+# Conversions: global 2D array <-> storage-order tile array.
+# Pure jnp; usable inside jit and differentiable.
+# ---------------------------------------------------------------------------
+
+
+def tiles_from_global(A: jnp.ndarray, layout: TileLayout) -> jnp.ndarray:
+    """Pack a (m, n) array into storage-order tiles (P, Q, mb, nb).
+
+    Reference analogue: Matrix::fromLAPACK / insert+copy of all tiles
+    (Matrix.hh:58).  Padding elements are zero.
+    """
+    m, n = layout.m, layout.n
+    assert A.shape == (m, n), f"expected {(m, n)}, got {A.shape}"
+    Pm, Qn = layout.P * layout.mb, layout.Q * layout.nb
+    A = jnp.pad(A, ((0, Pm - m), (0, Qn - n)))
+    T = A.reshape(layout.P, layout.mb, layout.Q, layout.nb).transpose(0, 2, 1, 3)
+    # natural -> storage permutation (static gather)
+    return T[layout.row_gather][:, layout.col_gather]
+
+
+def tiles_to_global(T: jnp.ndarray, layout: TileLayout) -> jnp.ndarray:
+    """Unpack storage-order tiles back to the (m, n) global array."""
+    assert T.shape == layout.storage_shape, (T.shape, layout.storage_shape)
+    Tn = T[layout.row_scatter][:, layout.col_scatter]  # storage -> natural
+    A = Tn.transpose(0, 2, 1, 3).reshape(layout.P * layout.mb, layout.Q * layout.nb)
+    return A[: layout.m, : layout.n]
+
+
+def zeros_tiles(layout: TileLayout, dtype=jnp.float32) -> jnp.ndarray:
+    return jnp.zeros(layout.storage_shape, dtype=dtype)
+
+
+def eye_splice(layout: TileLayout, T: jnp.ndarray, scale=1.0) -> jnp.ndarray:
+    """Return T with `scale` written on the *padding* diagonal so that
+    factorizations of the padded matrix stay nonsingular (SURVEY §7
+    hard-part (4): prefer padding to uniform nb on TPU)."""
+    mask = ~layout.element_mask()
+    gr = jnp.asarray(layout.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(layout.global_cols_np)[None, :, None, :]
+    diag_pad = mask & (gr == gc)
+    return jnp.where(diag_pad, jnp.asarray(scale, T.dtype), T)

@@ -1,0 +1,57 @@
+"""Grid/blocksize lambda tests (reference: unit_test/test_func.cc)."""
+
+from slate_tpu.enums import GridOrder
+from slate_tpu import func
+
+
+def test_uniform_blocksize():
+    size = func.uniform_blocksize(100, 16)
+    assert [size(i) for i in range(7)] == [16] * 6 + [4]
+    size = func.uniform_blocksize(64, 16)
+    assert [size(i) for i in range(4)] == [16] * 4
+
+
+def test_max_blocksize():
+    assert func.max_blocksize(7, func.uniform_blocksize(100, 16)) == 16
+    assert func.max_blocksize(0, func.uniform_blocksize(100, 16)) == 0
+
+
+def test_process_2d_grid_col():
+    f = func.process_2d_grid(GridOrder.Col, 2, 3)
+    assert f((0, 0)) == 0
+    assert f((1, 0)) == 1
+    assert f((0, 1)) == 2
+    assert f((2, 3)) == 0  # wraps
+
+
+def test_process_2d_grid_row():
+    f = func.process_2d_grid(GridOrder.Row, 2, 3)
+    assert f((0, 0)) == 0
+    assert f((0, 1)) == 1
+    assert f((1, 0)) == 3
+
+
+def test_device_2d_grid_blocks():
+    f = func.device_2d_grid(GridOrder.Col, 2, 2, 2, 2)
+    # tiles (0..1, 0..1) all map to device 0
+    assert {f((i, j)) for i in range(2) for j in range(2)} == {0}
+    assert f((2, 0)) == 1
+
+
+def test_transpose_grid():
+    f = func.process_2d_grid(GridOrder.Col, 2, 3)
+    ft = func.transpose_grid(f)
+    assert ft((1, 2)) == f((2, 1))
+
+
+def test_is_2d_cyclic_grid_detects():
+    for order in (GridOrder.Col, GridOrder.Row):
+        f = func.process_2d_grid(order, 2, 3)
+        ok, detected, p, q = func.is_2d_cyclic_grid(8, 9, f)
+        assert ok and p == 2 and q == 3 and detected == order
+
+
+def test_is_2d_cyclic_grid_rejects():
+    f = func.round_robin(4)
+    ok, order, p, q = func.is_2d_cyclic_grid(8, 8, f)
+    assert not ok and order == GridOrder.Unknown

@@ -1,0 +1,90 @@
+"""TileLayout round-trip and index-map tests (reference semantics:
+BaseMatrix.hh tileRank/tileMb/tileNb, func.hh grids)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.parallel.layout import (
+    TileLayout,
+    eye_splice,
+    tiles_from_global,
+    tiles_to_global,
+)
+
+
+@pytest.mark.parametrize(
+    "m,n,mb,nb,p,q",
+    [
+        (8, 8, 4, 4, 1, 1),
+        (100, 80, 16, 16, 2, 2),
+        (33, 65, 8, 16, 4, 2),
+        (7, 7, 8, 8, 2, 2),  # single partial tile
+        (64, 64, 16, 16, 3, 2),  # p doesn't divide mt
+    ],
+)
+def test_roundtrip(m, n, mb, nb, p, q):
+    layout = TileLayout(m, n, mb, nb, p, q)
+    A = np.random.default_rng(0).standard_normal((m, n))
+    T = tiles_from_global(jnp.asarray(A), layout)
+    assert T.shape == layout.storage_shape
+    back = tiles_to_global(T, layout)
+    np.testing.assert_array_equal(np.asarray(back), A)
+
+
+def test_storage_permutation_is_cyclic():
+    layout = TileLayout(64, 64, 8, 8, 2, 2)  # mt = nt = 8
+    # storage rows [0..3] hold tiles i % 2 == 0 (process row 0), [4..7] i%2==1
+    for s in range(layout.P):
+        i = layout.lrow(s)
+        assert layout.srow(i) == s
+        r = s // layout.mtl
+        assert i % layout.p == r, "slot block r must hold process-row r tiles"
+
+
+def test_tile_sizes_ragged():
+    layout = TileLayout(100, 70, 16, 32, 2, 2)
+    assert layout.mt == 7 and layout.nt == 3
+    assert layout.tileMb(6) == 100 - 6 * 16
+    assert layout.tileMb(0) == 16
+    assert layout.tileNb(2) == 70 - 2 * 32
+    # masks agree with tile sizes
+    mask = np.asarray(layout.element_mask())
+    assert mask.sum() == 100 * 70
+
+
+def test_tile_rank_cyclic():
+    layout = TileLayout(64, 64, 8, 8, 2, 3)
+    for i in range(layout.mt):
+        for j in range(layout.nt):
+            assert layout.tileRank(i, j) == (i % 2, j % 3)
+
+
+def test_sharded_placement(grid22):
+    """Each process's shard must hold exactly its block-cyclic tiles."""
+    layout = TileLayout(64, 64, 8, 8, grid22.p, grid22.q)
+    A = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    T = tiles_from_global(jnp.asarray(A), layout)
+    T = jax.device_put(T, grid22.tile_sharding())
+    # shard for mesh position (0, 0) holds tiles (i%2==0, j%2==0)
+    shards = {s.device: s for s in T.addressable_shards}
+    mesh_devs = np.asarray(grid22.mesh.devices)
+    shard00 = np.asarray(shards[mesh_devs[0, 0]].data)
+    assert shard00.shape == (layout.mtl, layout.ntl, 8, 8)
+    # tile (0,0) of the shard is global tile (0,0): elements A[0:8, 0:8]
+    np.testing.assert_array_equal(shard00[0, 0], A[0:8, 0:8])
+    # tile (1,1) of the shard is global tile (2,2): elements A[16:24, 16:24]
+    np.testing.assert_array_equal(shard00[1, 1], A[16:24, 16:24])
+
+
+def test_eye_splice_pads_diagonal():
+    layout = TileLayout(10, 10, 4, 4, 1, 1)  # padded to 12x12
+    T = tiles_from_global(jnp.zeros((10, 10)), layout)
+    T = eye_splice(layout, T)
+    A = np.asarray(
+        tiles_to_global(T, TileLayout(12, 12, 4, 4, 1, 1))
+    )
+    # in-range part untouched (zero), padding diagonal = 1
+    assert A[:10, :10].sum() == 0
+    np.testing.assert_array_equal(np.diag(A)[10:], [1.0, 1.0])

@@ -1,0 +1,96 @@
+"""Philox matgen RNG tests (reference semantics: matgen/random.cc).
+
+The key property under test: element (i, j) value depends only on
+(seed, i, j) — never on tiling, sub-matrix offsets, or backend.
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.matgen import philox
+
+
+def _ij(m, n, ioff=0, joff=0):
+    i = np.arange(ioff, ioff + m, dtype=np.uint64)[:, None]
+    j = np.arange(joff, joff + n, dtype=np.uint64)[None, :]
+    return np.broadcast_arrays(i + 0 * j, 0 * i + j)
+
+
+class TestPhiloxCore:
+    def test_reference_vector_identity(self):
+        """philox_2x64({0,0}, 0) — pin the implementation with a self-vector
+        and check basic statistical sanity of the stream."""
+        L, R = philox.philox_2x64_np(np.uint64(0), np.uint64(0), 0)
+        # must be deterministic and nonzero
+        L2, R2 = philox.philox_2x64_np(np.uint64(0), np.uint64(0), 0)
+        assert L == L2 and R == R2
+        assert L != 0 and R != 0
+
+    def test_distinct_counters_distinct_streams(self):
+        i, j = _ij(64, 64)
+        L, R = philox.philox_2x64_np(i, j, 1234)
+        flat = np.stack([L.ravel(), R.ravel()], axis=1)
+        assert len(np.unique(flat, axis=0)) == flat.shape[0]
+
+    def test_seed_changes_stream(self):
+        i, j = _ij(8, 8)
+        L1, _ = philox.philox_2x64_np(i, j, 1)
+        L2, _ = philox.philox_2x64_np(i, j, 2)
+        assert not np.array_equal(L1, L2)
+
+    def test_jnp_matches_np_bits(self):
+        i, j = _ij(33, 17, ioff=5, joff=900)
+        Ln, Rn = philox.philox_2x64_np(i, j, 42)
+        (Lh, Ll), (Rh, Rl) = philox.philox_2x64_jnp(
+            np.asarray(i, np.int64), np.asarray(j, np.int64), 42
+        )
+        L_j = (np.asarray(Lh, np.uint64) << np.uint64(32)) | np.asarray(Ll, np.uint64)
+        R_j = (np.asarray(Rh, np.uint64) << np.uint64(32)) | np.asarray(Rl, np.uint64)
+        np.testing.assert_array_equal(L_j, Ln)
+        np.testing.assert_array_equal(R_j, Rn)
+
+
+class TestDistributions:
+    @pytest.mark.parametrize("dist", philox.DISTS)
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_offset_independence(self, dist, dtype):
+        """Sub-matrix generation at offset equals slice of full generation
+        (what makes generation tiling-independent; random.cc:163-175)."""
+        i, j = _ij(16, 16)
+        full = philox.random_np(dist, 7, i, j, dtype)
+        i2, j2 = _ij(4, 4, ioff=8, joff=8)
+        sub = philox.random_np(dist, 7, i2, j2, dtype)
+        np.testing.assert_array_equal(full[8:12, 8:12], sub)
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_uniform_range(self, dtype):
+        i, j = _ij(64, 64)
+        x = philox.random_np("uniform", 3, i, j, dtype)
+        assert x.min() >= 0.0 and x.max() < 1.0
+        assert abs(x.mean() - 0.5) < 0.02
+
+    def test_normal_moments(self):
+        i, j = _ij(256, 256)
+        x = philox.random_np("normal", 5, i, j, np.float64)
+        assert abs(x.mean()) < 0.01
+        assert abs(x.std() - 1.0) < 0.01
+
+    def test_complex_parts(self):
+        i, j = _ij(16, 16)
+        z = philox.random_np("uniform", 11, i, j, np.complex128)
+        re = philox.random_np("uniform", 11, i, j, np.float64)
+        np.testing.assert_array_equal(z.real, re)
+        assert np.all(z.imag >= 0) and np.all(z.imag < 1)
+
+    @pytest.mark.parametrize("dist", ["uniform", "uniform_signed", "binary_signed"])
+    def test_jnp_matches_np_values(self, dist):
+        i, j = _ij(16, 16)
+        xn = philox.random_np(dist, 9, i, j, np.float64)
+        xj = philox.random_jnp(dist, 9, np.asarray(i, np.int64), np.asarray(j, np.int64), np.float64)
+        np.testing.assert_array_equal(np.asarray(xj), xn)
+
+    def test_jnp_matches_np_normal_close(self):
+        i, j = _ij(16, 16)
+        xn = philox.random_np("normal", 9, i, j, np.float64)
+        xj = philox.random_jnp("normal", 9, np.asarray(i, np.int64), np.asarray(j, np.int64), np.float64)
+        np.testing.assert_allclose(np.asarray(xj), xn, rtol=1e-12, atol=1e-12)
