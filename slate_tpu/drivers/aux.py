@@ -1,0 +1,171 @@
+"""Auxiliary elementwise/norm drivers (reference: src/add.cc, copy.cc,
+scale.cc, scale_row_col.cc, set.cc, set_lambdas (src/set.cc), norm.cc,
+colNorms -> NormScope, print.cc, redistribute.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import Diag, Norm, NormScope, Uplo
+from ..exceptions import DimensionError
+from ..internal import norms as _norms
+from ..internal import tile_ops
+from ..matrix.base import BaseMatrix
+from ..matrix.matrix import BaseTrapezoidMatrix, HermitianMatrix, Matrix, SymmetricMatrix
+from ..parallel.layout import TileLayout, tiles_from_global
+
+
+def _check_same_shape(A: BaseMatrix, B: BaseMatrix):
+    if (A.m, A.n) != (B.m, B.n):
+        raise DimensionError(f"shape mismatch {A.m}x{A.n} vs {B.m}x{B.n}")
+
+
+def add(alpha, A: BaseMatrix, beta, B: BaseMatrix, opts=None) -> BaseMatrix:
+    """B = alpha A + beta B (reference: src/add.cc -> internal geadd/tzadd)."""
+    _check_same_shape(A, B)
+    Ar, Br = A.resolved(), B.resolved()
+    if Ar.layout == Br.layout:
+        if isinstance(B, BaseTrapezoidMatrix) and B.uplo != Uplo.General:
+            mask = Br.tri_mask()
+            out = tile_ops.tzadd(mask, alpha, Ar.data, beta, Br.data)
+        else:
+            out = tile_ops.geadd(alpha, Ar.data, beta, Br.data)
+        return Br._with(data=out)
+    # layout mismatch: go through global arrays
+    out2d = alpha * Ar.to_global() + beta * Br.to_global()
+    return Br._with(data=tiles_from_global(out2d.astype(B.dtype), Br.layout))
+
+
+def copy(A: BaseMatrix, B: BaseMatrix, opts=None) -> BaseMatrix:
+    """B = A with optional precision conversion (reference: src/copy.cc);
+    also the precision-converting copy used by mixed-precision solvers."""
+    _check_same_shape(A, B)
+    Ar, Br = A.resolved(), B.resolved()
+    if Ar.layout == Br.layout:
+        return Br._with(data=Ar.data.astype(B.dtype))
+    return Br._with(
+        data=tiles_from_global(Ar.to_global().astype(B.dtype), Br.layout)
+    )
+
+
+def scale(numer, denom, A: BaseMatrix, opts=None) -> BaseMatrix:
+    """A *= numer/denom (reference: src/scale.cc)."""
+    Ar = A.resolved()
+    if isinstance(A, BaseTrapezoidMatrix) and A.uplo != Uplo.General:
+        out = tile_ops.tzscale(Ar.tri_mask(), numer, denom, Ar.data)
+    else:
+        out = tile_ops.gescale(numer, denom, Ar.data)
+    return Ar._with(data=out)
+
+
+def scale_row_col(
+    R: Optional[jnp.ndarray],
+    C: Optional[jnp.ndarray],
+    A: BaseMatrix,
+    opts=None,
+) -> BaseMatrix:
+    """A = diag(R) A diag(C) (reference: src/scale_row_col.cc, Equed)."""
+    Ar = A.resolved()
+    out = tile_ops.gescale_row_col(Ar.layout, R, C, Ar.data)
+    return Ar._with(data=out)
+
+
+def set(offdiag_value, diag_value, A: BaseMatrix, opts=None) -> BaseMatrix:
+    """A = offdiag everywhere, diag on the diagonal (reference: src/set.cc)."""
+    Ar = A.resolved()
+    if isinstance(A, BaseTrapezoidMatrix) and A.uplo != Uplo.General:
+        out = tile_ops.tzset(Ar.layout, Ar.uplo, offdiag_value, diag_value, Ar.data)
+    else:
+        out = tile_ops.geset(Ar.layout, offdiag_value, diag_value, Ar.data)
+    return Ar._with(data=out)
+
+
+def set_lambdas(value_fn: Callable, A: BaseMatrix, opts=None) -> BaseMatrix:
+    """A[i, j] = value_fn(i, j) elementwise over global indices
+    (reference: src/set.cc set(lambda) variant used by matgen).
+
+    value_fn receives broadcast (i, j) index arrays and must be
+    jnp-traceable; evaluated only on valid elements, padding stays 0.
+    """
+    Ar = A.resolved()
+    lay = Ar.layout
+    gr = jnp.asarray(lay.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(lay.global_cols_np)[None, :, None, :]
+    vals = value_fn(gr, gc).astype(A.dtype)
+    vals = jnp.broadcast_to(vals, lay.storage_shape)
+    out = jnp.where(lay.element_mask(), vals, jnp.zeros_like(vals))
+    return Ar._with(data=out)
+
+
+def norm(
+    norm_type: Norm,
+    A: BaseMatrix,
+    scope: NormScope = NormScope.Matrix,
+    opts=None,
+):
+    """Matrix / column / row norms (reference: src/norm.cc dispatching to
+    internal::genorm/synorm/henorm/trnorm with MPI allreduce; here one
+    masked XLA reduction, psum'd automatically when sharded)."""
+    Ar = A.resolved()
+    if isinstance(A, HermitianMatrix):
+        return _norms.henorm(norm_type, Ar.data, Ar.layout, Ar.uplo)
+    if isinstance(A, SymmetricMatrix):
+        return _norms.synorm(norm_type, Ar.data, Ar.layout, Ar.uplo)
+    if isinstance(A, BaseTrapezoidMatrix) and A.uplo != Uplo.General:
+        return _norms.trnorm(norm_type, Ar.data, Ar.layout, Ar.uplo, Ar.diag)
+    return _norms.genorm(norm_type, Ar.data, Ar.layout, scope)
+
+
+def colNorms(norm_type: Norm, A: BaseMatrix, opts=None):
+    """Per-column norms (reference: src/colNorms.cc, Norm.One scope)."""
+    return norm(norm_type if norm_type else Norm.One, A, scope=NormScope.Columns)
+
+
+def redistribute(A: BaseMatrix, B: BaseMatrix, opts=None) -> BaseMatrix:
+    """Copy A into B's (different) distribution (reference:
+    src/redistribute.cc tile re-send; here one resharded pack)."""
+    _check_same_shape(A, B)
+    out2d = A.resolved().to_global()
+    Br = B.resolved()
+    return Br._with(data=tiles_from_global(out2d.astype(B.dtype), Br.layout)).shard()
+
+
+def print_matrix(label: str, A: BaseMatrix, opts=None, verbose: int = 4,
+                 width: int = 10, precision: int = 4) -> str:
+    """Distributed matrix printing (reference: src/print.cc — gathers to
+    rank 0 and formats; PrintVerbose levels enums.hh:477-487)."""
+    if verbose <= 0:
+        return ""
+    header = (
+        f"% {label}: {type(A).__name__} {A.m}x{A.n}, "
+        f"tiles {A.mb}x{A.nb}, grid {A.layout.p}x{A.layout.q}\n"
+    )
+    if verbose == 1:
+        return header
+    G = np.asarray(A.to_global())
+    if verbose == 2:
+        edge = 4
+        G = np.block(
+            [
+                [G[:edge, :edge], G[:edge, -edge:]],
+                [G[-edge:, :edge], G[-edge:, -edge:]],
+            ]
+        )
+    body_lines = []
+    fmt = f"%{width}.{precision}f"
+    for row in G:
+        if np.iscomplexobj(row):
+            body_lines.append(
+                " ".join(
+                    (fmt % v.real) + ("+" + (fmt % v.imag).strip() + "i")
+                    for v in row
+                )
+            )
+        else:
+            body_lines.append(" ".join(fmt % v for v in row))
+    text = header + label + " = [\n" + "\n".join(body_lines) + "\n]\n"
+    return text

@@ -1,0 +1,216 @@
+"""Level-3 BLAS drivers (reference: src/gemm.cc, gemmA.cc, gemmC.cc,
+hemm.cc, symm.cc, herk.cc, her2k.cc, syrk.cc, syr2k.cc, trmm.cc, trsm.cc).
+
+Functional API: every routine returns the updated output matrix.
+
+Two execution paths, selected per call:
+
+* **global path** (single device / small grids): operands are materialized
+  as (padded) 2D arrays and the op is one XLA kernel — on one chip this is
+  the optimal schedule (max MXU tiles, fused epilogue), replacing the
+  reference's 4-way target dispatch + OpenMP task DAG wholesale.
+* **spmd path** (multi-device mesh): explicit shard_map SUMMA /
+  stationary-A with ICI collectives (parallel/spmd_blas.py), mirroring
+  gemmC/gemmA's broadcast/reduce structure.
+
+Method auto-selection mirrors gemm.cc:12-24: stationary-C unless A is
+much taller than C is wide (then stationary-A avoids moving A).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..enums import Diag, MethodGemm, Op, Option, Side, Uplo
+from ..exceptions import DimensionError, slate_assert
+from ..matrix.base import BaseMatrix
+from ..matrix.matrix import (
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TrapezoidMatrix,
+    TriangularMatrix,
+)
+from ..options import Options, get_option
+from ..ops import blas2d
+from ..parallel import spmd_blas
+from ..parallel.layout import tiles_from_global
+
+
+def _is_distributed(M: BaseMatrix) -> bool:
+    return M.grid is not None and M.grid.size > 1
+
+
+def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
+    """Pack a computed (m, n) global array back into C's layout/grid."""
+    T = tiles_from_global(C_new_2d.astype(C.dtype), C.layout)
+    out = C._with(data=T)
+    return out.shard()
+
+
+def _same_tiling(A: BaseMatrix, B: BaseMatrix, dims=("k",)) -> bool:
+    return True  # layouts are validated per-routine; padding handled by 2D path
+
+
+def gemm(
+    alpha,
+    A: Matrix,
+    B: Matrix,
+    beta,
+    C: Matrix,
+    opts: Optional[Options] = None,
+) -> Matrix:
+    """C = alpha op(A) op(B) + beta C (reference: src/gemm.cc:82).
+
+    Auto method select (gemm.cc:12-24): stationary-A when A's k dim is
+    small and C is narrow; else stationary-C (SUMMA).
+    """
+    if A.n != B.m or A.m != C.m or B.n != C.n:
+        raise DimensionError(
+            f"gemm dims: A {A.m}x{A.n}, B {B.m}x{B.n}, C {C.m}x{C.n}"
+        )
+    method = get_option(opts, Option.MethodGemm, MethodGemm.Auto)
+    if isinstance(method, str):
+        method = MethodGemm.from_string(method)
+
+    if _is_distributed(C) and get_option(opts, Option.UseShardMap):
+        Ar, Br = A.resolved(), B.resolved()
+        if method == MethodGemm.Auto:
+            # gemm.cc:12-24: use gemmA when A stays put profitably
+            method = (
+                MethodGemm.A
+                if (C.layout.nt <= C.grid.q and Ar.layout.mt > 2 * C.layout.nt)
+                else MethodGemm.C
+            )
+        # tile-size conformability for the tile-level spmd kernels
+        ok_tiles = (
+            Ar.layout.nb == Br.layout.mb
+            and Ar.layout.mb == C.layout.mb
+            and Br.layout.nb == C.layout.nb
+            and (Ar.layout.p, Ar.layout.q) == (C.layout.p, C.layout.q)
+            and (Br.layout.p, Br.layout.q) == (C.layout.p, C.layout.q)
+        )
+        if ok_tiles:
+            fn = (
+                spmd_blas.gemm_reduce_a
+                if method == MethodGemm.A
+                else spmd_blas.summa_gemm
+            )
+            data = fn(
+                C.grid, alpha, Ar.data, Ar.layout, Br.data, Br.layout,
+                beta, C.data, C.layout,
+            )
+            return C._with(data=data)
+        # fall through to global path (GSPMD inserts collectives)
+
+    A2 = A.to_global()
+    B2 = B.to_global()
+    C2 = C.to_global()
+    out = blas2d.gemm2d(alpha, A2, B2, beta, C2)
+    return _repack_like(out, C)
+
+
+def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
+         opts=None) -> Matrix:
+    """C = alpha A B + beta C, A symmetric (reference: src/symm.cc)."""
+    Af = A.full_global()
+    B2, C2 = B.to_global(), C.to_global()
+    out = (
+        blas2d.gemm2d(alpha, Af, B2, beta, C2)
+        if side == Side.Left
+        else blas2d.gemm2d(alpha, B2, Af, beta, C2)
+    )
+    return _repack_like(out, C)
+
+
+def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
+         opts=None) -> Matrix:
+    """C = alpha A B + beta C, A Hermitian (reference: src/hemm.cc,
+    method A/C variants collapse to one fused XLA product here)."""
+    Af = A.full_global()
+    B2, C2 = B.to_global(), C.to_global()
+    out = (
+        blas2d.gemm2d(alpha, Af, B2, beta, C2)
+        if side == Side.Left
+        else blas2d.gemm2d(alpha, B2, Af, beta, C2)
+    )
+    return _repack_like(out, C)
+
+
+def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None):
+    slate_assert(C.m == C.n, "herk/syrk C must be square")
+    k_dim = A.n
+    A2 = A.to_global()
+    C2 = C.full_global()
+    if rank2:
+        B2 = B.to_global()
+        out = (
+            blas2d.her2k2d(alpha, A2, B2, beta, C2)
+            if conj
+            else blas2d.syr2k2d(alpha, A2, B2, beta, C2)
+        )
+    else:
+        out = (
+            blas2d.herk2d(alpha, A2, beta, C2)
+            if conj
+            else blas2d.syrk2d(alpha, A2, beta, C2)
+        )
+    return _repack_like(out, C)
+
+
+def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
+    """C = alpha op(A) op(A)^T + beta C (reference: src/syrk.cc)."""
+    if A.m != C.m:
+        raise DimensionError(f"syrk dims: A {A.m}x{A.n}, C {C.m}x{C.n}")
+    return _herk_like(alpha, A, beta, C, conj=False)
+
+
+def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
+    """C = alpha op(A) op(A)^H + beta C (reference: src/herk.cc)."""
+    if A.m != C.m:
+        raise DimensionError(f"herk dims: A {A.m}x{A.n}, C {C.m}x{C.n}")
+    return _herk_like(alpha, A, beta, C, conj=True)
+
+
+def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
+    """C = alpha (A B^T + B A^T) + beta C (reference: src/syr2k.cc)."""
+    if A.m != C.m or B.m != C.m or A.n != B.n:
+        raise DimensionError("syr2k dims")
+    return _herk_like(alpha, A, beta, C, conj=False, rank2=True, B=B)
+
+
+def her2k(alpha, A: Matrix, B: Matrix, beta, C: HermitianMatrix, opts=None):
+    """C = alpha A B^H + conj(alpha) B A^H + beta C (reference: src/her2k.cc)."""
+    if A.m != C.m or B.m != C.m or A.n != B.n:
+        raise DimensionError("her2k dims")
+    return _herk_like(alpha, A, beta, C, conj=True, rank2=True, B=B)
+
+
+def _resolve_tri(A: TriangularMatrix):
+    """Triangular operand as (2D array, uplo, op-applied) honoring A.op."""
+    op = A.op
+    A_nores = A._with(op=Op.NoTrans)  # storage view
+    return A_nores.to_global(), A.uplo if op == Op.NoTrans else (
+        Uplo.Upper if A.uplo == Uplo.Lower else Uplo.Lower
+    ), op
+
+
+def trmm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
+    """B = alpha op(A) B or alpha B op(A) (reference: src/trmm.cc)."""
+    A2 = A._with(op=Op.NoTrans).to_global()
+    out = blas2d.trmm2d(side, A.uplo, A.op, A.diag, alpha, A2, B.to_global())
+    return _repack_like(out, B)
+
+
+def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
+    """Solve op(A) X = alpha B (or right) (reference: src/trsm.cc ->
+    trsmA/trsmB work pipelines, src/work/work_trsm.cc).
+
+    Global path: one XLA triangular_solve (internally blocked/pipelined by
+    XLA — the work_trsm row pipeline is the compiler's job on TPU).
+    """
+    A2 = A._with(op=Op.NoTrans).to_global()
+    out = blas2d.trsm2d(side, A.uplo, A.op, A.diag, alpha, A2, B.to_global())
+    return _repack_like(out, B)

@@ -1,0 +1,144 @@
+"""Batched tile operations over storage-order tile arrays.
+
+TPU-native equivalents of the reference device kernel set (reference:
+src/cuda/device_{geadd,gecopy,gescale,gescale_row_col,geset,transpose,
+tzadd,tzcopy,tzscale,tzset}.cu; interface include/slate/internal/device.hh:
+92-282).  Where the reference launches one batched CUDA kernel over pointer
+arrays grouped by uniform tile size (internal_batch.hh:197-304), here every
+op is a single fused XLA elementwise expression over the whole (P, Q, mb,
+nb) array — uniform padding makes the batch trivially regular, XLA fuses
+the mask logic, and under a sharded array each device touches only its
+local tiles.
+
+The tz* (trapezoid) variants take an element mask computed from the
+layout's global index maps, generalizing the reference's per-tile uplo +
+offset logic to the distributed tile grid in one shot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import Diag, Uplo
+from ..parallel.layout import TileLayout
+
+
+# -- masks ------------------------------------------------------------------
+
+
+def tri_mask(
+    layout: TileLayout,
+    uplo: Uplo,
+    diag: Diag = Diag.NonUnit,
+    include_valid_only: bool = True,
+) -> jnp.ndarray:
+    """(P, Q, mb, nb) mask of the uplo triangle (device tz* kernels' uplo
+    handling, device_util.cuh / tzset.cu)."""
+    gr = jnp.asarray(layout.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(layout.global_cols_np)[None, :, None, :]
+    if uplo == Uplo.Lower:
+        mask = gr >= gc if diag == Diag.NonUnit else gr > gc
+    elif uplo == Uplo.Upper:
+        mask = gr <= gc if diag == Diag.NonUnit else gr < gc
+    else:
+        mask = jnp.ones(np.broadcast_shapes(gr.shape, gc.shape), dtype=bool)
+    if include_valid_only:
+        mask = mask & layout.element_mask()
+    return mask
+
+
+def diag_mask(layout: TileLayout) -> jnp.ndarray:
+    gr = jnp.asarray(layout.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(layout.global_cols_np)[None, :, None, :]
+    return (gr == gc) & layout.element_mask()
+
+
+# -- ge (general) kernels ---------------------------------------------------
+
+
+def geadd(alpha, A: jnp.ndarray, beta, B: jnp.ndarray) -> jnp.ndarray:
+    """B = alpha*A + beta*B (reference: device_geadd.cu; device.hh:92)."""
+    return alpha * A + beta * B
+
+
+def gecopy(A: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    """Copy with optional precision conversion (device_gecopy.cu)."""
+    return A.astype(dtype) if dtype is not None else A
+
+
+def gescale(numer, denom, A: jnp.ndarray) -> jnp.ndarray:
+    """A *= numer/denom (device_gescale.cu)."""
+    return A * (numer / denom)
+
+
+def gescale_row_col(
+    layout: TileLayout, R: Optional[jnp.ndarray], C: Optional[jnp.ndarray], A: jnp.ndarray
+) -> jnp.ndarray:
+    """A = diag(R) @ A @ diag(C) with global row/col scaling vectors
+    (device_gescale_row_col.cu; Equed row/col/both).
+
+    R has length >= m, C length >= n (padded); indexed via the layout's
+    global index maps so it works directly on the distributed tile array.
+    """
+    out = A
+    if R is not None:
+        gr = jnp.asarray(layout.global_rows_np)  # (P, mb)
+        Rt = jnp.take(R, jnp.clip(gr, 0, R.shape[0] - 1), axis=0)
+        out = out * Rt[:, None, :, None].astype(A.dtype)
+    if C is not None:
+        gc = jnp.asarray(layout.global_cols_np)  # (Q, nb)
+        Ct = jnp.take(C, jnp.clip(gc, 0, C.shape[0] - 1), axis=0)
+        out = out * Ct[None, :, None, :].astype(A.dtype)
+    return out
+
+
+def geset(layout: TileLayout, offdiag_value, diag_value, A: jnp.ndarray) -> jnp.ndarray:
+    """Set off-diagonal / diagonal elements (device_geset.cu); padding
+    stays zero so norms/gemm on padded arrays remain correct."""
+    valid = layout.element_mask()
+    dm = diag_mask(layout)
+    out = jnp.where(valid, jnp.asarray(offdiag_value, A.dtype), A * 0)
+    out = jnp.where(dm, jnp.asarray(diag_value, A.dtype), out)
+    return out
+
+
+# -- tz (trapezoid) kernels -------------------------------------------------
+
+
+def tzadd(mask, alpha, A, beta, B):
+    """B = alpha*A + beta*B on masked region only (device_tzadd.cu)."""
+    return jnp.where(mask, alpha * A + beta * B, B)
+
+
+def tzcopy(mask, A, B, dtype=None):
+    """B[mask] = A[mask] (device_tzcopy.cu)."""
+    Ac = A.astype(B.dtype if dtype is None else dtype)
+    return jnp.where(mask, Ac, B)
+
+
+def tzscale(mask, numer, denom, A):
+    return jnp.where(mask, A * (numer / denom), A)
+
+
+def tzset(layout: TileLayout, uplo: Uplo, offdiag_value, diag_value, A):
+    """Set the uplo triangle (off-diag) + diagonal (device_tzset.cu)."""
+    tm = tri_mask(layout, uplo, Diag.Unit)  # strict triangle
+    dm = diag_mask(layout)
+    out = jnp.where(tm, jnp.asarray(offdiag_value, A.dtype), A)
+    out = jnp.where(dm, jnp.asarray(diag_value, A.dtype), out)
+    return out
+
+
+# -- transpose kernels ------------------------------------------------------
+
+
+def batch_transpose(T: jnp.ndarray, conj: bool = False) -> jnp.ndarray:
+    """Per-tile (conj-)transpose of all tiles (device_transpose.cu
+    in/out-of-place square + rectangular variants collapse to one XLA op)."""
+    out = T.transpose(0, 1, 3, 2)
+    if conj and jnp.issubdtype(T.dtype, jnp.complexfloating):
+        out = jnp.conj(out)
+    return out

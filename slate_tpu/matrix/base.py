@@ -1,0 +1,262 @@
+"""Distributed matrix classes.
+
+TPU-native re-design of the reference's BaseMatrix hierarchy (reference:
+include/slate/BaseMatrix.hh:40, Matrix.hh, *Matrix.hh headers).  Differences
+by design:
+
+* **Functional, not mutating**: routines return new matrices; there is no
+  MOSI coherence, tile insert/erase, or hold machinery (BaseMatrix.hh
+  tileGet*/tileAcquire) because XLA owns placement and staging on TPU.
+* **One array, not a tile map**: storage is a single jax array
+  (P, Q, mb, nb) in owner-major block-cyclic order (see parallel/layout.py)
+  instead of std::map<(i,j) -> TileNode> (MatrixStorage.hh:151).
+* **Transpose is a flag** resolved lazily, like the reference's op flag
+  (BaseMatrix.hh:770-781): `transpose(A)` is O(1); internals materialize.
+
+Matrices are registered pytrees, so they pass through jit/scan/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import Diag, Op, Uplo
+from ..exceptions import DimensionError, slate_assert
+from ..parallel.grid import ProcessGrid
+from ..parallel.layout import (
+    TileLayout,
+    tiles_from_global,
+    tiles_to_global,
+)
+
+
+class BaseMatrix:
+    """Shared behavior for all matrix kinds.
+
+    Attributes:
+        data:   (P, Q, mb, nb) storage-order tile array (may be sharded).
+        layout: static TileLayout index math.
+        grid:   ProcessGrid or None (single-device semantics).
+        op:     Op flag of this view (NoTrans/Trans/ConjTrans).
+    """
+
+    uplo: Uplo = Uplo.General
+    diag: Diag = Diag.NonUnit
+
+    def __init__(
+        self,
+        data: jnp.ndarray,
+        layout: TileLayout,
+        grid: Optional[ProcessGrid] = None,
+        op: Op = Op.NoTrans,
+    ):
+        slate_assert(
+            tuple(data.shape) == layout.storage_shape,
+            f"data shape {data.shape} != layout {layout.storage_shape}",
+        )
+        self.data = data
+        self.layout = layout
+        self.grid = grid
+        self.op = op
+
+    # -- pytree protocol ----------------------------------------------------
+
+    def tree_flatten(self):
+        aux = (
+            self.layout,
+            self.grid,
+            self.op,
+            type(self),
+            getattr(self, "uplo", Uplo.General),
+            getattr(self, "diag", Diag.NonUnit),
+        )
+        return (self.data,), aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        layout, grid, op, klass, uplo, diag = aux
+        obj = object.__new__(klass)
+        obj.data = children[0]
+        obj.layout = layout
+        obj.grid = grid
+        obj.op = op
+        obj.uplo = uplo
+        obj.diag = diag
+        return obj
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        jax.tree_util.register_pytree_node_class(cls)
+
+    # -- basic queries (reference: BaseMatrix.hh:211-223, mt/nt/m/n) --------
+
+    @property
+    def m(self) -> int:
+        return self.layout.n if self.op != Op.NoTrans else self.layout.m
+
+    @property
+    def n(self) -> int:
+        return self.layout.m if self.op != Op.NoTrans else self.layout.n
+
+    @property
+    def mt(self) -> int:
+        return self.layout.nt if self.op != Op.NoTrans else self.layout.mt
+
+    @property
+    def nt(self) -> int:
+        return self.layout.mt if self.op != Op.NoTrans else self.layout.nt
+
+    @property
+    def mb(self) -> int:
+        return self.layout.nb if self.op != Op.NoTrans else self.layout.mb
+
+    @property
+    def nb(self) -> int:
+        return self.layout.mb if self.op != Op.NoTrans else self.layout.nb
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def tileMb(self, i: int) -> int:
+        return self.layout.tileNb(i) if self.op != Op.NoTrans else self.layout.tileMb(i)
+
+    def tileNb(self, j: int) -> int:
+        return self.layout.tileMb(j) if self.op != Op.NoTrans else self.layout.tileNb(j)
+
+    def tileRank(self, i: int, j: int) -> Tuple[int, int]:
+        if self.op != Op.NoTrans:
+            r, c = self.layout.tileRank(j, i)
+            return (c, r)
+        return self.layout.tileRank(i, j)
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self.dtype, jnp.complexfloating)
+
+    # -- op handling (reference: BaseMatrix.hh transpose/conj_transpose) ----
+
+    def _with(self, **kw) -> "BaseMatrix":
+        out = object.__new__(type(self))
+        out.data = kw.get("data", self.data)
+        out.layout = kw.get("layout", self.layout)
+        out.grid = kw.get("grid", self.grid)
+        out.op = kw.get("op", self.op)
+        for extra in ("uplo", "diag"):
+            if hasattr(self, extra):
+                setattr(out, extra, kw.get(extra, getattr(self, extra)))
+        return out
+
+    def resolved(self) -> "BaseMatrix":
+        """Materialize the op flag into the data (internals see NoTrans).
+
+        Transposing swaps the storage grid roles (p <-> q), implemented as
+        one XLA transpose of the tile array plus the static permutations
+        natural <-> storage on both axes.
+        """
+        if self.op == Op.NoTrans:
+            return self
+        lay = self.layout
+        # storage -> natural on both axes, swap, natural -> storage of A^T
+        T = self.data[lay.row_scatter][:, lay.col_scatter]
+        T = T.transpose(1, 0, 3, 2)
+        if self.op == Op.ConjTrans and jnp.issubdtype(T.dtype, jnp.complexfloating):
+            T = jnp.conj(T)
+        lay_t = lay.transposed()
+        T = T[lay_t.row_gather][:, lay_t.col_gather]
+        out = self._with(data=T, layout=lay_t, op=Op.NoTrans)
+        if getattr(self, "uplo", Uplo.General) == Uplo.Lower:
+            out.uplo = Uplo.Upper
+        elif getattr(self, "uplo", Uplo.General) == Uplo.Upper:
+            out.uplo = Uplo.Lower
+        return out
+
+    # -- conversions --------------------------------------------------------
+
+    def to_global(self) -> jnp.ndarray:
+        """Gather to the (m, n) global array, honoring the op flag."""
+        A = tiles_to_global(self.data, self.layout)
+        if self.op == Op.Trans:
+            A = A.T
+        elif self.op == Op.ConjTrans:
+            A = jnp.conj(A).T
+        return A
+
+    def to_padded_global(self) -> jnp.ndarray:
+        """(P*mb, Q*nb) padded global array of the un-op'd storage.
+
+        The workhorse of the single-chip "global path": one reshape away
+        from the tile array, so XLA sees full-size MXU-friendly operands.
+        """
+        lay = self.layout
+        Tn = self.data[lay.row_scatter][:, lay.col_scatter]
+        return Tn.transpose(0, 2, 1, 3).reshape(lay.P * lay.mb, lay.Q * lay.nb)
+
+    @classmethod
+    def _pack_padded_global(cls, A_pad, layout, grid=None, **kw):
+        T = A_pad.reshape(layout.P, layout.mb, layout.Q, layout.nb)
+        T = T.transpose(0, 2, 1, 3)
+        T = T[layout.row_gather][:, layout.col_gather]
+        return cls(T, layout, grid=grid, **kw)
+
+    def shard(self) -> "BaseMatrix":
+        """Place the tile array on the grid's mesh with cyclic sharding."""
+        if self.grid is None or self.grid.size == 1:
+            return self
+        return self._with(data=jax.device_put(self.data, self.grid.tile_sharding()))
+
+    # -- slicing ------------------------------------------------------------
+
+    def sub(self, i1: int, i2: int, j1: int, j2: int) -> "BaseMatrix":
+        """Materialized sub-matrix of tile rows [i1, i2] x cols [j1, j2]
+        (inclusive, like the reference BaseMatrix::sub, BaseMatrix.hh:770).
+
+        Unlike the reference this copies (functional design); the returned
+        matrix is laid out on the same grid.
+        """
+        slate_assert(self.op == Op.NoTrans, "sub() requires resolved() view")
+        lay = self.layout
+        slate_assert(0 <= i1 <= i2 < lay.mt and 0 <= j1 <= j2 < lay.nt, "sub range")
+        rows = lay.row_scatter[np.arange(i1, i2 + 1)]
+        cols = lay.col_scatter[np.arange(j1, j2 + 1)]
+        Tn = self.data[rows][:, cols]  # natural-order tile block
+        m = min(self.m - i1 * lay.mb, (i2 - i1 + 1) * lay.mb)
+        n = min(self.n - j1 * lay.nb, (j2 - j1 + 1) * lay.nb)
+        sub_lay = TileLayout(m, n, lay.mb, lay.nb, lay.p, lay.q)
+        pad_r = sub_lay.P - Tn.shape[0]
+        pad_c = sub_lay.Q - Tn.shape[1]
+        Tn = jnp.pad(Tn, ((0, pad_r), (0, pad_c), (0, 0), (0, 0)))
+        Ts = Tn[sub_lay.row_gather][:, sub_lay.col_gather]
+        return self._with(data=Ts, layout=sub_lay)
+
+    def __repr__(self):
+        return (
+            f"{type(self).__name__}({self.m}x{self.n}, tiles {self.mb}x{self.nb}, "
+            f"grid {self.layout.p}x{self.layout.q}, op={self.op.name}, "
+            f"dtype={self.dtype})"
+        )
+
+
+jax.tree_util.register_pytree_node_class(BaseMatrix)
+
+
+def transpose(A: BaseMatrix) -> BaseMatrix:
+    """O(1) transposed view (reference: slate::transpose, BaseMatrix.hh)."""
+    new_op = {Op.NoTrans: Op.Trans, Op.Trans: Op.NoTrans, Op.ConjTrans: Op.NoTrans}[A.op]
+    if A.op == Op.ConjTrans and A.is_complex:
+        # transpose(conj_transpose(A)) = conj(A): materialize the conj
+        out = A._with(data=jnp.conj(A.data), op=Op.NoTrans)
+        return out
+    return A._with(op=new_op)
+
+
+def conj_transpose(A: BaseMatrix) -> BaseMatrix:
+    new_op = {Op.NoTrans: Op.ConjTrans, Op.ConjTrans: Op.NoTrans, Op.Trans: Op.NoTrans}[A.op]
+    if A.op == Op.Trans and A.is_complex:
+        out = A._with(data=jnp.conj(A.data), op=Op.NoTrans)
+        return out
+    return A._with(op=new_op)

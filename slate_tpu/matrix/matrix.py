@@ -1,0 +1,223 @@
+"""Concrete matrix classes (reference: include/slate/Matrix.hh,
+TrapezoidMatrix.hh, TriangularMatrix.hh, SymmetricMatrix.hh,
+HermitianMatrix.hh, BandMatrix.hh, TriangularBandMatrix.hh,
+HermitianBandMatrix.hh).
+
+All kinds share the full (P, Q, mb, nb) tile-grid storage; triangular /
+symmetric / Hermitian kinds logically reference one triangle and carry
+masks for it.  The reference instead stores only the referenced triangle's
+tiles (BaseTrapezoidMatrix.hh); on TPU uniform dense storage wins — static
+shapes, no per-tile map, and XLA DCEs whatever a routine doesn't touch.
+Band kinds add (kl, ku) bandwidth metadata; out-of-band tiles are
+zero and masked, matching BandMatrix.hh semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import Diag, Op, Uplo
+from ..exceptions import slate_assert
+from ..parallel.grid import ProcessGrid, default_grid
+from ..parallel.layout import TileLayout, tiles_from_global
+from .base import BaseMatrix, conj_transpose, transpose  # noqa: F401 (re-export)
+
+
+def _make_layout(m, n, mb, nb, grid: Optional[ProcessGrid]) -> TileLayout:
+    if grid is None:
+        return TileLayout(m, n, mb, nb, 1, 1)
+    return TileLayout(m, n, mb, nb, grid.p, grid.q)
+
+
+class Matrix(BaseMatrix):
+    """General m x n matrix (reference: Matrix.hh)."""
+
+    @staticmethod
+    def from_global(
+        A, mb: int, nb: Optional[int] = None, grid: Optional[ProcessGrid] = None
+    ) -> "Matrix":
+        """Build from a host/device (m, n) array — the TPU-native analogue
+        of Matrix::fromLAPACK (Matrix.hh:58): tile + distribute."""
+        nb = nb if nb is not None else mb
+        A = jnp.asarray(A)
+        m, n = A.shape
+        layout = _make_layout(m, n, mb, nb, grid)
+        T = tiles_from_global(A, layout)
+        return Matrix(T, layout, grid=grid).shard()
+
+    @staticmethod
+    def zeros(
+        m: int,
+        n: int,
+        mb: int,
+        nb: Optional[int] = None,
+        dtype=jnp.float32,
+        grid: Optional[ProcessGrid] = None,
+    ) -> "Matrix":
+        nb = nb if nb is not None else mb
+        layout = _make_layout(m, n, mb, nb, grid)
+        return Matrix(jnp.zeros(layout.storage_shape, dtype), layout, grid=grid).shard()
+
+    def emptyLike(self, dtype=None) -> "Matrix":
+        dt = dtype or self.dtype
+        return Matrix(jnp.zeros_like(self.data, dtype=dt), self.layout, grid=self.grid)
+
+
+class BaseTrapezoidMatrix(BaseMatrix):
+    """Upper/lower trapezoid storage semantics (reference:
+    BaseTrapezoidMatrix.hh)."""
+
+    def __init__(self, data, layout, grid=None, op=Op.NoTrans,
+                 uplo=Uplo.Lower, diag=Diag.NonUnit):
+        super().__init__(data, layout, grid=grid, op=op)
+        self.uplo = uplo
+        self.diag = diag
+
+    @classmethod
+    def from_global(cls, A, mb, nb=None, grid=None, uplo=Uplo.Lower,
+                    diag=Diag.NonUnit):
+        nb = nb if nb is not None else mb
+        A = jnp.asarray(A)
+        m, n = A.shape
+        layout = _make_layout(m, n, mb, nb, grid)
+        T = tiles_from_global(A, layout)
+        return cls(T, layout, grid=grid, uplo=uplo, diag=diag).shard()
+
+    def tri_mask(self) -> jnp.ndarray:
+        """(P, Q, mb, nb) bool mask of the referenced triangle's elements
+        (valid region only), honoring Diag.Unit exclusion of the diagonal."""
+        lay = self.layout
+        gr = jnp.asarray(lay.global_rows_np)[:, None, :, None]
+        gc = jnp.asarray(lay.global_cols_np)[None, :, None, :]
+        if self.uplo == Uplo.Lower:
+            mask = gr >= gc if self.diag == Diag.NonUnit else gr > gc
+        elif self.uplo == Uplo.Upper:
+            mask = gr <= gc if self.diag == Diag.NonUnit else gr < gc
+        else:
+            mask = jnp.ones_like(gr, dtype=bool) != False  # noqa: E712
+        return mask & lay.element_mask()
+
+
+class TrapezoidMatrix(BaseTrapezoidMatrix):
+    """m x n trapezoid (reference: TrapezoidMatrix.hh)."""
+
+
+class TriangularMatrix(BaseTrapezoidMatrix):
+    """Square triangular (reference: TriangularMatrix.hh)."""
+
+    @classmethod
+    def from_global(cls, A, mb, nb=None, grid=None, uplo=Uplo.Lower,
+                    diag=Diag.NonUnit):
+        A = jnp.asarray(A)
+        slate_assert(A.shape[0] == A.shape[1], "TriangularMatrix must be square")
+        return super().from_global(A, mb, nb, grid, uplo, diag)
+
+
+class SymmetricMatrix(BaseTrapezoidMatrix):
+    """Symmetric, one triangle referenced (reference: SymmetricMatrix.hh)."""
+
+    def __init__(self, data, layout, grid=None, op=Op.NoTrans,
+                 uplo=Uplo.Lower, diag=Diag.NonUnit):
+        super().__init__(data, layout, grid=grid, op=op, uplo=uplo, diag=Diag.NonUnit)
+
+    def full_global(self) -> jnp.ndarray:
+        """Materialize the full symmetric matrix from the stored triangle."""
+        A = self.to_global()
+        lay = self.layout
+        i = np.arange(lay.m)[:, None]
+        j = np.arange(lay.n)[None, :]
+        keep = (i >= j) if self.uplo == Uplo.Lower else (i <= j)
+        Ak = jnp.where(jnp.asarray(keep), A, 0)
+        diag_part = jnp.diag(jnp.diag(Ak))
+        return Ak + Ak.T - diag_part
+
+
+class HermitianMatrix(SymmetricMatrix):
+    """Hermitian, one triangle referenced (reference: HermitianMatrix.hh)."""
+
+    def full_global(self) -> jnp.ndarray:
+        A = self.to_global()
+        lay = self.layout
+        i = np.arange(lay.m)[:, None]
+        j = np.arange(lay.n)[None, :]
+        keep = (i >= j) if self.uplo == Uplo.Lower else (i <= j)
+        Ak = jnp.where(jnp.asarray(keep), A, 0)
+        diag_part = jnp.diag(jnp.real(jnp.diag(Ak)).astype(A.dtype))
+        return Ak + jnp.conj(Ak).T - diag_part
+
+
+# ---------------------------------------------------------------------------
+# Band kinds (reference: BandMatrix.hh, TriangularBandMatrix.hh,
+# HermitianBandMatrix.hh).  Dense tile storage + bandwidth metadata; tiles
+# wholly outside the band are zero.  he2hbGather/ge2tbGather analogues live
+# in drivers/eig.py and drivers/svd.py.
+# ---------------------------------------------------------------------------
+
+
+class BandMatrix(Matrix):
+    """General band matrix with lower/upper bandwidth (kl, ku)."""
+
+    def __init__(self, data, layout, grid=None, op=Op.NoTrans, kl=0, ku=0):
+        super().__init__(data, layout, grid=grid, op=op)
+        self.kl = kl
+        self.ku = ku
+
+    def tree_flatten(self):
+        children, aux = super().tree_flatten()
+        return children, aux + (self.kl, self.ku)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = super().tree_unflatten(aux[:-2], children)
+        obj.kl, obj.ku = aux[-2], aux[-1]
+        return obj
+
+    @staticmethod
+    def from_global(A, kl, ku, mb, nb=None, grid=None):
+        nb = nb if nb is not None else mb
+        A = jnp.asarray(A)
+        m, n = A.shape
+        i = np.arange(m)[:, None]
+        j = np.arange(n)[None, :]
+        band = (j - i <= ku) & (i - j <= kl)
+        A = jnp.where(jnp.asarray(band), A, 0)
+        layout = _make_layout(m, n, mb, nb, grid)
+        T = tiles_from_global(A, layout)
+        return BandMatrix(T, layout, grid=grid, kl=kl, ku=ku).shard()
+
+    def band_mask(self) -> jnp.ndarray:
+        lay = self.layout
+        gr = jnp.asarray(lay.global_rows_np)[:, None, :, None]
+        gc = jnp.asarray(lay.global_cols_np)[None, :, None, :]
+        band = ((gc - gr) <= self.ku) & ((gr - gc) <= self.kl)
+        return band & lay.element_mask()
+
+
+class TriangularBandMatrix(BandMatrix):
+    """Triangular band (reference: TriangularBandMatrix.hh)."""
+
+    def __init__(self, data, layout, grid=None, op=Op.NoTrans, kd=0,
+                 uplo=Uplo.Lower, diag=Diag.NonUnit):
+        kl, ku = (kd, 0) if uplo == Uplo.Lower else (0, kd)
+        super().__init__(data, layout, grid=grid, op=op, kl=kl, ku=ku)
+        self.uplo = uplo
+        self.diag = diag
+        self.kd = kd
+
+    def tree_flatten(self):
+        children, aux = super().tree_flatten()
+        return children, aux + (self.kd,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = BaseMatrix.tree_unflatten.__func__(cls, aux[:-3], children)
+        obj.kl, obj.ku, obj.kd = aux[-3], aux[-2], aux[-1]
+        return obj
+
+
+class HermitianBandMatrix(TriangularBandMatrix):
+    """Hermitian band, one triangle stored (reference: HermitianBandMatrix.hh)."""

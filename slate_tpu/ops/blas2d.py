@@ -1,0 +1,94 @@
+"""2D-level BLAS kernels on (padded) global arrays — the "global path".
+
+These are the L0/L1 vendor-kernel layer of the TPU build (reference
+analogue: blaspp/vendor BLAS called per tile, Tile_blas.hh:19-941).  On a
+single chip the best schedule for a tiled BLAS3 op is simply the one big
+XLA op — the MXU gets maximal tile sizes and XLA fuses the epilogue — so
+drivers route here whenever the matrix lives on one device, and internals
+reuse these for panel-sized subproblems.
+
+All functions take/return plain jnp arrays.  Padding conventions: operands
+are zero-padded (products unaffected); triangular solves require the
+padding diagonal spliced to 1 (see layout.eye_splice) so the padded system
+stays nonsingular.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..enums import Diag, Op, Side, Uplo
+
+
+def apply_op(A: jnp.ndarray, op: Op) -> jnp.ndarray:
+    if op == Op.Trans:
+        return A.T
+    if op == Op.ConjTrans:
+        return jnp.conj(A).T if jnp.issubdtype(A.dtype, jnp.complexfloating) else A.T
+    return A
+
+
+def gemm2d(alpha, A, B, beta, C):
+    """C = alpha A B + beta C (reference: tile::gemm, Tile_blas.hh:30)."""
+    acc = jnp.promote_types(A.dtype, jnp.float32)
+    out = alpha * jnp.matmul(A, B, preferred_element_type=acc) + beta * C
+    return out.astype(C.dtype)
+
+
+def syrk2d(alpha, A, beta, C):
+    """C = alpha A A^T + beta C (reference: tile::syrk, Tile_blas.hh:523)."""
+    return gemm2d(alpha, A, A.T, beta, C)
+
+
+def herk2d(alpha, A, beta, C):
+    """C = alpha A A^H + beta C (reference: tile::herk)."""
+    AH = jnp.conj(A).T if jnp.issubdtype(A.dtype, jnp.complexfloating) else A.T
+    return gemm2d(alpha, A, AH, beta, C)
+
+
+def syr2k2d(alpha, A, B, beta, C):
+    return gemm2d(alpha, A, B.T, 1, gemm2d(alpha, B, A.T, beta, C))
+
+
+def her2k2d(alpha, A, B, beta, C):
+    conj = jnp.issubdtype(A.dtype, jnp.complexfloating)
+    BH = jnp.conj(B).T if conj else B.T
+    AH = jnp.conj(A).T if conj else A.T
+    alpha_c = jnp.conj(alpha) if conj else alpha
+    return gemm2d(alpha, A, BH, 1, gemm2d(alpha_c, B, AH, beta, C))
+
+
+def _tri_take(A, uplo: Uplo, diag: Diag):
+    """Materialize the referenced triangle of A (unit diag -> ones)."""
+    T = jnp.tril(A) if uplo == Uplo.Lower else jnp.triu(A)
+    if diag == Diag.Unit:
+        n = A.shape[0]
+        eye = jnp.eye(n, dtype=A.dtype)
+        strict = T - jnp.diag(jnp.diag(T))
+        T = strict + eye
+    return T
+
+
+def trmm2d(side: Side, uplo: Uplo, op: Op, diag: Diag, alpha, A, B):
+    """B = alpha op(T(A)) B or alpha B op(T(A)) (reference: tile::trmm)."""
+    T = apply_op(_tri_take(A, uplo, diag), op)
+    if side == Side.Left:
+        return alpha * jnp.matmul(T, B)
+    return alpha * jnp.matmul(B, T)
+
+
+def trsm2d(side: Side, uplo: Uplo, op: Op, diag: Diag, alpha, A, B):
+    """Solve op(T(A)) X = alpha B (or right variant)
+    (reference: tile::trsm, Tile_blas.hh:682) via XLA triangular_solve."""
+    conj = op == Op.ConjTrans and jnp.issubdtype(A.dtype, jnp.complexfloating)
+    X = lax.linalg.triangular_solve(
+        A,
+        alpha * B,
+        left_side=(side == Side.Left),
+        lower=(uplo == Uplo.Lower),
+        transpose_a=(op != Op.NoTrans),
+        conjugate_a=conj,
+        unit_diagonal=(diag == Diag.Unit),
+    )
+    return X

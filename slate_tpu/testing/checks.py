@@ -1,0 +1,75 @@
+"""Norm-based residual checks — the tester's acceptance criteria.
+
+Reproduces the reference tester's norm-scaled residual bounds (reference:
+test/test_gemm.cc:192-207: ||C - C_ref|| / (sqrt(k) |alpha| ||A|| ||B|| +
+2 |beta| ||C0||) <= 3 eps; analogous scalings per routine in
+test/test_*.cc).  All checks are computed in the working precision's
+epsilon, in f64 arithmetic for the norms themselves.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def eps_of(dtype) -> float:
+    dt = np.dtype(dtype)
+    if dt.kind == "c":
+        dt = np.dtype("f4") if dt == np.complex64 else np.dtype("f8")
+    return float(np.finfo(dt).eps)
+
+
+def _norm1(X) -> float:
+    X = np.asarray(X)
+    if X.ndim == 1:
+        return float(np.abs(X).sum())
+    return float(np.abs(X).sum(axis=0).max())
+
+
+def gemm_residual(C_test, C_ref, alpha, A, B, beta, C0) -> float:
+    """Scaled gemm residual (test_gemm.cc:192-207)."""
+    k = np.asarray(A).shape[1]
+    denom = (
+        np.sqrt(float(k)) * abs(alpha) * _norm1(A) * _norm1(B)
+        + 2 * abs(beta) * _norm1(C0)
+    )
+    denom = max(denom, np.finfo(np.float64).tiny)
+    return _norm1(np.asarray(C_test) - np.asarray(C_ref)) / denom
+
+
+def solve_residual(A, X, B) -> float:
+    """||B - A X|| / (||A|| ||X|| n) — the standard backward-error check
+    used by the factorization testers (test_gesv.cc, test_posv.cc)."""
+    A, X, B = map(np.asarray, (A, X, B))
+    n = A.shape[1]
+    R = B - A @ X
+    denom = max(_norm1(A) * _norm1(X) * n, np.finfo(np.float64).tiny)
+    return _norm1(R) / denom
+
+
+def factor_residual(A, L, U=None, P=None) -> float:
+    """||A - P L U|| / (||A|| n) for LU; ||A - L L^H|| / (||A|| n) for
+    Cholesky when U is None (test_getrf/test_potrf semantics)."""
+    A, L = np.asarray(A), np.asarray(L)
+    n = A.shape[0]
+    if U is None:
+        Rec = L @ np.conj(L.T)
+    else:
+        Rec = L @ np.asarray(U)
+        if P is not None:
+            Rec = np.asarray(P) @ Rec
+    denom = max(_norm1(A) * n, np.finfo(np.float64).tiny)
+    return _norm1(A - Rec) / denom
+
+
+def ortho_residual(Q) -> float:
+    """||Q^H Q - I|| / n — orthogonality check (test_geqrf.cc)."""
+    Q = np.asarray(Q)
+    n = Q.shape[1]
+    I = np.eye(n, dtype=Q.dtype)
+    return _norm1(np.conj(Q.T) @ Q - I) / n
+
+
+def passed(error: float, dtype, factor: float = 3.0) -> bool:
+    """Acceptance: error <= factor * eps (test_gemm.cc:207)."""
+    return bool(error <= factor * eps_of(dtype))

@@ -1,0 +1,195 @@
+"""Aux driver tests: add/copy/scale/set/norms/redistribute
+(reference: unit-test analogues test_geadd/gescale/geset + test/test_*norm*)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import aux
+from slate_tpu.enums import Diag, Norm, NormScope, Uplo
+from slate_tpu.matrix.matrix import (
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TrapezoidMatrix,
+    TriangularMatrix,
+)
+
+
+def _mk(rng, m, n, dtype=np.float64):
+    A = rng.standard_normal((m, n))
+    if np.dtype(dtype).kind == "c":
+        A = A + 1j * rng.standard_normal((m, n))
+    return A.astype(dtype)
+
+
+def test_add(rng):
+    A0, B0 = _mk(rng, 50, 30), _mk(rng, 50, 30)
+    A, B = Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    B2 = aux.add(2.0, A, -1.0, B)
+    np.testing.assert_allclose(np.asarray(B2.to_global()), 2 * A0 - B0, atol=1e-14)
+
+
+def test_add_triangular_masked(rng):
+    A0, B0 = _mk(rng, 32, 32), _mk(rng, 32, 32)
+    A = TriangularMatrix.from_global(A0, 8, uplo=Uplo.Lower)
+    B = TriangularMatrix.from_global(B0, 8, uplo=Uplo.Lower)
+    B2 = aux.add(1.0, A, 1.0, B)
+    G = np.asarray(B2.to_global())
+    np.testing.assert_allclose(np.tril(G), np.tril(A0 + B0), atol=1e-14)
+    # upper (unreferenced) triangle untouched
+    np.testing.assert_allclose(np.triu(G, 1), np.triu(B0, 1), atol=1e-14)
+
+
+def test_copy_precision(rng):
+    A0 = _mk(rng, 20, 20)
+    A = Matrix.from_global(A0, 8)
+    B = Matrix.zeros(20, 20, 8, dtype=np.float32)
+    B2 = aux.copy(A, B)
+    assert B2.dtype == np.float32
+    np.testing.assert_allclose(np.asarray(B2.to_global()), A0.astype(np.float32))
+
+
+def test_scale_set(rng):
+    A0 = _mk(rng, 24, 24)
+    A = Matrix.from_global(A0, 8)
+    A2 = aux.scale(3.0, 2.0, A)
+    np.testing.assert_allclose(np.asarray(A2.to_global()), A0 * 1.5, atol=1e-14)
+    A3 = aux.set(0.0, 1.0, A)
+    np.testing.assert_array_equal(np.asarray(A3.to_global()), np.eye(24))
+
+
+def test_scale_row_col(rng):
+    A0 = _mk(rng, 12, 10)
+    R = np.arange(1.0, 13.0)
+    C = np.arange(1.0, 11.0)
+    A = Matrix.from_global(A0, 4)
+    A2 = aux.scale_row_col(R, C, A)
+    np.testing.assert_allclose(
+        np.asarray(A2.to_global()), np.diag(R) @ A0 @ np.diag(C), atol=1e-12
+    )
+
+
+def test_set_lambdas():
+    import jax.numpy as jnp
+
+    A = Matrix.zeros(10, 10, 4, dtype=np.float64)
+    A2 = aux.set_lambdas(lambda i, j: (i + 10 * j).astype(jnp.float64), A)
+    i, j = np.meshgrid(np.arange(10), np.arange(10), indexing="ij")
+    np.testing.assert_array_equal(np.asarray(A2.to_global()), i + 10 * j)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Inf, Norm.Fro])
+@pytest.mark.parametrize("shape", [(40, 30), (13, 57)])
+def test_genorm(rng, norm_t, shape):
+    A0 = _mk(rng, *shape)
+    A = Matrix.from_global(A0, 16)
+    got = float(aux.norm(norm_t, A))
+    ref = {
+        Norm.Max: np.abs(A0).max(),
+        Norm.One: np.abs(A0).sum(axis=0).max(),
+        Norm.Inf: np.abs(A0).sum(axis=1).max(),
+        Norm.Fro: np.linalg.norm(A0, "fro"),
+    }[norm_t]
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+def test_genorm_scopes(rng):
+    A0 = _mk(rng, 20, 12)
+    A = Matrix.from_global(A0, 8)
+    cols = np.asarray(aux.norm(Norm.One, A, scope=NormScope.Columns))
+    np.testing.assert_allclose(cols, np.abs(A0).sum(axis=0), rtol=1e-14)
+    rows = np.asarray(aux.norm(Norm.Inf, A, scope=NormScope.Rows))
+    np.testing.assert_allclose(rows, np.abs(A0).sum(axis=1), rtol=1e-14)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Inf, Norm.Fro])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_synorm(rng, norm_t, uplo):
+    S0 = _mk(rng, 30, 30)
+    S0 = S0 + S0.T
+    S = SymmetricMatrix.from_global(S0, 8, uplo=uplo)
+    got = float(aux.norm(norm_t, S))
+    ref = {
+        Norm.Max: np.abs(S0).max(),
+        Norm.One: np.abs(S0).sum(axis=0).max(),
+        Norm.Inf: np.abs(S0).sum(axis=1).max(),
+        Norm.Fro: np.linalg.norm(S0, "fro"),
+    }[norm_t]
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Fro])
+def test_henorm_complex(rng, norm_t):
+    H0 = _mk(rng, 24, 24, np.complex128)
+    H0 = H0 + H0.conj().T
+    H = HermitianMatrix.from_global(H0, 8, uplo=Uplo.Lower)
+    got = float(aux.norm(norm_t, H))
+    ref = {
+        Norm.Max: np.abs(H0).max(),
+        Norm.One: np.abs(H0).sum(axis=0).max(),
+        Norm.Fro: np.linalg.norm(H0, "fro"),
+    }[norm_t]
+    np.testing.assert_allclose(got, ref, rtol=1e-13)
+
+
+@pytest.mark.parametrize("diag", [Diag.NonUnit, Diag.Unit])
+def test_trnorm(rng, diag):
+    T0 = np.tril(_mk(rng, 20, 20))
+    T = TriangularMatrix.from_global(T0, 8, uplo=Uplo.Lower, diag=diag)
+    ref_mat = T0.copy()
+    if diag == Diag.Unit:
+        np.fill_diagonal(ref_mat, 1.0)
+    got = float(aux.norm(Norm.One, T))
+    np.testing.assert_allclose(got, np.abs(ref_mat).sum(axis=0).max(), rtol=1e-13)
+
+
+def test_norm_distributed_matches(rng, grid22):
+    A0 = _mk(rng, 64, 64)
+    A_s = Matrix.from_global(A0, 16)
+    A_d = Matrix.from_global(A0, 16, grid=grid22)
+    for nt in (Norm.Max, Norm.One, Norm.Inf, Norm.Fro):
+        np.testing.assert_allclose(
+            float(aux.norm(nt, A_d)), float(aux.norm(nt, A_s)), rtol=1e-14
+        )
+
+
+def test_redistribute(rng, grid22):
+    A0 = _mk(rng, 48, 48)
+    A = Matrix.from_global(A0, 16)  # single
+    B = Matrix.zeros(48, 48, 8, grid=grid22, dtype=np.float64)
+    B2 = aux.redistribute(A, B)
+    np.testing.assert_array_equal(np.asarray(B2.to_global()), A0)
+    assert B2.layout.p == 2
+
+
+def test_print_matrix(rng):
+    A0 = _mk(rng, 8, 8)
+    A = Matrix.from_global(A0, 4)
+    text = aux.print_matrix("A", A, verbose=4)
+    assert "A = [" in text and "8x8" in text
+    assert aux.print_matrix("A", A, verbose=1).startswith("% A")
+    assert aux.print_matrix("A", A, verbose=0) == ""
+
+
+def test_transpose_views(rng):
+    from slate_tpu.matrix.base import conj_transpose, transpose
+
+    A0 = _mk(rng, 30, 20, np.complex128)
+    A = Matrix.from_global(A0, 8)
+    At = transpose(A)
+    assert (At.m, At.n) == (20, 30)
+    np.testing.assert_array_equal(np.asarray(At.to_global()), A0.T)
+    Ah = conj_transpose(A)
+    np.testing.assert_array_equal(np.asarray(Ah.to_global()), A0.conj().T)
+    # resolved() materializes
+    Ar = At.resolved()
+    np.testing.assert_allclose(np.asarray(Ar.to_global()), A0.T)
+
+
+def test_sub(rng):
+    A0 = _mk(rng, 64, 64)
+    A = Matrix.from_global(A0, 8)
+    S = A.sub(2, 4, 1, 3)  # tile rows 2-4, cols 1-3
+    np.testing.assert_array_equal(
+        np.asarray(S.to_global()), A0[16:40, 8:32]
+    )

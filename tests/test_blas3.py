@@ -1,0 +1,195 @@
+"""BLAS3 driver tests vs numpy references with the reference tester's
+norm-based acceptance (residual <= 3 eps; test_gemm.cc:192-207)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import blas3
+from slate_tpu.enums import Diag, MethodGemm, Op, Option, Side, Uplo
+from slate_tpu.matrix.matrix import (
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TriangularMatrix,
+)
+from slate_tpu.matrix.base import conj_transpose, transpose
+from slate_tpu.testing import checks
+
+
+def _mk(rng, m, n, dtype=np.float64):
+    A = rng.standard_normal((m, n))
+    if np.dtype(dtype).kind == "c":
+        A = A + 1j * rng.standard_normal((m, n))
+    return A.astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex128])
+@pytest.mark.parametrize("mnk", [(64, 48, 32), (100, 70, 50), (17, 19, 23)])
+def test_gemm_single(rng, dtype, mnk):
+    m, n, k = mnk
+    A0, B0, C0 = _mk(rng, m, k, dtype), _mk(rng, k, n, dtype), _mk(rng, m, n, dtype)
+    alpha, beta = 2.5, -0.5
+    A = Matrix.from_global(A0, 16)
+    B = Matrix.from_global(B0, 16)
+    C = Matrix.from_global(C0, 16)
+    C2 = blas3.gemm(alpha, A, B, beta, C)
+    ref = alpha * A0 @ B0 + beta * C0
+    err = checks.gemm_residual(np.asarray(C2.to_global()), ref, alpha, A0, B0, beta, C0)
+    assert checks.passed(err, dtype), err
+
+
+@pytest.mark.parametrize("opA", [Op.NoTrans, Op.Trans, Op.ConjTrans])
+@pytest.mark.parametrize("opB", [Op.NoTrans, Op.Trans])
+def test_gemm_ops(rng, opA, opB):
+    m, n, k = 40, 30, 20
+    dtype = np.complex128
+    A0 = _mk(rng, m, k, dtype)
+    B0 = _mk(rng, k, n, dtype)
+    C0 = _mk(rng, m, n, dtype)
+    Aop = {Op.NoTrans: lambda x: x, Op.Trans: lambda x: x.T, Op.ConjTrans: lambda x: x.conj().T}
+    A = Matrix.from_global(Aop[opA](A0), 8)
+    B = Matrix.from_global(Bop := Aop[opB](B0), 8)
+    if opA == Op.Trans:
+        A = transpose(A)
+    elif opA == Op.ConjTrans:
+        A = conj_transpose(A)
+    if opB == Op.Trans:
+        B = transpose(B)
+    C = Matrix.from_global(C0, 8)
+    C2 = blas3.gemm(1.0, A, B, 0.0, C)
+    ref = A0 @ B0
+    err = checks.gemm_residual(np.asarray(C2.to_global()), ref, 1.0, A0, B0, 0.0, C0)
+    assert checks.passed(err, dtype), (opA, opB, err)
+
+
+@pytest.mark.parametrize("method", [MethodGemm.C, MethodGemm.A])
+@pytest.mark.parametrize("mnk", [(96, 96, 96), (80, 48, 64)])
+def test_gemm_distributed(rng, grid22, method, mnk):
+    m, n, k = mnk
+    dtype = np.float64
+    A0, B0, C0 = _mk(rng, m, k, dtype), _mk(rng, k, n, dtype), _mk(rng, m, n, dtype)
+    A = Matrix.from_global(A0, 16, grid=grid22)
+    B = Matrix.from_global(B0, 16, grid=grid22)
+    C = Matrix.from_global(C0, 16, grid=grid22)
+    C2 = blas3.gemm(1.5, A, B, 0.5, C, opts={Option.MethodGemm: method})
+    ref = 1.5 * A0 @ B0 + 0.5 * C0
+    err = checks.gemm_residual(np.asarray(C2.to_global()), ref, 1.5, A0, B0, 0.5, C0)
+    assert checks.passed(err, dtype), (method, err)
+    # distribution must be preserved
+    assert C2.layout == C.layout
+
+
+def test_gemm_distributed_4x2(rng, grid42):
+    m, n, k = 64, 64, 96
+    A0, B0, C0 = _mk(rng, m, k), _mk(rng, k, n), _mk(rng, m, n)
+    A = Matrix.from_global(A0, 8, grid=grid42)
+    B = Matrix.from_global(B0, 8, grid=grid42)
+    C = Matrix.from_global(C0, 8, grid=grid42)
+    C2 = blas3.gemm(1.0, A, B, 0.0, C)
+    err = checks.gemm_residual(np.asarray(C2.to_global()), A0 @ B0, 1.0, A0, B0, 0.0, C0)
+    assert checks.passed(err, np.float64), err
+
+
+def test_symm_hemm(rng):
+    n, m = 48, 48
+    S0 = _mk(rng, n, n)
+    S0 = (S0 + S0.T) / 2
+    B0, C0 = _mk(rng, n, m), _mk(rng, n, m)
+    S = SymmetricMatrix.from_global(S0, 16, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, 16)
+    C = Matrix.from_global(C0, 16)
+    C2 = blas3.symm(Side.Left, 2.0, S, B, 1.0, C)
+    ref = 2.0 * S0 @ B0 + C0
+    assert checks.passed(
+        checks.gemm_residual(np.asarray(C2.to_global()), ref, 2.0, S0, B0, 1.0, C0),
+        np.float64,
+    )
+    # hemm with complex Hermitian
+    H0 = _mk(rng, n, n, np.complex128)
+    H0 = (H0 + H0.conj().T) / 2
+    H = HermitianMatrix.from_global(H0, 16, uplo=Uplo.Upper)
+    Bc = Matrix.from_global(B0.astype(np.complex128), 16)
+    Cc = Matrix.from_global(C0.astype(np.complex128), 16)
+    C3 = blas3.hemm(Side.Right, 1.0, H, Bc, 0.0, Cc)
+    # note: Side.Right: C = B H
+    refh = B0.astype(np.complex128) @ H0
+    assert checks.passed(
+        checks.gemm_residual(np.asarray(C3.to_global()), refh, 1.0, B0, H0, 0.0, C0),
+        np.complex128,
+    )
+
+
+def test_syrk_herk(rng):
+    n, k = 40, 24
+    A0 = _mk(rng, n, k)
+    C0 = _mk(rng, n, n)
+    C0 = (C0 + C0.T) / 2
+    A = Matrix.from_global(A0, 8)
+    C = SymmetricMatrix.from_global(C0, 8, uplo=Uplo.Lower)
+    C2 = blas3.syrk(1.0, A, 0.5, C)
+    ref = A0 @ A0.T + 0.5 * C0
+    err = checks.gemm_residual(np.asarray(C2.to_global()), ref, 1.0, A0, A0.T, 0.5, C0)
+    assert checks.passed(err, np.float64)
+
+    Az = _mk(rng, n, k, np.complex128)
+    Cz = _mk(rng, n, n, np.complex128)
+    Cz = (Cz + Cz.conj().T) / 2
+    Ch = HermitianMatrix.from_global(Cz, 8, uplo=Uplo.Lower)
+    C3 = blas3.herk(1.0, Matrix.from_global(Az, 8), 1.0, Ch)
+    refh = Az @ Az.conj().T + Cz
+    err = checks.gemm_residual(np.asarray(C3.to_global()), refh, 1.0, Az, Az.conj().T, 1.0, Cz)
+    assert checks.passed(err, np.complex128)
+    # result must be Hermitian
+    G = np.asarray(C3.to_global())
+    np.testing.assert_allclose(G, G.conj().T, atol=1e-12)
+
+
+def test_syr2k_her2k(rng):
+    n, k = 32, 16
+    A0, B0 = _mk(rng, n, k), _mk(rng, n, k)
+    C0 = _mk(rng, n, n)
+    C0 = (C0 + C0.T) / 2
+    C = SymmetricMatrix.from_global(C0, 8, uplo=Uplo.Upper)
+    C2 = blas3.syr2k(1.0, Matrix.from_global(A0, 8), Matrix.from_global(B0, 8), 1.0, C)
+    ref = A0 @ B0.T + B0 @ A0.T + C0
+    assert np.allclose(np.asarray(C2.to_global()), ref, atol=1e-10)
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans])
+def test_trsm_trmm(rng, side, uplo, op):
+    n, m = 48, 32
+    dim = n if side == Side.Left else m
+    T0 = _mk(rng, dim, dim)
+    T0 = np.tril(T0) if uplo == Uplo.Lower else np.triu(T0)
+    T0 += np.eye(dim) * dim  # well-conditioned
+    B0 = _mk(rng, n, m)
+    T = TriangularMatrix.from_global(T0, 16, uplo=uplo)
+    if op == Op.Trans:
+        T = transpose(T)
+    B = Matrix.from_global(B0, 16)
+    X = blas3.trsm(side, 1.0, T, B)
+    Topd = T0.T if op == Op.Trans else T0
+    Xg = np.asarray(X.to_global())
+    if side == Side.Left:
+        resid = checks.solve_residual(Topd, Xg, B0)
+    else:
+        resid = checks.solve_residual(Topd.T, Xg.T, B0.T)
+    assert checks.passed(resid, np.float64, factor=30), resid
+    # trmm inverts trsm
+    B2 = blas3.trmm(side, 1.0, T, X)
+    np.testing.assert_allclose(np.asarray(B2.to_global()), B0, rtol=1e-9, atol=1e-9)
+
+
+def test_trsm_unit_diag(rng):
+    n = 32
+    T0 = np.tril(_mk(rng, n, n), -1) + np.eye(n)
+    B0 = _mk(rng, n, 8)
+    # store garbage on the diagonal: Diag.Unit must ignore it
+    Tg = T0 + np.diag(rng.standard_normal(n))
+    T = TriangularMatrix.from_global(Tg, 8, uplo=Uplo.Lower, diag=Diag.Unit)
+    X = blas3.trsm(Side.Left, 1.0, T, Matrix.from_global(B0, 8))
+    ref = np.linalg.solve(T0, B0)
+    np.testing.assert_allclose(np.asarray(X.to_global()), ref, rtol=1e-9, atol=1e-9)
