@@ -1,0 +1,218 @@
+"""Cholesky family drivers (reference: src/potrf.cc, potrs.cc, posv.cc,
+trtri.cc, trtrm.cc, potri.cc, posv_mixed.cc, pocondest.cc).
+
+potrf is the factorization archetype (SURVEY §3.2): panel factor ->
+broadcast -> trsm -> trailing herk with lookahead.  On TPU the global path
+hands the whole blocked schedule to XLA's cholesky (single chip: optimal);
+the spmd path runs the explicit mesh algorithm in parallel/spmd_chol.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..enums import Diag, Norm, Op, Option, Side, Uplo
+from ..exceptions import DimensionError, NumericalError, slate_assert
+from ..matrix.base import BaseMatrix, conj_transpose
+from ..matrix.matrix import HermitianMatrix, Matrix, SymmetricMatrix, TriangularMatrix
+from ..options import Options, get_option
+from ..ops import blas2d
+from ..parallel import spmd_chol
+from ..parallel.layout import eye_splice, tiles_from_global
+from . import blas3
+from .aux import norm as _norm
+
+
+def _is_distributed(M: BaseMatrix) -> bool:
+    return M.grid is not None and M.grid.size > 1
+
+
+def _hermitian_full_tiles(A: HermitianMatrix) -> jnp.ndarray:
+    """Mirror the stored triangle into a full tile array (keeps sharding)."""
+    return tiles_from_global(A.full_global().astype(A.dtype), A.layout)
+
+
+def potrf(
+    A: HermitianMatrix, opts: Optional[Options] = None
+) -> Tuple[TriangularMatrix, jnp.ndarray]:
+    """Cholesky: A = L L^H (uplo Lower) or U^H U (Upper)
+    (reference: src/potrf.cc:84-209).
+
+    Returns (factor, info); info > 0 signals a non-SPD matrix, detected
+    from non-finite entries like internal::reduce_info aggregates the
+    per-rank codes (potrf.cc:208).
+    """
+    slate_assert(A.m == A.n, "potrf requires square A")
+    slate_assert(A.layout.mb == A.layout.nb, "potrf requires square tiles")
+
+    use_spmd = _is_distributed(A) and get_option(opts, Option.UseShardMap)
+    if use_spmd:
+        T = _hermitian_full_tiles(A)
+        T = eye_splice(A.layout, T)
+        Ld = spmd_chol.spmd_potrf_lower(A.grid, T, A.layout)
+        L = TriangularMatrix(Ld, A.layout, grid=A.grid, uplo=Uplo.Lower)
+    else:
+        full = A.full_global()
+        n = A.n
+        lay = A.layout
+        pad = lay.P * lay.mb - n
+        fullp = jnp.pad(full, ((0, pad), (0, pad)))
+        fullp = fullp + jnp.diag(jnp.concatenate([jnp.zeros(n), jnp.ones(pad)]).astype(A.dtype))
+        Lp = lax.linalg.cholesky(fullp)
+        L2 = Lp[:n, :n]
+        L = TriangularMatrix.from_global(L2, lay.mb, lay.nb, grid=A.grid, uplo=Uplo.Lower)
+
+    diag_ok = jnp.isfinite(
+        L.data if use_spmd else L.data
+    )
+    info = jnp.where(jnp.all(diag_ok), 0, 1).astype(jnp.int32)
+
+    if A.uplo == Uplo.Upper:
+        U = conj_transpose(L).resolved()
+        U = TriangularMatrix(U.data, U.layout, grid=A.grid, uplo=Uplo.Upper)
+        return U, info
+    return L, info
+
+
+def potrs(
+    L: TriangularMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Matrix:
+    """Solve A X = B given the Cholesky factor (reference: src/potrs.cc:
+    two trsm sweeps)."""
+    if L.uplo == Uplo.Lower:
+        Y = blas3.trsm(Side.Left, 1.0, L, B, opts)
+        X = blas3.trsm(Side.Left, 1.0, conj_transpose(L), Y, opts)
+    else:
+        Y = blas3.trsm(Side.Left, 1.0, conj_transpose(L), B, opts)
+        X = blas3.trsm(Side.Left, 1.0, L, Y, opts)
+    return X
+
+
+def posv(
+    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray]:
+    """Solve SPD A X = B (reference: src/posv.cc = potrf + potrs).
+
+    Returns (X, factor, info)."""
+    L, info = potrf(A, opts)
+    X = potrs(L, B, opts)
+    return X, L, info
+
+
+def trtri(T: TriangularMatrix, opts: Optional[Options] = None) -> TriangularMatrix:
+    """Triangular inverse (reference: src/trtri.cc) via solve vs identity."""
+    slate_assert(T.m == T.n, "trtri requires square")
+    A2 = T._with(op=Op.NoTrans).to_global()
+    eye = jnp.eye(T.m, dtype=T.dtype)
+    inv = blas2d.trsm2d(Side.Left, T.uplo, T.op, T.diag, 1.0, A2, eye)
+    out = TriangularMatrix.from_global(
+        inv, T.layout.mb, T.layout.nb, grid=T.grid, uplo=T.uplo, diag=T.diag
+    )
+    return out
+
+
+def trtrm(L: TriangularMatrix, opts: Optional[Options] = None) -> HermitianMatrix:
+    """L^H L (or U U^H) keeping the triangle — the second half of potri
+    (reference: src/trtrm.cc)."""
+    Lg = L._with(op=Op.NoTrans).to_global()
+    if L.uplo == Uplo.Lower:
+        tri = jnp.tril(Lg)
+        out = jnp.conj(tri).T @ tri if L.is_complex else tri.T @ tri
+    else:
+        tri = jnp.triu(Lg)
+        out = tri @ jnp.conj(tri).T if L.is_complex else tri @ tri.T
+    return HermitianMatrix.from_global(
+        out, L.layout.mb, L.layout.nb, grid=L.grid, uplo=L.uplo
+    )
+
+
+def potri(L: TriangularMatrix, opts: Optional[Options] = None) -> HermitianMatrix:
+    """SPD inverse from the Cholesky factor: A^-1 = L^-H L^-1
+    (reference: src/potri.cc = trtri + trtrm)."""
+    Linv = trtri(L, opts)
+    return trtrm(Linv, opts)
+
+
+def posv_mixed(
+    A: HermitianMatrix,
+    B: Matrix,
+    opts: Optional[Options] = None,
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision SPD solve: factor in low precision, iterative
+    refinement in working precision (reference: src/posv_mixed.cc; on TPU
+    the low precision is f32 — an easy win given the MXU's f32/bf16 rates,
+    SURVEY §7 step 5).
+
+    Returns (X, info, iters); iters < 0 means fallback to full precision
+    was used (Option.UseFallbackSolver, gesv_mixed_gmres.cc:100-106).
+    """
+    lo_t = np.complex64 if A.is_complex else np.float32
+    max_it = int(get_option(opts, Option.MaxIterations, 30))
+    use_fallback = bool(get_option(opts, Option.UseFallbackSolver, True))
+
+    A_full = A.full_global()
+    B2 = B.to_global()
+    n = A.n
+    eps = float(np.finfo(np.float32 if not A.is_complex else np.float32).eps)
+    # target accuracy in working precision
+    work_eps = float(jnp.finfo(B2.dtype).eps)
+    anorm = _norm(Norm.Inf, A)
+    tol = float(get_option(opts, Option.Tolerance, np.sqrt(n) * work_eps))
+
+    A_lo = A_full.astype(lo_t)
+    L_lo = lax.linalg.cholesky(A_lo)
+
+    def solve_lo(R):
+        Y = lax.linalg.triangular_solve(
+            L_lo, R.astype(lo_t), left_side=True, lower=True
+        )
+        Z = lax.linalg.triangular_solve(
+            L_lo, Y, left_side=True, lower=True, transpose_a=True,
+            conjugate_a=A.is_complex,
+        )
+        return Z.astype(B2.dtype)
+
+    X = solve_lo(B2)
+    iters = 0
+    converged = False
+    for it in range(max_it):
+        R = B2 - A_full @ X
+        rnorm = jnp.abs(R).max()
+        xnorm = jnp.abs(X).max()
+        iters = it
+        if bool(rnorm <= tol * float(anorm) * float(xnorm) + 1e-300):
+            converged = True
+            break
+        X = X + solve_lo(R)
+    if not converged and use_fallback:
+        # full-precision fallback (posv_mixed.cc fallback path)
+        Lw = lax.linalg.cholesky(A_full)
+        Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)
+        Xw = lax.linalg.triangular_solve(
+            Lw, Y, left_side=True, lower=True, transpose_a=True,
+            conjugate_a=A.is_complex,
+        )
+        X = Xw
+        iters = -max_it
+    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
+    Xm = B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
+    return Xm, info, iters
+
+
+def pocondest(
+    L: TriangularMatrix, anorm, opts: Optional[Options] = None
+):
+    """Reciprocal condition estimate from the Cholesky factor (reference:
+    src/pocondest.cc via Hager/Higham 1-norm estimation,
+    internal_norm1est.cc).  Uses the explicit-inverse 1-norm on TPU (the
+    estimator's sequential re-solves serialize badly; the inverse is one
+    triangular solve pair, MXU-friendly)."""
+    Ainv = potri(L, opts)
+    ainv_norm = _norm(Norm.One, Ainv)
+    rcond = 1.0 / (jnp.asarray(anorm) * ainv_norm)
+    return jnp.where(jnp.isfinite(rcond), rcond, 0.0)

@@ -1,0 +1,70 @@
+"""Shared value types (reference: include/slate/types.hh).
+
+The reference's Pivot{tile_index, element_offset} lists (types.hh:84-117)
+become a single global row-permutation vector on TPU: the factorization's
+net row permutation, directly applicable with one gather — the natural
+form for XLA (no per-row MPI exchanges at solve time).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Pivots:
+    """Row pivots as a forward permutation: (P A)[i] = A[perm[i]].
+
+    Length covers the padded row space; rows >= m map to themselves.
+    Reference analogue: Pivots = vector<vector<Pivot>> (types.hh:117),
+    applied by internal::permuteRows (internal_swap.cc).
+    """
+
+    perm: jnp.ndarray  # (m_pad,) int32
+
+    def tree_flatten(self):
+        return (self.perm,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def apply(self, B: jnp.ndarray) -> jnp.ndarray:
+        """B <- P B (rows permuted forward)."""
+        return B[self.perm[: B.shape[0]]]
+
+    def apply_inverse(self, B: jnp.ndarray) -> jnp.ndarray:
+        inv = jnp.zeros_like(self.perm)
+        inv = inv.at[self.perm].set(jnp.arange(self.perm.shape[0], dtype=self.perm.dtype))
+        return B[inv[: B.shape[0]]]
+
+    def to_ipiv(self) -> jnp.ndarray:
+        """Net permutation is not uniquely an ipiv sequence; exposed for
+        ScaLAPACK-shim interop where only the permutation matters."""
+        return self.perm
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class TriangularFactors:
+    """Householder panel factors for QR/LQ (reference: slate.hh
+    TriangularFactors = vector<Matrix>: Tlocal + Treduce).
+
+    On TPU: V is stored in the factored matrix's lower (upper for LQ)
+    triangle; T holds the nb x nb compact-WY block factors, one per panel,
+    stacked: (nt_panels, nb, nb).
+    """
+
+    T: jnp.ndarray  # (num_panels, nb, nb)
+
+    def tree_flatten(self):
+        return (self.T,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])

@@ -1,0 +1,194 @@
+"""LU family tests (reference: test/test_gesv.cc, test_getri.cc,
+test_gesv_mixed; norm-scaled residual acceptance)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import lu
+from slate_tpu.enums import MethodLU, Norm, Option, Uplo
+from slate_tpu.matrix.matrix import Matrix, TriangularMatrix
+from slate_tpu.testing import checks
+
+
+def _mk(rng, m, n, dtype=np.float64):
+    A = rng.standard_normal((m, n))
+    if np.dtype(dtype).kind == "c":
+        A = A + 1j * rng.standard_normal((m, n))
+    return A.astype(dtype)
+
+
+def _lu_recompose(LUg, perm, m, n):
+    L = np.tril(LUg, -1)[:, : min(m, n)] + np.eye(m, min(m, n))
+    U = np.triu(LUg)[: min(m, n), :]
+    return L @ U, perm
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb", [(64, 16), (50, 16), (33, 8)])
+def test_getrf_single(rng, dtype, n, nb):
+    A0 = _mk(rng, n, n, dtype)
+    A = Matrix.from_global(A0, nb)
+    LU, piv, info = lu.getrf(A)
+    assert int(info) == 0
+    G = np.asarray(LU.to_global())
+    rec, _ = _lu_recompose(G, piv, n, n)
+    # P A = L U  =>  A[perm] == rec
+    perm = np.asarray(piv.perm)[:n]
+    err = checks.factor_residual(A0[perm], rec, np.eye(n))
+    assert checks.passed(err, dtype, factor=30), err
+
+
+@pytest.mark.parametrize("n,nb", [(64, 16), (96, 16), (48, 8)])
+def test_getrf_distributed(rng, grid22, n, nb):
+    A0 = _mk(rng, n, n)
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    LU, piv, info = lu.getrf(A)
+    assert int(info) == 0
+    G = np.asarray(LU.to_global())
+    rec, _ = _lu_recompose(G, piv, n, n)
+    perm = np.asarray(piv.perm)[:n]
+    assert (perm < n).all(), "pivots must stay in the valid row range"
+    err = checks.factor_residual(A0[perm], rec, np.eye(n))
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_getrf_spmd_matches_lapack_pivoting(rng, grid22):
+    """Distributed pivots must genuinely pivot: make the natural diagonal
+    tiny so no-pivot LU would blow up."""
+    n, nb = 32, 8
+    A0 = _mk(rng, n, n)
+    A0[np.arange(n), np.arange(n)] = 1e-14
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    LU, piv, info = lu.getrf(A)
+    X = lu.getrs(LU, piv, Matrix.from_global(np.eye(n), nb, grid=grid22))
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), np.eye(n))
+    assert checks.passed(err, np.float64, factor=100), err
+
+
+def test_getrf_distributed_4x2(rng, grid42):
+    n, nb = 64, 8
+    A0 = _mk(rng, n, n)
+    A = Matrix.from_global(A0, nb, grid=grid42)
+    LU, piv, info = lu.getrf(A)
+    perm = np.asarray(piv.perm)[:n]
+    G = np.asarray(LU.to_global())
+    rec, _ = _lu_recompose(G, piv, n, n)
+    err = checks.factor_residual(A0[perm], rec, np.eye(n))
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_gesv(rng):
+    n, nrhs = 64, 8
+    A0 = _mk(rng, n, n)
+    B0 = _mk(rng, n, nrhs)
+    X, LU, piv, info = lu.gesv(Matrix.from_global(A0, 16), Matrix.from_global(B0, 16))
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_gesv_distributed(rng, grid22):
+    n, nrhs = 96, 16
+    A0 = _mk(rng, n, n)
+    B0 = _mk(rng, n, nrhs)
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(A0, 16, grid=grid22),
+        Matrix.from_global(B0, 16, grid=grid22),
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_getrf_nopiv(rng):
+    n = 48
+    A0 = _mk(rng, n, n) + n * np.eye(n)  # diagonally dominant: safe nopiv
+    A = Matrix.from_global(A0, 16)
+    LU, info = lu.getrf_nopiv(A)
+    assert int(info) == 0
+    G = np.asarray(LU.to_global())
+    L = np.tril(G, -1) + np.eye(n)
+    U = np.triu(G)
+    err = checks.factor_residual(A0, L, U)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_gesv_nopiv(rng):
+    n, nrhs = 32, 4
+    A0 = _mk(rng, n, n) + n * np.eye(n)
+    B0 = _mk(rng, n, nrhs)
+    X, LU, piv, info = lu.gesv_nopiv(
+        Matrix.from_global(A0, 8), Matrix.from_global(B0, 8)
+    )
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_gesv_rbt(rng):
+    n, nrhs = 40, 4
+    A0 = _mk(rng, n, n)
+    B0 = _mk(rng, n, nrhs)
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(A0, 8),
+        Matrix.from_global(B0, 8),
+        opts={Option.MethodLU: MethodLU.RBT},
+    )
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=1000), err
+
+
+def test_getri(rng):
+    n = 40
+    A0 = _mk(rng, n, n)
+    LU, piv, info = lu.getrf(Matrix.from_global(A0, 8))
+    Ainv = lu.getri(LU, piv)
+    np.testing.assert_allclose(
+        np.asarray(Ainv.to_global()) @ A0, np.eye(n), atol=1e-9
+    )
+
+
+def test_gesv_mixed(rng):
+    n, nrhs = 64, 4
+    A0 = _mk(rng, n, n) + n * np.eye(n)
+    B0 = _mk(rng, n, nrhs)
+    X, info, iters = lu.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert err < 1e-12, (err, iters)
+    assert iters >= 0
+
+
+def test_gesv_mixed_gmres(rng):
+    n, nrhs = 48, 3
+    A0 = _mk(rng, n, n) + n * np.eye(n)
+    B0 = _mk(rng, n, nrhs)
+    X, info, iters = lu.gesv_mixed_gmres(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert err < 1e-10, (err, iters)
+
+
+def test_gecondest(rng):
+    n = 32
+    A0 = _mk(rng, n, n) + n * np.eye(n)
+    A = Matrix.from_global(A0, 8)
+    from slate_tpu.drivers.aux import norm as mat_norm
+
+    anorm = mat_norm(Norm.One, A)
+    LU, piv, _ = lu.getrf(A)
+    rcond = float(lu.gecondest(LU, piv, anorm))
+    ref = 1.0 / (np.linalg.norm(A0, 1) * np.linalg.norm(np.linalg.inv(A0), 1))
+    np.testing.assert_allclose(rcond, ref, rtol=0.3)
+
+
+def test_trcondest(rng):
+    n = 32
+    T0 = np.tril(_mk(rng, n, n)) + n * np.eye(n)
+    T = TriangularMatrix.from_global(T0, 8, uplo=Uplo.Lower)
+    rcond = float(lu.trcondest(T))
+    ref = 1.0 / (np.linalg.norm(T0, 1) * np.linalg.norm(np.linalg.inv(T0), 1))
+    np.testing.assert_allclose(rcond, ref, rtol=0.3)
