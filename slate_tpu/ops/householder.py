@@ -1,0 +1,151 @@
+"""Householder compact-WY utilities (reference: the T factors of
+geqrf/unmqr — slate's TriangularFactors Tlocal/Treduce, src/geqrf.cc:150-200,
+internal_unmqr.cc; LAPACK larft/larfb semantics).
+
+Q = H_0 H_1 ... H_{nb-1} = I - V T V^H with V unit-lower, T upper
+triangular.  T is built from the identity
+
+    T^{-1} = diag(1/tau) + strict_upper(V^H V)
+
+(one small triangular inverse, MXU-friendly) instead of LAPACK's column
+recurrence — mathematically identical, verified against the recurrence in
+tests.  tau == 0 (no reflector) columns are handled by a large-diagonal
+limit, zeroing the corresponding T row/column.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+try:  # fast path: XLA's geqrf primitive (private module path in jax 0.9)
+    from jax._src.lax.linalg import geqrf as _geqrf_xla
+except Exception:  # pragma: no cover
+    _geqrf_xla = None
+
+
+def geqrf(a: jnp.ndarray):
+    """LAPACK-style QR: returns (a_factored, taus) with V unit-lower below
+    the diagonal and R above.  Uses XLA's geqrf when available, else the
+    blocked Householder implementation below (identical semantics)."""
+    if _geqrf_xla is not None:
+        return _geqrf_xla(a)
+    return geqrf_blocked(a)
+
+
+def _larfg(alpha, xnorm_sq, dtype):
+    """Reflector scalar generation (LAPACK larfg): returns (beta, tau,
+    scale) with v = (alpha_vec) * scale, v[0] := 1 implicit."""
+    complex_t = jnp.issubdtype(dtype, jnp.complexfloating)
+    a_re = jnp.real(alpha)
+    norm = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + xnorm_sq)
+    beta = -jnp.sign(jnp.where(a_re == 0, 1.0, a_re)) * norm
+    live = norm > 0
+    beta = jnp.where(live, beta, a_re)
+    if complex_t:
+        tau = jnp.where(live, (beta - alpha) / beta, 0.0 + 0.0j)
+    else:
+        tau = jnp.where(live, (beta - alpha) / beta, 0.0)
+    scale = jnp.where(live, 1.0 / jnp.where(alpha == beta, 1, alpha - beta), 0.0)
+    return beta.astype(dtype), tau.astype(dtype), scale.astype(dtype)
+
+
+def _geqrf_panel(P: jnp.ndarray):
+    """Unblocked right-looking Householder QR of a panel (m x w)."""
+    m, w = P.shape
+    rows = jnp.arange(m)
+    cols = jnp.arange(w)
+
+    def step(j, carry):
+        P, taus = carry
+        x = P[:, j]
+        below = rows > j
+        alpha = P[j, j]
+        xnorm_sq = jnp.sum(jnp.where(below, jnp.abs(x) ** 2, 0.0))
+        beta, tau, scale = _larfg(alpha, xnorm_sq, P.dtype)
+        v = jnp.where(below, x * scale, 0.0).at[j].set(1.0)
+        # eliminate with H^H = I - conj(tau) v v^H (LAPACK zgeqr2 applies
+        # H(i)^H, passing conj(tau) to zlarf)
+        w_row = jnp.conj(v) @ P  # (w,)
+        right = cols >= j
+        upd = jnp.conj(tau) * v[:, None] * w_row[None, :]
+        P = P - jnp.where(right[None, :], upd, 0.0)
+        # store beta on the diagonal, v below it
+        P = P.at[:, j].set(jnp.where(below, v, P[:, j]).at[j].set(beta))
+        taus = taus.at[j].set(tau)
+        return P, taus
+
+    taus0 = jnp.zeros((w,), P.dtype)
+    return lax.fori_loop(0, w, step, (P, taus0))
+
+
+def geqrf_blocked(a: jnp.ndarray, nb: int = 128):
+    """Blocked Householder QR (the reference's geqrf panel+larfb structure,
+    src/geqrf.cc, entirely in XLA ops)."""
+    m, n = a.shape
+    taus = jnp.zeros((min(m, n),), a.dtype)
+    kmax = min(m, n)
+    for k0 in range(0, kmax, nb):
+        w = min(nb, kmax - k0)
+        panel = a[:, k0 : k0 + w]
+        rows = jnp.arange(m)
+        panel = jnp.where((rows >= k0)[:, None], panel, 0.0)
+        pfac, ptaus = _geqrf_panel(
+            jnp.roll(panel, -k0, axis=0)
+        )
+        pfac = jnp.roll(pfac, k0, axis=0)
+        # merge: rows < k0 keep original (they belong to earlier R rows)
+        merged = jnp.where((rows >= k0)[:, None], pfac, a[:, k0 : k0 + w])
+        a = a.at[:, k0 : k0 + w].set(merged)
+        taus = taus.at[k0 : k0 + w].set(ptaus)
+        if k0 + w < n:
+            V = materialize_v(merged, offset=k0)
+            V = jnp.where((rows >= k0)[:, None], V, 0.0)
+            T = larft(V, ptaus)
+            C = a[:, k0 + w :]
+            C = apply_block_reflector(V, T, C, trans=True)
+            a = a.at[:, k0 + w :].set(C)
+    return a, taus
+
+
+def larft(V: jnp.ndarray, taus: jnp.ndarray) -> jnp.ndarray:
+    """Build the nb x nb T factor from unit-lower V (m x nb) and taus.
+
+    V must have the unit diagonal materialized (V[j, j] == 1, zeros above).
+    """
+    nb = V.shape[1]
+    complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
+    VhV = (jnp.conj(V).T if complex_t else V.T) @ V
+    U = jnp.triu(VhV, 1)
+    big = jnp.asarray(1e30, V.dtype)
+    d = jnp.where(taus != 0, 1.0 / jnp.where(taus == 0, 1, taus), big)
+    M = U + jnp.diag(d.astype(V.dtype))
+    T = lax.linalg.triangular_solve(
+        M, jnp.eye(nb, dtype=V.dtype), left_side=True, lower=False
+    )
+    # exact zeros for absent reflectors
+    live = (taus != 0)[None, :] & (taus != 0)[:, None]
+    return jnp.where(live, T, jnp.zeros_like(T))
+
+
+def materialize_v(panel: jnp.ndarray, offset: int = 0) -> jnp.ndarray:
+    """Unit-lower V from a geqrf-factored panel (m x nb): zeros on/above
+    the diagonal of the block starting at row `offset`, implicit ones."""
+    m, nb = panel.shape
+    rows = jnp.arange(m)[:, None]
+    cols = jnp.arange(nb)[None, :]
+    below = rows > (cols + offset)
+    V = jnp.where(below, panel, jnp.zeros_like(panel))
+    return V + jnp.where(rows == cols + offset, jnp.ones_like(panel), 0)
+
+
+def apply_block_reflector(
+    V: jnp.ndarray, T: jnp.ndarray, C: jnp.ndarray, trans: bool
+) -> jnp.ndarray:
+    """C <- (I - V T V^H) C (trans=False) or (I - V T^H V^H) C (True)
+    — LAPACK larfb, left side."""
+    complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
+    Vh = jnp.conj(V).T if complex_t else V.T
+    W = Vh @ C  # (nb, n)
+    Tm = (jnp.conj(T).T if complex_t else T.T) if trans else T
+    return C - V @ (Tm @ W)

@@ -1,0 +1,156 @@
+"""Matrix generator tests (reference: matgen/ kinds + kind grammar)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.exceptions import SlateError
+from slate_tpu.matgen.generate import generate_2d, generate_matrix, parse_kind
+from slate_tpu.matrix.matrix import Matrix
+
+
+def G(kind, m=16, n=16, **kw):
+    A, S = generate_2d(kind, m, n, **kw)
+    return np.asarray(A), (None if S is None else np.asarray(S))
+
+
+class TestSpecialKinds:
+    def test_identity_zeros_ones(self):
+        assert np.array_equal(G("identity")[0], np.eye(16))
+        assert np.array_equal(G("zeros")[0], np.zeros((16, 16)))
+        assert np.array_equal(G("ones")[0], np.ones((16, 16)))
+
+    def test_jordan(self):
+        A, _ = G("jordan", 4, 4)
+        assert np.array_equal(
+            A, np.eye(4) + np.diag(np.ones(3), 1)
+        )
+        At, _ = G("jordanT", 4, 4)
+        assert np.array_equal(At, A.T)
+
+    def test_minij_hilb_lehmer(self):
+        A, _ = G("minij", 5, 5)
+        i, j = np.meshgrid(range(5), range(5), indexing="ij")
+        assert np.array_equal(A, np.minimum(i, j) + 1)
+        H, _ = G("hilb", 5, 5)
+        np.testing.assert_allclose(H, 1.0 / (i + j + 1))
+        L, _ = G("lehmer", 5, 5)
+        np.testing.assert_allclose(L, (np.minimum(i, j) + 1) / (np.maximum(i, j) + 1))
+
+    def test_tridiag_clement_toeppen(self):
+        T, _ = G("tridiag", 6, 6)
+        assert np.array_equal(T, 2 * np.eye(6) - np.eye(6, k=1) - np.eye(6, k=-1))
+        C, _ = G("clement", 4, 4)
+        # i-j==1 -> mx-j-1; i-j==-1 -> j
+        assert C[1, 0] == 3 and C[0, 1] == 1 and C[2, 2] == 0
+
+    def test_gcdmat_riemann_redheff(self):
+        A, _ = G("gcdmat", 6, 6)
+        assert A[3, 5] == np.gcd(4, 6)
+        R, _ = G("redheff", 6, 6)
+        assert R[0, 0] == 1 and R[2, 5] == 1 and R[2, 4] == 0
+
+    def test_orthog_is_orthogonal(self):
+        Q, _ = G("orthog", 12, 12)
+        np.testing.assert_allclose(Q @ Q.T, np.eye(12), atol=1e-12)
+
+    def test_kms_pei_fiedler_circul(self):
+        K, _ = G("kms", 5, 5)
+        np.testing.assert_allclose(K[0, 3], 0.5**3)
+        P, _ = G("pei", 3, 3)
+        assert np.array_equal(P, np.ones((3, 3)) + np.eye(3))
+        F, _ = G("fiedler", 4, 4)
+        assert F[0, 3] == 3
+        Ci, _ = G("circul", 4, 4)
+        assert Ci[0, 0] == 1 and Ci[3, 0] == 2  # wraps
+
+
+class TestRandomKinds:
+    def test_rand_reproducible(self):
+        A1, _ = G("rand", seed=7)
+        A2, _ = G("rand", seed=7)
+        assert np.array_equal(A1, A2)
+        A3, _ = G("rand", seed=8)
+        assert not np.array_equal(A1, A3)
+
+    def test_rands_range(self):
+        A, _ = G("rands", 64, 64)
+        assert A.min() < 0 < A.max() and np.abs(A).max() <= 1
+
+    def test_randb_binary(self):
+        A, _ = G("randb", 32, 32)
+        assert set(np.unique(A)) <= {0.0, 1.0}
+
+    def test_rand_dominant(self):
+        A, _ = G("rand_dominant", 16, 16)
+        for i in range(16):
+            off = np.abs(A[i]).sum() - np.abs(A[i, i])
+            assert np.abs(A[i, i]) >= off - 1e-10
+
+    def test_zerocol(self):
+        A, _ = G("rand_zerocol3", 8, 8)
+        assert np.all(A[:, 3] == 0)
+        A, _ = G("rand_zerocol0.5", 8, 8)
+        assert np.all(A[:, 3] == 0)  # 0.5 * (8-1) = 3
+
+
+class TestSpectrumKinds:
+    def test_svd_singular_values(self):
+        A, S = G("svd_geo", 24, 24, cond=100.0)
+        sv = np.linalg.svd(A, compute_uv=False)
+        np.testing.assert_allclose(sorted(sv), sorted(np.abs(S)), rtol=1e-10)
+        np.testing.assert_allclose(sv.max() / sv.min(), 100.0, rtol=1e-8)
+
+    def test_heev_eigenvalues(self):
+        A, S = G("heev_arith", 20, 20, cond=50.0)
+        np.testing.assert_allclose(A, A.T.conj(), atol=1e-12)
+        ev = np.linalg.eigvalsh(A)
+        np.testing.assert_allclose(sorted(ev), sorted(np.asarray(S)), atol=1e-10)
+
+    def test_poev_positive(self):
+        A, S = G("poev_logrand", 20, 20, cond=10.0)
+        ev = np.linalg.eigvalsh(A)
+        assert ev.min() > 0
+        assert (np.asarray(S) > 0).all()
+
+    def test_geev_spectrum(self):
+        A, S = G("geev_arith", 16, 16, cond=10.0)
+        ev = np.linalg.eigvals(A)
+        np.testing.assert_allclose(sorted(ev.real), sorted(np.asarray(S)), atol=1e-8)
+
+    def test_diag(self):
+        A, S = G("diag_arith", 10, 10, cond=4.0)
+        np.testing.assert_allclose(np.diag(A), np.asarray(S))
+        assert np.abs(A - np.diag(np.diag(A))).max() == 0
+
+    def test_rectangular_svd(self):
+        A, S = G("svd_geo", 30, 18, cond=10.0)
+        assert A.shape == (30, 18) and S.shape == (18,)
+        sv = np.linalg.svd(A, compute_uv=False)
+        np.testing.assert_allclose(sorted(sv), sorted(np.abs(S)), rtol=1e-9)
+
+    def test_complex_heev(self):
+        A, S = G("heev_geo", 16, 16, dtype=np.complex128, cond=10.0)
+        np.testing.assert_allclose(A, A.conj().T, atol=1e-12)
+        ev = np.linalg.eigvalsh(A)
+        np.testing.assert_allclose(sorted(ev), sorted(np.asarray(S)), atol=1e-10)
+
+
+class TestGrammar:
+    def test_parse(self):
+        assert parse_kind("rand")[0] == "rand"
+        base, dist, smax, dom, zc = parse_kind("svd_geo_dominant")
+        assert base == "svd" and dist == "geo" and dom
+
+    def test_bad_kind(self):
+        with pytest.raises(SlateError):
+            parse_kind("noSuchKind_x")
+        with pytest.raises(SlateError):
+            parse_kind("rand_geo")  # dist on non-spectrum kind
+        with pytest.raises(SlateError):
+            generate_2d("hilb_bogus", 4, 4)
+
+    def test_generate_matrix_api(self, grid22):
+        A = Matrix.zeros(32, 32, 8, dtype=np.float64, grid=grid22)
+        A2, S = generate_matrix("rand", A, seed=3)
+        full, _ = generate_2d("rand", 32, 32, seed=3)
+        np.testing.assert_array_equal(np.asarray(A2.to_global()), np.asarray(full))
