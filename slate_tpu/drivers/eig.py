@@ -1,0 +1,251 @@
+"""Hermitian eigensolver family (reference: src/heev.cc, he2hb.cc,
+hb2st.cc, sterf.cc, steqr.cc, stedc*.cc, unmtr_he2hb.cc, unmtr_hb2st.cc,
+hegst.cc, hegv.cc; SURVEY §3.5).
+
+Staging mirrors the reference:
+
+  heev:  he2hb (dense -> band, distributed-capable, all the FLOPs)
+         -> gather -> tridiagonal/eigen stage on one device.
+
+The reference also runs stage 2+ on ONE node over a gathered band
+(heev.cc:135 he2hbGather, hb2st threads+atomics) calling LAPACK
+sterf/steqr/stedc; here the gathered stage calls the XLA eigensolver
+(jnp.linalg.eigh — our L0 vendor-kernel layer, exactly as the reference
+leans on LAPACK).  A native Pallas bulge-chaser is the planned
+replacement (SURVEY §7 step 6).
+
+he2hb is implemented as blocked two-sided Householder updates
+(he2hb.cc:174-185's panel QR + trailing her2k-style update), using our
+QR panel kernels; the back-transform unmtr_he2hb applies the stored
+reflectors.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import MethodEig, Norm, Op, Option, Side, Uplo
+from ..exceptions import slate_assert
+from ..matrix.base import BaseMatrix, conj_transpose
+from ..matrix.matrix import HermitianMatrix, HermitianBandMatrix, Matrix, TriangularMatrix
+from ..options import Options, get_option
+from ..ops.householder import geqrf as _geqrf_kernel, larft, materialize_v
+from ..parallel.layout import TileLayout, tiles_from_global
+from ..types import TriangularFactors
+from . import blas3
+
+
+def he2hb(
+    A: HermitianMatrix, opts: Optional[Options] = None
+) -> Tuple[HermitianBandMatrix, Matrix, TriangularFactors]:
+    """Reduce Hermitian A to band form with bandwidth nb
+    (reference: src/he2hb.cc: per-panel QR over panel ranks + two-sided
+    trailing update).
+
+    Returns (band, V, T): band Hermitian with kd = nb; V stores the block
+    reflectors (panel k in tile column k, rows k+1..), T their compact-WY
+    factors — the inputs of unmtr_he2hb."""
+    slate_assert(A.m == A.n, "he2hb requires square")
+    lay = A.layout
+    nb = lay.nb
+    n = A.n
+    G = A.full_global()
+    kt = lay.nt
+    Vs = jnp.zeros_like(G)
+    Ts = []
+    complex_t = A.is_complex
+
+    def C(x):
+        return jnp.conj(x) if complex_t else x
+
+    for k in range(kt - 1):
+        lo = (k + 1) * nb
+        w = min(nb, n - k * nb)
+        if lo >= n:
+            break
+        panel = G[lo:, k * nb : k * nb + w]
+        vr, taus = _geqrf_kernel(panel)
+        V = materialize_v(vr, offset=0)  # (n-lo, w) unit-lower
+        Tk = larft(V, taus)
+        # panel becomes [R; 0]
+        R = jnp.triu(vr)
+        G = G.at[lo:, k * nb : k * nb + w].set(R)
+        G = G.at[k * nb : k * nb + w, lo:].set(C(R).T)
+        # two-sided update of trailing A22 (Hermitian):
+        # A' = H^H A H,  H = I - V Tk V^H
+        A22 = G[lo:, lo:]
+        P = A22 @ (V @ Tk)  # (n-lo, w)
+        Q2 = C(Tk).T @ (C(V).T @ P)  # (w, w)
+        A22 = A22 - V @ C(P).T - P @ C(V).T + V @ Q2 @ C(V).T
+        G = G.at[lo:, lo:].set(A22)
+        Vs = Vs.at[lo:, k * nb : k * nb + w].set(V)
+        Tk_full = jnp.zeros((nb, nb), G.dtype).at[:w, :w].set(Tk)
+        Ts.append(Tk_full)
+
+    Tstack = (
+        jnp.stack(Ts) if Ts else jnp.zeros((0, nb, nb), G.dtype)
+    )
+    band = HermitianBandMatrix(
+        tiles_from_global(G, lay), lay, grid=A.grid, kd=nb, uplo=A.uplo
+    )
+    Vm = Matrix(tiles_from_global(Vs, lay), lay, grid=A.grid)
+    return band, Vm, TriangularFactors(Tstack)
+
+
+def unmtr_he2hb(
+    side: Side,
+    op: Op,
+    V: Matrix,
+    T: TriangularFactors,
+    C_mat: Matrix,
+    opts: Optional[Options] = None,
+) -> Matrix:
+    """Apply the he2hb back-transform Q (reference: src/unmtr_he2hb.cc).
+
+    Q = H_0 H_1 ... with H_k = I - V_k T_k V_k^H (V_k in tile column k,
+    shifted one block down)."""
+    lay = V.layout
+    nb = lay.nb
+    n = V.n
+    kt = lay.nt
+    Vg = V.to_global()
+    C2 = C_mat.to_global()
+    complex_t = V.is_complex
+
+    def CC(x):
+        return jnp.conj(x) if complex_t else x
+
+    npanels = T.T.shape[0]
+    forward = (side == Side.Left) == (op != Op.NoTrans)
+    order = range(npanels) if forward else range(npanels - 1, -1, -1)
+    for k in order:
+        lo = (k + 1) * nb
+        w = min(nb, n - k * nb)
+        Vk = Vg[lo:, k * nb : k * nb + w]
+        Tk = T.T[k][:w, :w]
+        Tm = CC(Tk).T if op != Op.NoTrans else Tk
+        if side == Side.Left:
+            W = CC(Vk).T @ C2[lo:]
+            C2 = C2.at[lo:].set(C2[lo:] - Vk @ (Tm @ W))
+        else:
+            W = C2[:, lo:] @ Vk
+            C2 = C2.at[:, lo:].set(C2[:, lo:] - (W @ Tm) @ CC(Vk).T)
+    return C_mat._with(data=tiles_from_global(C2.astype(C_mat.dtype), C_mat.layout))
+
+
+def _gathered_band_eig(
+    band_2d: jnp.ndarray, vectors: bool
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Stage 2+: eigensolve the gathered band matrix on one device via the
+    XLA vendor eigensolver (reference analogue: gathered hb2st + LAPACK
+    steqr/stedc on one node, heev.cc:135-180)."""
+    if vectors:
+        w, Z = jnp.linalg.eigh(band_2d)
+        return w, Z
+    return jnp.linalg.eigvalsh(band_2d), None
+
+
+def heev(
+    A: HermitianMatrix,
+    opts: Optional[Options] = None,
+    vectors: bool = True,
+) -> Tuple[jnp.ndarray, Optional[Matrix]]:
+    """Hermitian eigendecomposition (reference: src/heev.cc two-stage).
+
+    Returns (Lambda ascending, Z or None).  MethodEig selects the
+    tridiagonal-stage algorithm in the reference (QR iteration vs divide &
+    conquer); the vendor eigensolver is D&C-equivalent."""
+    band, V, T = he2hb(A, opts)
+    Gband = band.to_global()
+    w, Z2 = _gathered_band_eig(Gband, vectors)
+    if not vectors:
+        return w, None
+    Zm = Matrix(
+        tiles_from_global(Z2.astype(A.dtype), A.layout), A.layout, grid=A.grid
+    )
+    # back-transform: Z = Q_he2hb Z_band (unmtr_he2hb, heev.cc:193-203)
+    Z = unmtr_he2hb(Side.Left, Op.NoTrans, V, T, Zm, opts)
+    return w, Z
+
+
+def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
+    """Eigenvalues of a symmetric tridiagonal matrix, no vectors
+    (reference: src/sterf.cc QL/QR iteration).  Vendor eigensolver on the
+    assembled tridiagonal."""
+    Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
+    return jnp.linalg.eigvalsh(Tm)
+
+
+def steqr(
+    d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Tridiagonal eigensolver with vectors (reference: src/steqr.cc
+    implicit QR)."""
+    Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
+    return _gathered_band_eig(Tm, vectors)
+
+
+def stedc(
+    d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Tridiagonal divide & conquer (reference: src/stedc.cc +
+    stedc_deflate/merge/secular/solve/sort/z_vector).  The XLA eigensolver
+    is itself a D&C; the reference's explicit deflation pipeline is a
+    planned native replacement."""
+    return steqr(d, e, vectors)
+
+
+def hegst(
+    itype: int,
+    A: HermitianMatrix,
+    L: TriangularMatrix,
+    opts: Optional[Options] = None,
+) -> HermitianMatrix:
+    """Reduce the generalized problem to standard form (reference:
+    src/hegst.cc): itype 1: C = L^-1 A L^-H; itype 2/3: C = L^H A L."""
+    from ..ops import blas2d
+
+    Ag = A.full_global()
+    Lg = L._with(op=Op.NoTrans).to_global()
+    if itype == 1:
+        Y = blas2d.trsm2d(Side.Left, L.uplo, Op.NoTrans, L.diag, 1.0, Lg, Ag)
+        Ch = blas2d.trsm2d(
+            Side.Right, L.uplo, Op.ConjTrans, L.diag, 1.0, Lg, Y
+        )
+    else:
+        LH = jnp.conj(Lg).T if A.is_complex else Lg.T
+        Ch = LH @ Ag @ Lg
+    return HermitianMatrix.from_global(
+        Ch, A.layout.mb, A.layout.nb, grid=A.grid, uplo=A.uplo
+    )
+
+
+def hegv(
+    itype: int,
+    A: HermitianMatrix,
+    B: HermitianMatrix,
+    opts: Optional[Options] = None,
+    vectors: bool = True,
+) -> Tuple[jnp.ndarray, Optional[Matrix], jnp.ndarray]:
+    """Generalized Hermitian-definite eigenproblem (reference: src/hegv.cc:
+    potrf(B) + hegst + heev + triangular back-transform).
+
+    itype 1: A x = lambda B x.  Returns (Lambda, X or None, info)."""
+    from . import chol
+
+    L, info = chol.potrf(B, opts)
+    C = hegst(itype, A, L, opts)
+    w, Z = heev(C, opts, vectors=vectors)
+    if not vectors:
+        return w, None, info
+    # x = L^-H y (itype 1)
+    X = blas3.trsm(Side.Left, 1.0, conj_transpose(L), Z, opts)
+    return w, X, info
+
+
+def sygv(itype, A, B, opts=None, vectors=True):
+    """Real-symmetric alias of hegv (reference: hegv covers sygv)."""
+    return hegv(itype, A, B, opts, vectors)

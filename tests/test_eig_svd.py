@@ -1,0 +1,164 @@
+"""Eigen/SVD family tests (reference: test/test_heev.cc, test_svd.cc,
+test_hegv.cc: eigenvalue accuracy + back-transformed vector residuals)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import eig, svd as svd_mod
+from slate_tpu.enums import Uplo
+from slate_tpu.matgen.generate import generate_2d
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix
+from slate_tpu.testing import checks
+
+
+def _herm(rng, n, dtype=np.float64):
+    A = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        A = A + 1j * rng.standard_normal((n, n))
+    return ((A + A.conj().T) / 2).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb", [(48, 16), (33, 8)])
+def test_he2hb_band_similarity(rng, dtype, n, nb):
+    A0 = _herm(rng, n, dtype)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    band, V, T = eig.he2hb(A)
+    B = np.asarray(band.to_global())
+    # band structure: zero outside bandwidth nb
+    i, j = np.meshgrid(range(n), range(n), indexing="ij")
+    assert np.abs(B[np.abs(i - j) > nb]).max() < 1e-10
+    # similarity: same eigenvalues
+    np.testing.assert_allclose(
+        np.linalg.eigvalsh(B), np.linalg.eigvalsh(A0), atol=1e-9
+    )
+
+
+def test_he2hb_back_transform(rng):
+    n, nb = 32, 8
+    A0 = _herm(rng, n)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    band, V, T = eig.he2hb(A)
+    B = np.asarray(band.to_global())
+    # Q B Q^H == A  with Q from unmtr_he2hb
+    from slate_tpu.enums import Op, Side
+
+    eye = Matrix.from_global(np.eye(n), nb)
+    Q = np.asarray(eig.unmtr_he2hb(Side.Left, Op.NoTrans, V, T, eye).to_global())
+    np.testing.assert_allclose(Q @ Q.conj().T, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(Q @ B @ Q.conj().T, A0, atol=1e-9)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_heev(rng, dtype):
+    n, nb = 48, 16
+    A0 = _herm(rng, n, dtype)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    w, Z = eig.heev(A)
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(A0), atol=1e-9)
+    Zg = np.asarray(Z.to_global())
+    # residual ||A Z - Z diag(w)||
+    R = A0 @ Zg - Zg * np.asarray(w)[None, :]
+    assert np.abs(R).max() < 1e-8
+    assert checks.passed(checks.ortho_residual(Zg), dtype, factor=100)
+
+
+def test_heev_novec(rng):
+    A0 = _herm(rng, 24)
+    A = HermitianMatrix.from_global(A0, 8, uplo=Uplo.Lower)
+    w, Z = eig.heev(A, vectors=False)
+    assert Z is None
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(A0), atol=1e-10)
+
+
+def test_heev_matgen_spectrum(rng):
+    """heev on a matgen matrix with known spectrum."""
+    A2d, S = generate_2d("heev_geo", 32, 32, cond=100.0, seed=5)
+    A = HermitianMatrix.from_global(np.asarray(A2d), 8, uplo=Uplo.Lower)
+    w, _ = eig.heev(A, vectors=False)
+    np.testing.assert_allclose(
+        sorted(np.asarray(w)), sorted(np.asarray(S)), atol=1e-10
+    )
+
+
+def test_sterf_steqr_stedc(rng):
+    n = 32
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    ref = np.linalg.eigvalsh(T)
+    np.testing.assert_allclose(np.asarray(eig.sterf(d, e)), ref, atol=1e-12)
+    w, Z = eig.steqr(d, e)
+    np.testing.assert_allclose(np.asarray(w), ref, atol=1e-12)
+    R = T @ np.asarray(Z) - np.asarray(Z) * np.asarray(w)[None, :]
+    assert np.abs(R).max() < 1e-10
+    w2, _ = eig.stedc(d, e, vectors=False)
+    np.testing.assert_allclose(np.asarray(w2), ref, atol=1e-12)
+
+
+def test_hegv(rng):
+    n, nb = 32, 8
+    A0 = _herm(rng, n)
+    B0 = rng.standard_normal((n, n))
+    B0 = B0 @ B0.T + n * np.eye(n)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    B = HermitianMatrix.from_global(B0, nb, uplo=Uplo.Lower)
+    w, X, info = eig.hegv(1, A, B)
+    assert int(info) == 0
+    # residual: A x = w B x
+    Xg = np.asarray(X.to_global())
+    R = A0 @ Xg - (B0 @ Xg) * np.asarray(w)[None, :]
+    assert np.abs(R).max() < 1e-7, np.abs(R).max()
+
+
+@pytest.mark.parametrize("m,n", [(48, 48), (64, 32), (32, 64), (40, 24)])
+def test_svd_values(rng, m, n):
+    A0 = rng.standard_normal((m, n))
+    A = Matrix.from_global(A0, 8)
+    s, _, _ = svd_mod.svd(A)
+    np.testing.assert_allclose(
+        np.asarray(s), np.linalg.svd(A0, compute_uv=False), atol=1e-10
+    )
+
+
+@pytest.mark.parametrize("m,n", [(48, 48), (64, 32), (32, 64)])
+def test_svd_vectors(rng, m, n):
+    A0 = rng.standard_normal((m, n))
+    A = Matrix.from_global(A0, 8)
+    s, U, Vh = svd_mod.svd(A, vectors=True)
+    k = min(m, n)
+    Ug = np.asarray(U.to_global())[:, :k]
+    Vhg = np.asarray(Vh.to_global())[:k]
+    rec = (Ug * np.asarray(s)[None, :k]) @ Vhg
+    assert np.abs(rec - A0).max() < 1e-8, np.abs(rec - A0).max()
+    assert checks.passed(checks.ortho_residual(Ug), np.float64, factor=100)
+
+
+def test_ge2tb_band_structure(rng):
+    m = n = 40
+    nb = 8
+    A0 = rng.standard_normal((m, n))
+    A = Matrix.from_global(A0, nb)
+    band, UV, UT, VV, VT = svd_mod.ge2tb(A)
+    B = np.asarray(band.to_global())
+    i, j = np.meshgrid(range(m), range(n), indexing="ij")
+    # upper triangular band: zeros below diag and beyond superdiag band
+    assert np.abs(B[(i > j)]).max() < 1e-10
+    assert np.abs(B[(j - i) > 2 * nb]).max() < 1e-10
+    # same singular values
+    np.testing.assert_allclose(
+        np.linalg.svd(B, compute_uv=False),
+        np.linalg.svd(A0, compute_uv=False),
+        atol=1e-9,
+    )
+
+
+def test_bdsqr(rng):
+    n = 16
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    B = np.diag(d) + np.diag(e, 1)
+    s, U, Vh = svd_mod.bdsqr(d, e, vectors=True)
+    np.testing.assert_allclose(
+        np.asarray(s), np.linalg.svd(B, compute_uv=False), atol=1e-12
+    )
