@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Headline benchmark: single-chip large gemm through the slate_tpu driver.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's only published figure is dgemm at 0.70 TFLOP/s
+per GPU (4 ranks, GPU-aware MPI; reference docs/usage.md:40-42, see
+BASELINE.md).  vs_baseline = our GFLOP/s per chip / 700.
+
+Runs on whatever accelerator jax exposes (the axon TPU chip under the
+driver; CPU elsewhere).  f32: the TPU MXU's native precision class — the
+reference's f64 runs on GPUs with native f64 units, the TPU analogue is
+f32 (see SURVEY §7 hard-part (5)).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    n = 8192 if on_tpu else 512
+    nb = 1024 if on_tpu else 128
+    dtype = jnp.float32
+
+    from slate_tpu.drivers import blas3
+    from slate_tpu.matrix.matrix import Matrix
+
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    A2 = jax.random.normal(ka, (n, n), dtype)
+    B2 = jax.random.normal(kb, (n, n), dtype) * (1.0 / n)
+
+    A = Matrix.from_global(A2, nb)
+    B = Matrix.from_global(B2, nb)
+
+    # Chain K dependent gemms inside ONE jit call: per-call dispatch over
+    # the device tunnel is ~100ms, so the timed region must amortize it,
+    # and chaining defeats any result caching of repeated identical calls.
+    K = 8 if on_tpu else 3
+
+    @jax.jit
+    def step(A, B, t):
+        # t varies per trial so no layer of the stack can serve a cached
+        # result for a repeated identical invocation
+        C = A._with(data=A.data + t)
+        for _ in range(K):
+            C = blas3.gemm(1.0, C, B, 0.0, C)
+        return C.data.sum()  # scalar readback forces real execution
+
+    float(step(A, B, 0.0))  # compile + warmup
+
+    best = float("inf")
+    for trial in range(5 if on_tpu else 2):
+        t0 = time.perf_counter()
+        s = float(step(A, B, 1.0 + trial))  # host readback = hard barrier
+        best = min(best, time.perf_counter() - t0)
+    assert np.isfinite(s)
+
+    gflops = 2.0 * n * n * n * K / best / 1e9
+    baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
+    print(
+        json.dumps(
+            {
+                "metric": f"sgemm_n{n}_gflops_per_chip",
+                "value": round(gflops, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(gflops / baseline_gflops, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -193,14 +193,19 @@ class BaseMatrix:
         from the tile array, so XLA sees full-size MXU-friendly operands.
         """
         lay = self.layout
-        Tn = self.data[lay.row_scatter][:, lay.col_scatter]
+        Tn = (
+            self.data
+            if lay.trivial_perm
+            else self.data[lay.row_scatter][:, lay.col_scatter]
+        )
         return Tn.transpose(0, 2, 1, 3).reshape(lay.P * lay.mb, lay.Q * lay.nb)
 
     @classmethod
     def _pack_padded_global(cls, A_pad, layout, grid=None, **kw):
         T = A_pad.reshape(layout.P, layout.mb, layout.Q, layout.nb)
         T = T.transpose(0, 2, 1, 3)
-        T = T[layout.row_gather][:, layout.col_gather]
+        if not layout.trivial_perm:
+            T = T[layout.row_gather][:, layout.col_gather]
         return cls(T, layout, grid=grid, **kw)
 
     def shard(self) -> "BaseMatrix":

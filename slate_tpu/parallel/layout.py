@@ -181,6 +181,16 @@ class TileLayout:
             out[s] = j * self.nb + np.arange(self.nb)
         return out
 
+    @cached_property
+    def trivial_perm(self) -> bool:
+        """True when storage order == natural order (p == q == 1), letting
+        pack/unpack skip the index gathers entirely (XLA fuses the
+        remaining reshapes into consumer layouts)."""
+        return bool(
+            np.array_equal(self.row_gather, np.arange(self.P))
+            and np.array_equal(self.col_gather, np.arange(self.Q))
+        )
+
     # -- derived layouts -----------------------------------------------------
 
     def transposed(self) -> "TileLayout":
@@ -208,6 +218,8 @@ def tiles_from_global(A: jnp.ndarray, layout: TileLayout) -> jnp.ndarray:
     Pm, Qn = layout.P * layout.mb, layout.Q * layout.nb
     A = jnp.pad(A, ((0, Pm - m), (0, Qn - n)))
     T = A.reshape(layout.P, layout.mb, layout.Q, layout.nb).transpose(0, 2, 1, 3)
+    if layout.trivial_perm:
+        return T
     # natural -> storage permutation (static gather)
     return T[layout.row_gather][:, layout.col_gather]
 
@@ -215,7 +227,7 @@ def tiles_from_global(A: jnp.ndarray, layout: TileLayout) -> jnp.ndarray:
 def tiles_to_global(T: jnp.ndarray, layout: TileLayout) -> jnp.ndarray:
     """Unpack storage-order tiles back to the (m, n) global array."""
     assert T.shape == layout.storage_shape, (T.shape, layout.storage_shape)
-    Tn = T[layout.row_scatter][:, layout.col_scatter]  # storage -> natural
+    Tn = T if layout.trivial_perm else T[layout.row_scatter][:, layout.col_scatter]
     A = Tn.transpose(0, 2, 1, 3).reshape(layout.P * layout.mb, layout.Q * layout.nb)
     return A[: layout.m, : layout.n]
 
