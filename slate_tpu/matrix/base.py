@@ -141,14 +141,12 @@ class BaseMatrix:
     # -- op handling (reference: BaseMatrix.hh transpose/conj_transpose) ----
 
     def _with(self, **kw) -> "BaseMatrix":
+        """Copy with overridden fields; preserves every subclass attribute
+        (uplo/diag/kl/ku/kd/...)."""
         out = object.__new__(type(self))
-        out.data = kw.get("data", self.data)
-        out.layout = kw.get("layout", self.layout)
-        out.grid = kw.get("grid", self.grid)
-        out.op = kw.get("op", self.op)
-        for extra in ("uplo", "diag"):
-            if hasattr(self, extra):
-                setattr(out, extra, kw.get(extra, getattr(self, extra)))
+        out.__dict__.update(self.__dict__)
+        for k, v in kw.items():
+            setattr(out, k, v)
         return out
 
     def resolved(self) -> "BaseMatrix":

@@ -1,0 +1,210 @@
+"""Simplified verb-named API (reference: include/slate/simplified_api.hh:
+15-848 — multiply, rank_k_update, triangular_solve, lu_solve, chol_solve,
+least_squares_solve, eig_vals, svd_vals, ...).
+
+Thin overload layer over the drivers, dispatching on matrix kind like the
+reference's C++ overload set.  Functional: outputs are returned.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .enums import Diag, Norm, Op, Side, Uplo
+from .matrix.matrix import (
+    BandMatrix,
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TriangularMatrix,
+)
+from .drivers import band as _band
+from .drivers import blas3 as _blas3
+from .drivers import chol as _chol
+from .drivers import eig as _eig
+from .drivers import indefinite as _indef
+from .drivers import lu as _lu
+from .drivers import qr as _qr
+from .drivers import svd as _svd
+
+
+# ----- level 3 -------------------------------------------------------------
+
+
+def multiply(alpha, A, B, beta, C, opts=None):
+    """C = alpha A B + beta C, dispatched on A/B kind (simplified_api.hh
+    multiply overloads for gemm/hemm/symm/gbmm/hbmm)."""
+    if isinstance(A, BandMatrix):
+        return _band.gbmm(alpha, A, B, beta, C, opts)
+    if isinstance(A, HermitianMatrix):
+        return _blas3.hemm(Side.Left, alpha, A, B, beta, C, opts)
+    if isinstance(B, HermitianMatrix):
+        return _blas3.hemm(Side.Right, alpha, B, A, beta, C, opts)
+    if isinstance(A, SymmetricMatrix):
+        return _blas3.symm(Side.Left, alpha, A, B, beta, C, opts)
+    if isinstance(B, SymmetricMatrix):
+        return _blas3.symm(Side.Right, alpha, B, A, beta, C, opts)
+    return _blas3.gemm(alpha, A, B, beta, C, opts)
+
+
+def rank_k_update(alpha, A, beta, C, opts=None):
+    """C = alpha A A^H/T + beta C (herk/syrk overloads)."""
+    if isinstance(C, HermitianMatrix):
+        return _blas3.herk(alpha, A, beta, C, opts)
+    return _blas3.syrk(alpha, A, beta, C, opts)
+
+
+def rank_2k_update(alpha, A, B, beta, C, opts=None):
+    if isinstance(C, HermitianMatrix):
+        return _blas3.her2k(alpha, A, B, beta, C, opts)
+    return _blas3.syr2k(alpha, A, B, beta, C, opts)
+
+
+def triangular_multiply(alpha, A: TriangularMatrix, B, side=Side.Left, opts=None):
+    return _blas3.trmm(side, alpha, A, B, opts)
+
+
+def triangular_solve(alpha, A, B, side=Side.Left, pivots=None, opts=None):
+    """trsm / tbsm overloads."""
+    from .matrix.matrix import TriangularBandMatrix
+
+    if isinstance(A, TriangularBandMatrix):
+        return _band.tbsm(side, alpha, A, B, pivots, opts)
+    return _blas3.trsm(side, alpha, A, B, opts)
+
+
+def band_multiply(alpha, A: BandMatrix, B, beta, C, opts=None):
+    return _band.gbmm(alpha, A, B, beta, C, opts)
+
+
+# ----- LU ------------------------------------------------------------------
+
+
+def lu_factor(A: Matrix, opts=None):
+    return _lu.getrf(A, opts)
+
+
+def lu_factor_nopiv(A: Matrix, opts=None):
+    return _lu.getrf_nopiv(A, opts)
+
+
+def lu_solve(A, B, opts=None):
+    """Solve A X = B (gesv / gbsv overloads)."""
+    if isinstance(A, BandMatrix):
+        X, *_ = _band.gbsv(A, B, opts)
+        return X
+    X, *_ = _lu.gesv(A, B, opts)
+    return X
+
+
+def lu_solve_using_factor(LU, pivots, B, opts=None):
+    if isinstance(LU, BandMatrix):
+        return _band.gbtrs(LU, pivots, B, opts)
+    return _lu.getrs(LU, pivots, B, opts)
+
+
+def lu_solve_using_factor_nopiv(LU, B, opts=None):
+    return _lu.getrs_nopiv(LU, B, opts)
+
+
+def lu_inverse_using_factor(LU, pivots, opts=None):
+    return _lu.getri(LU, pivots, opts)
+
+
+def lu_inverse_using_factor_out_of_place(LU, pivots, opts=None):
+    """(reference: getriOOP — out-of-place is the only mode in the
+    functional API)"""
+    return _lu.getri(LU, pivots, opts)
+
+
+# ----- Cholesky ------------------------------------------------------------
+
+
+def chol_factor(A, opts=None):
+    from .matrix.matrix import HermitianBandMatrix
+
+    if isinstance(A, HermitianBandMatrix):
+        return _band.pbtrf(A, opts)
+    return _chol.potrf(A, opts)
+
+
+def chol_solve(A, B, opts=None):
+    from .matrix.matrix import HermitianBandMatrix
+
+    if isinstance(A, HermitianBandMatrix):
+        X, *_ = _band.pbsv(A, B, opts)
+        return X
+    X, *_ = _chol.posv(A, B, opts)
+    return X
+
+
+def chol_solve_using_factor(L, B, opts=None):
+    from .matrix.matrix import TriangularBandMatrix
+
+    if isinstance(L, TriangularBandMatrix):
+        return _band.pbtrs(L, B, opts)
+    return _chol.potrs(L, B, opts)
+
+
+def chol_inverse_using_factor(L, opts=None):
+    return _chol.potri(L, opts)
+
+
+# ----- indefinite ----------------------------------------------------------
+
+
+def indefinite_factor(A: HermitianMatrix, opts=None):
+    return _indef.hetrf(A, opts)
+
+
+def indefinite_solve(A: HermitianMatrix, B, opts=None):
+    X, *_ = _indef.hesv(A, B, opts)
+    return X
+
+
+def indefinite_solve_using_factor(L, d, B, opts=None):
+    return _indef.hetrs(L, d, B, opts)
+
+
+# ----- least squares / QR / LQ --------------------------------------------
+
+
+def least_squares_solve(A: Matrix, B: Matrix, opts=None):
+    return _qr.gels(A, B, opts)
+
+
+def qr_factor(A: Matrix, opts=None):
+    return _qr.geqrf(A, opts)
+
+
+def lq_factor(A: Matrix, opts=None):
+    return _qr.gelqf(A, opts)
+
+
+def multiply_by_q(side, op, fac, T, C, from_lq=False, opts=None):
+    """Apply Q from qr_factor / lq_factor (unmqr/unmlq overloads)."""
+    if from_lq:
+        return _qr.unmlq(side, op, fac, T, C, opts)
+    return _qr.unmqr(side, op, fac, T, C, opts)
+
+
+# ----- eigen / svd ---------------------------------------------------------
+
+
+def eig(A: HermitianMatrix, opts=None):
+    """Eigenvalues + vectors (simplified_api.hh eig)."""
+    return _eig.heev(A, opts, vectors=True)
+
+
+def eig_vals(A: HermitianMatrix, opts=None):
+    w, _ = _eig.heev(A, opts, vectors=False)
+    return w
+
+
+def svd(A: Matrix, opts=None):
+    return _svd.svd(A, opts, vectors=True)
+
+
+def svd_vals(A: Matrix, opts=None):
+    s, _, _ = _svd.svd(A, opts, vectors=False)
+    return s

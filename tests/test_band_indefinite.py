@@ -1,0 +1,182 @@
+"""Band + indefinite + simplified API tests (reference: test_gbsv.cc,
+test_pbsv.cc, test_hesv.cc, test_tbsm.cc)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.drivers import band as band_mod
+from slate_tpu.drivers import indefinite as indef
+from slate_tpu.enums import Diag, Side, Uplo
+from slate_tpu.matrix.matrix import (
+    BandMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    Matrix,
+    TriangularBandMatrix,
+)
+from slate_tpu.testing import checks
+
+
+def _band_np(rng, n, kl, ku):
+    A = rng.standard_normal((n, n))
+    i, j = np.meshgrid(range(n), range(n), indexing="ij")
+    A[(j - i > ku) | (i - j > kl)] = 0
+    return A
+
+
+def test_gbmm(rng):
+    n, kl, ku = 32, 3, 2
+    A0 = _band_np(rng, n, kl, ku)
+    B0 = rng.standard_normal((n, 8))
+    A = BandMatrix.from_global(A0, kl, ku, 8)
+    B = Matrix.from_global(B0, 8)
+    C = Matrix.zeros(n, 8, 8, dtype=np.float64)
+    C2 = band_mod.gbmm(1.0, A, B, 0.0, C)
+    np.testing.assert_allclose(np.asarray(C2.to_global()), A0 @ B0, atol=1e-12)
+
+
+def test_gbsv(rng):
+    n, kl, ku = 48, 4, 3
+    A0 = _band_np(rng, n, kl, ku) + 10 * np.eye(n)
+    B0 = rng.standard_normal((n, 4))
+    A = BandMatrix.from_global(A0, kl, ku, 8)
+    B = Matrix.from_global(B0, 8)
+    X, LU, piv, info = band_mod.gbsv(A, B)
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_pbsv(rng):
+    n, kd = 40, 4
+    A0 = _band_np(rng, n, kd, kd)
+    A0 = (A0 + A0.T) / 2 + n * np.eye(n)
+    B0 = rng.standard_normal((n, 4))
+    base = Matrix.from_global(np.tril(A0), 8)
+    Ah = HermitianBandMatrix(base.data, base.layout, kd=kd, uplo=Uplo.Lower)
+    X, L, info = band_mod.pbsv(Ah, Matrix.from_global(B0, 8))
+    assert int(info) == 0
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_tbsm(rng):
+    n, kd = 32, 3
+    T0 = np.tril(_band_np(rng, n, kd, 0)) + n * np.eye(n)
+    B0 = rng.standard_normal((n, 4))
+    T = TriangularBandMatrix(
+        Matrix.from_global(T0, 8).data,
+        Matrix.from_global(T0, 8).layout,
+        kd=kd,
+        uplo=Uplo.Lower,
+    )
+    X = band_mod.tbsm(Side.Left, 1.0, T, Matrix.from_global(B0, 8))
+    err = checks.solve_residual(T0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_hesv(rng):
+    n = 40
+    A0 = rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2  # indefinite
+    B0 = rng.standard_normal((n, 4))
+    A = HermitianMatrix.from_global(A0, 8, uplo=Uplo.Lower)
+    X, L, d, info = indef.hesv(A, Matrix.from_global(B0, 8))
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=1000), err
+
+
+def test_hetrf_factorization(rng):
+    n = 24
+    A0 = rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2 + n * np.eye(n)  # definite => nopiv safe
+    A = HermitianMatrix.from_global(A0, 8, uplo=Uplo.Lower)
+    L, d, info = indef.hetrf(A)
+    assert int(info) == 0
+    Lg = np.tril(np.asarray(L.to_global()), -1) + np.eye(n)
+    rec = Lg @ np.diag(np.asarray(d)) @ Lg.T
+    np.testing.assert_allclose(rec, A0, atol=1e-9)
+
+
+def test_hesv_complex(rng):
+    n = 24
+    A0 = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    A0 = (A0 + A0.conj().T) / 2
+    B0 = rng.standard_normal((n, 2)).astype(np.complex128)
+    A = HermitianMatrix.from_global(A0, 8, uplo=Uplo.Lower)
+    X, L, d, info = indef.hesv(A, Matrix.from_global(B0, 8))
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.complex128, factor=1000), err
+
+
+class TestSimplifiedAPI:
+    def test_multiply_dispatch(self, rng):
+        n = 24
+        A0 = rng.standard_normal((n, n))
+        B0 = rng.standard_normal((n, n))
+        C = Matrix.zeros(n, n, 8, dtype=np.float64)
+        C2 = st.simplified.multiply(
+            1.0, Matrix.from_global(A0, 8), Matrix.from_global(B0, 8), 0.0, C
+        )
+        np.testing.assert_allclose(np.asarray(C2.to_global()), A0 @ B0, atol=1e-12)
+        # hermitian dispatch
+        H0 = (A0 + A0.T) / 2
+        H = HermitianMatrix.from_global(H0, 8, uplo=Uplo.Lower)
+        C3 = st.simplified.multiply(1.0, H, Matrix.from_global(B0, 8), 0.0, C)
+        np.testing.assert_allclose(np.asarray(C3.to_global()), H0 @ B0, atol=1e-12)
+
+    def test_solver_verbs(self, rng):
+        n = 32
+        A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+        B0 = rng.standard_normal((n, 4))
+        X = st.simplified.lu_solve(Matrix.from_global(A0, 8), Matrix.from_global(B0, 8))
+        np.testing.assert_allclose(
+            np.asarray(X.to_global()), np.linalg.solve(A0, B0), atol=1e-9
+        )
+        S0 = A0 @ A0.T + n * np.eye(n)
+        Xc = st.simplified.chol_solve(
+            HermitianMatrix.from_global(S0, 8, uplo=Uplo.Lower),
+            Matrix.from_global(B0, 8),
+        )
+        np.testing.assert_allclose(
+            np.asarray(Xc.to_global()), np.linalg.solve(S0, B0), atol=1e-8
+        )
+
+    def test_eig_svd_verbs(self, rng):
+        n = 24
+        A0 = rng.standard_normal((n, n))
+        H0 = (A0 + A0.T) / 2
+        w = st.simplified.eig_vals(HermitianMatrix.from_global(H0, 8, uplo=Uplo.Lower))
+        np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(H0), atol=1e-10)
+        s = st.simplified.svd_vals(Matrix.from_global(A0, 8))
+        np.testing.assert_allclose(
+            np.asarray(s), np.linalg.svd(A0, compute_uv=False), atol=1e-10
+        )
+
+    def test_least_squares_verb(self, rng):
+        m, n = 40, 24
+        A0 = rng.standard_normal((m, n))
+        B0 = rng.standard_normal((m, 2))
+        X = st.simplified.least_squares_solve(
+            Matrix.from_global(A0, 8), Matrix.from_global(B0, 8)
+        )
+        ref, *_ = np.linalg.lstsq(A0, B0, rcond=None)
+        np.testing.assert_allclose(np.asarray(X.to_global())[:n], ref, atol=1e-8)
+
+
+def test_public_api_surface():
+    """The slate.hh-equivalent surface must be importable from the root."""
+    for name in (
+        "gemm", "hemm", "symm", "herk", "her2k", "syrk", "syr2k", "trmm",
+        "trsm", "add", "copy", "scale", "set", "norm", "colNorms",
+        "potrf", "potrs", "posv", "potri", "trtri", "posv_mixed",
+        "getrf", "getrs", "gesv", "getri", "gesv_mixed", "gesv_rbt",
+        "geqrf", "unmqr", "gelqf", "unmlq", "cholqr", "gels",
+        "heev", "hegv", "he2hb", "sterf", "steqr", "stedc",
+        "svd", "ge2tb", "bdsqr", "gbmm", "gbsv", "pbsv", "tbsm",
+        "hesv", "hetrf", "hetrs", "generate_matrix", "Matrix",
+        "HermitianMatrix", "TriangularMatrix", "BandMatrix",
+        "ProcessGrid", "TileLayout", "Pivots", "TriangularFactors",
+    ):
+        assert hasattr(st, name), name
