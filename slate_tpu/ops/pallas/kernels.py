@@ -1,0 +1,260 @@
+"""Pallas TPU kernels for the device tile-kernel layer.
+
+TPU-native re-implementations of the reference's CUDA device kernels
+(reference: src/cuda/device_genorm.cu, device_transpose.cu,
+device_geadd.cu, device_gescale.cu, src/internal/internal_rbt_generate +
+gerbt butterfly kernels; interface include/slate/internal/device.hh:92-282).
+
+Most elementwise tile ops fuse perfectly under plain XLA (see
+internal/tile_ops.py) — Pallas is reserved for the patterns XLA schedules
+poorly:
+
+  * batched tile norms with per-tile reductions and a fro (scale, sumsq)
+    update — one VMEM pass per tile instead of XLA's multi-kernel
+    reduce chains (device_genorm.cu's per-block reductions);
+  * the recursive butterfly (RBT) pair transform — strided pair access
+    that XLA turns into gather/scatter, here a single VMEM pass;
+  * batched tile transpose feeding MXU-unfriendly layouts.
+
+Every kernel has a jnp reference implementation; `use_pallas()` gates on
+the actual platform, and tests run the Pallas path in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on pure-CPU installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Batched tile norms (device_genorm.cu analogue)
+# ---------------------------------------------------------------------------
+
+
+def _norm_kernel(t_ref, out_ref, *, kind: str):
+    """One grid step = one tile; writes the tile's norm statistic."""
+    t = t_ref[...]
+    a = jnp.abs(t)
+    if kind == "max":
+        out_ref[0] = jnp.max(a)
+    elif kind == "fro_sumsq":
+        out_ref[0] = jnp.sum(a * a)
+    elif kind == "one":  # max column sum within the tile -> still needs
+        # cross-tile accumulation; emit per-column sums
+        out_ref[...] = jnp.sum(a, axis=0)
+    elif kind == "inf":
+        out_ref[...] = jnp.sum(a, axis=1)
+
+
+def tile_norms_pallas(T: jnp.ndarray, kind: str, interpret: bool = False):
+    """Per-tile norm statistics over a (N, mb, nb) tile stack.
+
+    kind: 'max' -> (N,); 'fro_sumsq' -> (N,) sum of squares;
+    'one' -> (N, nb) per-column sums; 'inf' -> (N, mb) per-row sums.
+
+    Grid steps process TB=8 tiles each so every output block satisfies the
+    TPU (8, 128)-divisibility rules; N is zero-padded to a multiple of TB
+    (zero tiles contribute zero statistics).
+    """
+    N, mb, nb = T.shape
+    TB = 8
+    Np = -(-N // TB) * TB
+    if Np != N:
+        T = jnp.pad(T, ((0, Np - N), (0, 0), (0, 0)))
+    real = (
+        jnp.finfo(T.dtype).dtype
+        if not jnp.issubdtype(T.dtype, jnp.complexfloating)
+        else (jnp.float32 if T.dtype == jnp.complex64 else jnp.float64)
+    )
+    if kind in ("max", "fro_sumsq"):
+        out_shape = jax.ShapeDtypeStruct((Np, 1), real)
+        out_spec = pl.BlockSpec((TB, 1), lambda i: (i, 0))
+    elif kind == "one":
+        out_shape = jax.ShapeDtypeStruct((Np, nb), real)
+        out_spec = pl.BlockSpec((TB, nb), lambda i: (i, 0))
+    else:
+        out_shape = jax.ShapeDtypeStruct((Np, mb), real)
+        out_spec = pl.BlockSpec((TB, mb), lambda i: (i, 0))
+
+    def kernel(t_ref, out_ref):
+        a = jnp.abs(t_ref[...]).astype(real)  # (TB, mb, nb)
+        # staged 2D reductions with keepdims: Mosaic's layout inference
+        # rejects the 1D intermediates a flat axis=(1,2) reduce creates
+        if kind == "max":
+            out_ref[...] = jnp.max(jnp.max(a, axis=2), axis=1, keepdims=True)
+        elif kind == "fro_sumsq":
+            out_ref[...] = jnp.sum(jnp.sum(a * a, axis=2), axis=1, keepdims=True)
+        elif kind == "one":
+            out_ref[...] = jnp.sum(a, axis=1)
+        else:
+            out_ref[...] = jnp.sum(a, axis=2)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(Np // TB,),
+        in_specs=[pl.BlockSpec((TB, mb, nb), lambda i: (i, 0, 0))],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(T)
+    out = out[:N]
+    if kind in ("max", "fro_sumsq"):
+        return out[:, 0]
+    return out
+
+
+def tile_norms_reference(T: jnp.ndarray, kind: str):
+    """jnp twin of tile_norms_pallas."""
+    a = jnp.abs(T)
+    if kind == "max":
+        return a.max(axis=(1, 2))
+    if kind == "fro_sumsq":
+        return (a * a).sum(axis=(1, 2))
+    if kind == "one":
+        return a.sum(axis=1)
+    return a.sum(axis=2)
+
+
+def tile_norms(T: jnp.ndarray, kind: str):
+    """Dispatch: Pallas on TPU, jnp elsewhere."""
+    if on_tpu() and _HAS_PLTPU:
+        return tile_norms_pallas(T, kind)
+    return tile_norms_reference(T, kind)
+
+
+# ---------------------------------------------------------------------------
+# Batched tile transpose (device_transpose.cu analogue)
+# ---------------------------------------------------------------------------
+
+
+def tile_transpose_pallas(T: jnp.ndarray, conj: bool = False, interpret: bool = False):
+    """(N, mb, nb) -> (N, nb, mb), per-tile (conj-)transpose."""
+    N, mb, nb = T.shape
+
+    def kernel(t_ref, out_ref):
+        t = t_ref[0]
+        if conj and jnp.issubdtype(t.dtype, jnp.complexfloating):
+            t = jnp.conj(t)
+        out_ref[0, :, :] = t.T
+
+    return pl.pallas_call(
+        kernel,
+        grid=(N,),
+        in_specs=[pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, nb, mb), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, nb, mb), T.dtype),
+        interpret=interpret,
+    )(T)
+
+
+def tile_transpose(T: jnp.ndarray, conj: bool = False):
+    if on_tpu() and _HAS_PLTPU and not jnp.issubdtype(T.dtype, jnp.complexfloating):
+        return tile_transpose_pallas(T, conj)
+    out = T.transpose(0, 2, 1)
+    if conj and jnp.issubdtype(T.dtype, jnp.complexfloating):
+        out = jnp.conj(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Butterfly (RBT) pair transform (gerbt kernel analogue)
+# ---------------------------------------------------------------------------
+
+
+def butterfly_level_pallas(
+    X: jnp.ndarray, D1: jnp.ndarray, D2: jnp.ndarray, transpose: bool,
+    interpret: bool = False,
+):
+    """One butterfly level over paired row blocks.
+
+    X: (2h, w); D1, D2: (h,).  transpose=True:
+        top = D1 x1 + D2 x2 ; bot = D1 x1 - D2 x2
+    else:
+        top = D1 (x1 + x2) ; bot = D2 (x1 - x2)
+    (matches drivers/lu._apply_butterfly; all rows in one VMEM pass).
+    """
+    two_h, w = X.shape
+    h = two_h // 2
+    s = float(np.sqrt(0.5))  # python scalar: weak-typed, not a captured const
+
+    def kernel(x_ref, d1_ref, d2_ref, out_ref):
+        x1 = x_ref[:h, :]
+        x2 = x_ref[h:, :]
+        d1 = d1_ref[:][:, None]
+        d2 = d2_ref[:][:, None]
+        if transpose:
+            top = d1 * x1 + d2 * x2
+            bot = d1 * x1 - d2 * x2
+        else:
+            top = d1 * (x1 + x2)
+            bot = d2 * (x1 - x2)
+        out_ref[:h, :] = s * top
+        out_ref[h:, :] = s * bot
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(X.shape, X.dtype),
+        interpret=interpret,
+    )(X, D1, D2)
+
+
+def butterfly_level_reference(X, D1, D2, transpose: bool):
+    h = X.shape[0] // 2
+    s = np.sqrt(0.5)
+    x1, x2 = X[:h], X[h:]
+    d1, d2 = D1[:, None], D2[:, None]
+    if transpose:
+        return s * jnp.concatenate([d1 * x1 + d2 * x2, d1 * x1 - d2 * x2])
+    return s * jnp.concatenate([d1 * (x1 + x2), d2 * (x1 - x2)])
+
+
+def butterfly_level(X, D1, D2, transpose: bool):
+    if on_tpu() and _HAS_PLTPU:
+        return butterfly_level_pallas(X, D1, D2, transpose)
+    return butterfly_level_reference(X, D1, D2, transpose)
+
+
+# ---------------------------------------------------------------------------
+# Fused masked geadd/scale (device_geadd.cu / device_gescale.cu analogue)
+# ---------------------------------------------------------------------------
+
+
+def tile_geadd_pallas(
+    alpha, A: jnp.ndarray, beta, B: jnp.ndarray, interpret: bool = False
+):
+    """B = alpha A + beta B over a (N, mb, nb) stack, one VMEM pass."""
+    N, mb, nb = A.shape
+
+    def kernel(a_ref, b_ref, out_ref):
+        out_ref[...] = alpha * a_ref[...] + beta * b_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(A.shape, B.dtype),
+        interpret=interpret,
+    )(A, B)
