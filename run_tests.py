@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Sweep runner (reference: test/run_tests.py — builds command lists per
+routine class with size presets quick/small/medium, JUnit XML output).
+
+Usage:
+    python run_tests.py                     # quick preset, all routines
+    python run_tests.py --size small --grid 2x2 --xml results.xml gemm posv
+    python run_tests.py --target d          # accepted for reference parity
+"""
+
+import argparse
+import os
+import sys
+
+PRESETS = {
+    "quick": {"dim": "32,50", "nb": "16", "type": "d"},
+    "small": {"dim": "64,100", "nb": "16,32", "type": "s,d"},
+    "medium": {"dim": "128,256", "nb": "32,64", "type": "s,d,c,z"},
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("routines", nargs="*", default=[])
+    ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
+    ap.add_argument("--grid", default="1x1")
+    ap.add_argument("--xml", default=None)
+    ap.add_argument("--target", default="d")
+    ap.add_argument("--type", default=None)
+    args = ap.parse_args()
+
+    # virtual devices for multi-process grids (tests force the cpu
+    # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)
+    p, q = (int(x) for x in args.grid.split("x"))
+    if p * q > 1:
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count={max(8, p * q)}",
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from slate_tpu.testing.tester import run
+
+    preset = PRESETS[args.size]
+    argv = list(args.routines) if args.routines else ["all"]
+    argv += ["--dim", preset["dim"], "--nb", preset["nb"]]
+    argv += ["--type", args.type or preset["type"]]
+    argv += ["--grid", args.grid, "--target", args.target]
+    if args.xml:
+        argv += ["--xml", args.xml]
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from .enums import (
     Side,
     Target,
     TileKind,
+    Uplo,
 )
 from .exceptions import (
     DimensionError,

@@ -1,0 +1,390 @@
+"""Parameter-sweep tester (reference: test/test.cc + testsweeper dispatch
+table test.cc:117-260, per-routine testers test/test_*.cc, sweep runner
+test/run_tests.py with JUnit XML output).
+
+CLI:
+    python -m slate_tpu.testing.tester --dim 64:128 --type s,d --nb 16 \
+        --grid 2x2 --xml out.xml gemm posv gesv
+
+Each routine test generates inputs with the Philox matgen, runs the
+driver, and accepts on the reference's norm-scaled residual bound
+(error <= tol_factor * eps; test_gemm.cc:192-207).  Timing is wall-clock
+around the blocked driver call (first call includes compile, a repeat
+measures steady state).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+_TYPES = {"s": np.float32, "d": np.float64, "c": np.complex64, "z": np.complex128}
+_EPS_FACTOR = {"default": 50.0}
+
+
+@dataclass
+class Params:
+    m: int
+    n: int
+    k: int
+    nb: int
+    dtype: type
+    type_char: str
+    p: int = 1
+    q: int = 1
+    seed: int = 42
+    check: bool = True
+    uplo: str = "lower"
+    grid=None
+
+
+@dataclass
+class Result:
+    routine: str
+    params: str
+    seconds: float
+    gflops: float
+    error: float
+    passed: bool
+    message: str = ""
+
+
+def _rng_matrix(kind, m, n, dtype, seed):
+    from ..matgen.generate import generate_2d
+
+    A, _ = generate_2d(kind, m, n, dtype, seed=seed)
+    return np.asarray(A)
+
+
+def _eps(dtype):
+    from .checks import eps_of
+
+    return eps_of(dtype)
+
+
+def _grid(pr: Params):
+    if pr.p * pr.q == 1:
+        return None
+    import jax
+
+    from ..parallel.grid import ProcessGrid
+
+    devs = jax.devices()
+    if len(devs) < pr.p * pr.q:
+        raise RuntimeError(f"grid {pr.p}x{pr.q} needs {pr.p*pr.q} devices")
+    return ProcessGrid.from_devices(devs[: pr.p * pr.q], p=pr.p, q=pr.q)
+
+
+# ---------------------------------------------------------------------------
+# routine testers — each returns (seconds, gflop, error)
+# ---------------------------------------------------------------------------
+
+
+def _test_gemm(pr: Params):
+    import slate_tpu as st
+    from .checks import gemm_residual
+
+    g = _grid(pr)
+    A0 = _rng_matrix("rand", pr.m, pr.k, pr.dtype, pr.seed)
+    B0 = _rng_matrix("rand", pr.k, pr.n, pr.dtype, pr.seed + 1)
+    C0 = _rng_matrix("rand", pr.m, pr.n, pr.dtype, pr.seed + 2)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    C = st.Matrix.from_global(C0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    C2 = st.gemm(2.0, A, B, -1.0, C)
+    got = np.asarray(C2.to_global())
+    dt = time.perf_counter() - t0
+    err = gemm_residual(got, 2.0 * A0 @ B0 - C0, 2.0, A0, B0, -1.0, C0)
+    return dt, 2e-9 * pr.m * pr.n * pr.k / dt, err
+
+
+def _test_posv(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand_dominant", n, n, pr.dtype, pr.seed)
+    A0 = (A0 + A0.conj().T) / 2 + n * np.eye(n)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    X, L, info = st.posv(A, B)
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 1e-9 * n**3 / 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_potrf(pr: Params):
+    import slate_tpu as st
+    from .checks import factor_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = A0 @ A0.conj().T + n * np.eye(n)
+    A0 = A0.astype(pr.dtype)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    L, info = st.potrf(A)
+    Lg = np.tril(np.asarray(L.to_global()))
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 1e-9 * n**3 / 3 / dt, factor_residual(A0, Lg)
+
+
+def _test_gesv(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    X, LU, piv, info = st.gesv(A, B)
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 2e-9 * n**3 / 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_geqrf(pr: Params):
+    import slate_tpu as st
+    from .checks import factor_residual, ortho_residual
+
+    g = _grid(pr)
+    m, n = pr.m, pr.n
+    A0 = _rng_matrix("rand", m, n, pr.dtype, pr.seed)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    fac, T = st.geqrf(A)
+    Q = np.asarray(st.ungqr(fac, T).to_global())
+    dt = time.perf_counter() - t0
+    R = np.triu(np.asarray(fac.to_global()))[: min(m, n), :]
+    err = max(factor_residual(A0, Q, R), ortho_residual(Q))
+    return dt, 2e-9 * m * n * n / dt, err
+
+
+def _test_gels(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    m, n = max(pr.m, pr.n), min(pr.m, pr.n)
+    A0 = _rng_matrix("rand", m, n, pr.dtype, pr.seed)
+    B0 = _rng_matrix("rand", m, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    X = st.gels(A, B)
+    got = np.asarray(X.to_global())[:n]
+    dt = time.perf_counter() - t0
+    ref, *_ = np.linalg.lstsq(A0, B0, rcond=None)
+    scale = max(np.abs(ref).max(), 1.0)
+    err = np.abs(got - ref).max() / scale / max(m, 1)
+    return dt, 2e-9 * m * n * n / dt, err
+
+
+def _test_heev(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.conj().T) / 2).astype(pr.dtype)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    w, Z = st.heev(A)
+    dt = time.perf_counter() - t0
+    ref = np.linalg.eigvalsh(A0)
+    err = np.abs(np.asarray(w) - ref).max() / max(np.abs(ref).max(), 1.0) / n
+    if Z is not None:
+        Zg = np.asarray(Z.to_global())
+        res = np.abs(A0 @ Zg - Zg * np.asarray(w)[None, :]).max()
+        err = max(err, res / max(np.abs(ref).max(), 1.0) / n)
+    return dt, 4e-9 * n**3 / 3 / dt, err
+
+
+def _test_svd(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    m, n = pr.m, pr.n
+    A0 = _rng_matrix("rand", m, n, pr.dtype, pr.seed)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    s, _, _ = st.svd(A)
+    dt = time.perf_counter() - t0
+    ref = np.linalg.svd(A0, compute_uv=False)
+    err = np.abs(np.asarray(s) - ref).max() / max(ref.max(), 1.0) / max(m, n)
+    return dt, 4e-9 * m * n * min(m, n) / dt, err
+
+
+def _test_norm(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    A0 = _rng_matrix("rand", pr.m, pr.n, pr.dtype, pr.seed)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    errs = []
+    for nt, ref in (
+        (st.Norm.Max, np.abs(A0).max()),
+        (st.Norm.One, np.abs(A0).sum(axis=0).max()),
+        (st.Norm.Inf, np.abs(A0).sum(axis=1).max()),
+        (st.Norm.Fro, np.linalg.norm(A0, "fro")),
+    ):
+        got = float(st.norm(nt, A))
+        errs.append(abs(got - ref) / max(ref, 1e-300))
+    dt = time.perf_counter() - t0
+    return dt, 1e-9 * pr.m * pr.n / dt, max(errs)
+
+
+def _test_trsm(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n, m = pr.n, max(pr.k, 1)
+    T0 = np.tril(_rng_matrix("rand", n, n, pr.dtype, pr.seed)) + n * np.eye(n)
+    T0 = T0.astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, m, pr.dtype, pr.seed + 1)
+    T = st.TriangularMatrix.from_global(T0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    X = st.trsm(st.Side.Left, 1.0, T, B)
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    return dt, 1e-9 * n * n * m / dt, solve_residual(T0, got, B0)
+
+
+def _simple(fn):
+    return fn
+
+
+ROUTINES: Dict[str, Callable[[Params], tuple]] = {
+    "gemm": _test_gemm,
+    "posv": _test_posv,
+    "potrf": _test_potrf,
+    "gesv": _test_gesv,
+    "geqrf": _test_geqrf,
+    "gels": _test_gels,
+    "heev": _test_heev,
+    "svd": _test_svd,
+    "norm": _test_norm,
+    "trsm": _test_trsm,
+}
+
+# reference-style tolerance factors per routine class (test_*.cc use 3eps
+# with routine-dependent scalings; decompositions get a looser factor)
+TOL_FACTOR = {
+    "gemm": 30, "norm": 30, "trsm": 100, "posv": 100, "potrf": 100,
+    "gesv": 100, "geqrf": 100, "gels": 100, "heev": 300, "svd": 300,
+}
+
+
+def run(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="slate_tpu tester")
+    ap.add_argument("routines", nargs="+", choices=sorted(ROUTINES) + ["all"])
+    ap.add_argument("--dim", default="64", help="comma list of n (or m:n:k)")
+    ap.add_argument("--nb", default="16", help="comma list of tile sizes")
+    ap.add_argument("--type", default="d", help="comma list from s,d,c,z")
+    ap.add_argument("--grid", default="1x1", help="pxq process grid")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--check", default="y", choices=["y", "n"])
+    ap.add_argument("--xml", default=None, help="write JUnit XML here")
+    ap.add_argument("--target", default="d", help="accepted for parity (h/t/b/d)")
+    args = ap.parse_args(argv)
+
+    routines = sorted(ROUTINES) if "all" in args.routines else args.routines
+    p, q = (int(x) for x in args.grid.split("x"))
+    dims = []
+    for d in args.dim.split(","):
+        parts = [int(x) for x in d.split(":")]
+        if len(parts) == 1:
+            dims.append((parts[0], parts[0], parts[0]))
+        else:
+            while len(parts) < 3:
+                parts.append(parts[-1])
+            dims.append(tuple(parts))
+
+    results: List[Result] = []
+    header = (
+        f"{'routine':10} {'type':4} {'m':>6} {'n':>6} {'k':>6} {'nb':>4} "
+        f"{'grid':>5} {'time(s)':>9} {'GFLOPs':>9} {'error':>10} {'status':>7}"
+    )
+    print(header)
+    print("-" * len(header))
+    for routine in routines:
+        fn = ROUTINES[routine]
+        for tc in args.type.split(","):
+            dtype = _TYPES[tc]
+            for (m, n, k) in dims:
+                for nb in (int(x) for x in args.nb.split(",")):
+                    pr = Params(
+                        m=m, n=n, k=k, nb=nb, dtype=dtype, type_char=tc,
+                        p=p, q=q, seed=args.seed, check=args.check == "y",
+                    )
+                    label = f"{routine}_{tc}_m{m}n{n}k{k}nb{nb}_{p}x{q}"
+                    try:
+                        dt, gflops, err = fn(pr)
+                        tol = TOL_FACTOR.get(routine, 100) * _eps(dtype)
+                        ok = (err <= tol) if pr.check else True
+                        results.append(
+                            Result(routine, label, dt, gflops, err, ok)
+                        )
+                        status = "pass" if ok else "FAILED"
+                        print(
+                            f"{routine:10} {tc:4} {m:6} {n:6} {k:6} {nb:4} "
+                            f"{p}x{q:>3} {dt:9.4f} {gflops:9.2f} "
+                            f"{err:10.2e} {status:>7}"
+                        )
+                    except Exception as e:  # noqa: BLE001 — harness boundary
+                        results.append(
+                            Result(routine, label, 0, 0, float("inf"), False, str(e))
+                        )
+                        print(f"{routine:10} {tc:4} {label}: ERROR {e}")
+
+    npass = sum(r.passed for r in results)
+    print(f"\n{npass} / {len(results)} passed")
+    if args.xml:
+        _write_junit(args.xml, results)
+        print(f"wrote {args.xml}")
+    return 0 if npass == len(results) else 1
+
+
+def _write_junit(path: str, results: List[Result]) -> None:
+    """JUnit XML like the reference's run_tests.py --xml (SURVEY §4)."""
+    suite = ET.Element(
+        "testsuite",
+        name="slate_tpu",
+        tests=str(len(results)),
+        failures=str(sum(not r.passed for r in results)),
+    )
+    for r in results:
+        case = ET.SubElement(
+            suite, "testcase", classname=r.routine, name=r.params,
+            time=f"{r.seconds:.4f}",
+        )
+        if not r.passed:
+            fail = ET.SubElement(case, "failure", message=r.message or "tolerance")
+            fail.text = f"error={r.error:.3e} {r.message}"
+    ET.ElementTree(suite).write(path, encoding="unicode", xml_declaration=False)
+
+
+if __name__ == "__main__":
+    sys.exit(run())
