@@ -50,10 +50,6 @@ def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
     return out.shard()
 
 
-def _same_tiling(A: BaseMatrix, B: BaseMatrix, dims=("k",)) -> bool:
-    return True  # layouts are validated per-routine; padding handled by 2D path
-
-
 def gemm(
     alpha,
     A: Matrix,

@@ -67,10 +67,7 @@ def potrf(
         L2 = Lp[:n, :n]
         L = TriangularMatrix.from_global(L2, lay.mb, lay.nb, grid=A.grid, uplo=Uplo.Lower)
 
-    diag_ok = jnp.isfinite(
-        L.data if use_spmd else L.data
-    )
-    info = jnp.where(jnp.all(diag_ok), 0, 1).astype(jnp.int32)
+    info = jnp.where(jnp.all(jnp.isfinite(L.data)), 0, 1).astype(jnp.int32)
 
     if A.uplo == Uplo.Upper:
         U = conj_transpose(L).resolved()
@@ -110,8 +107,14 @@ def trtri(T: TriangularMatrix, opts: Optional[Options] = None) -> TriangularMatr
     A2 = T._with(op=Op.NoTrans).to_global()
     eye = jnp.eye(T.m, dtype=T.dtype)
     inv = blas2d.trsm2d(Side.Left, T.uplo, T.op, T.diag, 1.0, A2, eye)
+    # op(A)^-1 lives in the triangle of op(A), not of the storage: a
+    # transposed view inverts into the opposite triangle (mirrors
+    # resolved()'s uplo swap).
+    out_uplo = T.uplo
+    if T.op != Op.NoTrans:
+        out_uplo = Uplo.Upper if T.uplo == Uplo.Lower else Uplo.Lower
     out = TriangularMatrix.from_global(
-        inv, T.layout.mb, T.layout.nb, grid=T.grid, uplo=T.uplo, diag=T.diag
+        inv, T.layout.mb, T.layout.nb, grid=T.grid, uplo=out_uplo, diag=T.diag
     )
     return out
 

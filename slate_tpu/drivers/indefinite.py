@@ -4,11 +4,13 @@ LTL^H to band, hetrs.cc, hesv.cc).
 The reference's Aasen algorithm (panel factor + band reduction with
 partial pivoting inside the panel sub-communicator) is built around
 fine-grained row exchanges that map poorly to static TPU schedules.  Here
-hetrf computes a blocked LDL^H without pivoting, optionally after a
-random butterfly randomization (gesv_rbt rationale: randomization replaces
-pivoting on schedule-hostile hardware); one step of iterative refinement
-in hesv restores accuracy.  The factor object matches the L D L^H
-contract, so hetrs is two unit-triangular solves + a diagonal scale.
+hetrf computes a blocked LDL^H without pivoting; when that breaks down
+(zero/non-finite D entry — e.g. a singular leading minor of a genuinely
+indefinite matrix), it refactors after a two-sided random butterfly
+congruence A' = U^H A U (gesv_rbt rationale: randomization replaces
+pivoting on schedule-hostile hardware).  The butterfly, when used, rides
+on the returned factor and hetrs applies it transparently; iterative
+refinement in hesv restores accuracy either way.
 """
 
 from __future__ import annotations
@@ -16,15 +18,37 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-from ..enums import Option, Side, Uplo
+from ..enums import Op, Side, Uplo
 from ..exceptions import slate_assert
 from ..matrix.base import conj_transpose
 from ..matrix.matrix import HermitianMatrix, Matrix, TriangularMatrix
-from ..options import Options, get_option
+from ..options import Options
 from ..parallel.layout import tiles_from_global
 from . import lu as lu_mod
+from .lu import _apply_butterfly, _butterfly_diags
+
+
+def _ldl_nopiv(Af: jnp.ndarray, mb: int, grid, opts):
+    """No-pivot LDL^H of a full Hermitian 2D array via getrf_nopiv."""
+    Am = Matrix.from_global(Af, mb, grid=grid)
+    LU, info = lu_mod.getrf_nopiv(Am, opts)
+    G = LU.to_global()
+    # A = L U with U = D L^H for Hermitian A  =>  D = diag(U)
+    d = jnp.real(jnp.diagonal(G))
+    n = Af.shape[0]
+    L = TriangularMatrix.from_global(
+        jnp.tril(G, -1) + jnp.eye(n, dtype=G.dtype),
+        mb,
+        mb,
+        grid=grid,
+        uplo=Uplo.Lower,
+    )
+    bad = (d == 0) | ~jnp.isfinite(d)
+    info = jnp.maximum(info, jnp.where(jnp.any(bad), 1, 0)).astype(jnp.int32)
+    return L, d, info
 
 
 def hetrf(
@@ -34,37 +58,97 @@ def hetrf(
     (reference contract: src/hetrf.cc; see module docstring for the
     pivot-free TPU algorithm).
 
-    Returns (L, d, info)."""
+    Returns (L, d, info).  If the pivot-free pass breaks down, L carries a
+    random-butterfly congruence (L._rbt) and factors U^H A U instead;
+    hetrs consumes it transparently, so (L, d) remains a valid solve
+    factor for A either way."""
     slate_assert(A.m == A.n, "hetrf requires square")
     Af = A.full_global()
     lay = A.layout
-    Am = Matrix.from_global(Af, lay.mb, lay.nb, grid=A.grid)
-    LU, info = lu_mod.getrf_nopiv(Am, opts)
-    G = LU.to_global()
-    # A = L U with U = D L^H for Hermitian A  =>  D = diag(U)
-    d = jnp.real(jnp.diagonal(G))
-    L = TriangularMatrix.from_global(
-        jnp.tril(G, -1) + jnp.eye(A.n, dtype=G.dtype),
-        lay.mb,
-        lay.nb,
-        grid=A.grid,
-        uplo=Uplo.Lower,
+    L, d, info = _ldl_nopiv(Af, lay.mb, A.grid, opts)
+    try:
+        broke = bool(info != 0)
+    except Exception:
+        # Traced (inside jit): the breakdown branch cannot be taken, and
+        # the butterfly fallback marker would be stripped at the pytree
+        # boundary, silently mis-pairing hetrs with the wrong factor.
+        raise TypeError(
+            "hetrf breakdown detection needs a concrete info value; call "
+            "hetrf/hesv outside jit (the reference's hetrf is likewise a "
+            "host-driven algorithm)"
+        ) from None
+    if not broke:
+        return L, d, info
+    # breakdown: randomize with a Hermitian-preserving butterfly congruence
+    # A' = U^H A U, pad to a power of 2 with an identity block so the
+    # static-shape butterfly stays invertible (gesv_rbt structure).
+    n = A.n
+    n2 = 1 << int(np.ceil(np.log2(max(n, 1))))
+    # Full-depth butterfly, unconditionally: depth-2 (gesv_rbt's default)
+    # only mixes at coarse strides and leaves fine-grained singular-minor
+    # structure (e.g. kron(I, [[0,1],[1,0]])) intact; log2(n) levels mix
+    # every pair.  Deliberately NOT Option.Depth: that key tunes gesv_rbt
+    # and a shared opts dict must not weaken this fallback.
+    depth = max(int(np.log2(n2)), 1)
+    Ap = jnp.pad(Af, ((0, n2 - n), (0, n2 - n)))
+    Ap = Ap + jnp.diag(
+        jnp.concatenate([jnp.zeros(n), jnp.ones(n2 - n)]).astype(Af.dtype)
     )
-    bad = (d == 0) | ~jnp.isfinite(d)
-    info = jnp.maximum(info, jnp.where(jnp.any(bad), 1, 0)).astype(jnp.int32)
-    return L, d, info
+    du = _butterfly_diags(n2, depth, 1729, jnp.float64)
+    if A.is_complex:
+        # complex phases: a real congruence cannot break the structure of
+        # purely-imaginary Hermitian matrices (i*K keeps a zero diagonal
+        # under any real U^T A U)
+        from ..matgen.philox import random_jnp
+
+        idx = jnp.arange(depth * n2, dtype=jnp.int64).reshape(depth, n2)
+        ph = random_jnp(
+            "uniform_signed", 4242, idx, jnp.zeros_like(idx), jnp.float64
+        )
+        du = (du * jnp.exp(1j * np.pi * ph)).astype(Af.dtype)
+    else:
+        du = du.astype(Af.dtype)
+    Ar = _apply_butterfly(Ap, jnp.conj(du), transpose=True)  # U^H A
+    Ar = _apply_butterfly(Ar.T, du, transpose=True).T  # (U^H A) U
+    Lr, dr, info_r = _ldl_nopiv(Ar, min(lay.mb, n2), A.grid, opts)
+    Lr._rbt = (du, n)
+    return Lr, dr, info_r
 
 
 def hetrs(
     L: TriangularMatrix, d: jnp.ndarray, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:
-    """Solve A X = B from the L D L^H factor (reference: src/hetrs.cc)."""
+    """Solve A X = B from the L D L^H factor (reference: src/hetrs.cc).
+
+    Handles both the plain factor and the butterfly-randomized fallback
+    (L._rbt set by hetrf): A x = b  <=>  (U^H A U) y = U^H b, x = U y."""
     from . import blas3
 
-    Y = blas3.trsm(Side.Left, 1.0, L, B, opts)
-    Yg = Y.to_global() / jnp.where(d == 0, 1, d)[:, None].astype(B.dtype)
-    Ym = B._with(data=tiles_from_global(Yg.astype(B.dtype), B.layout))
-    return blas3.trsm(Side.Left, 1.0, conj_transpose(L), Ym, opts)
+    rbt = getattr(L, "_rbt", None)
+    if rbt is None:
+        Y = blas3.trsm(Side.Left, 1.0, L, B, opts)
+        Yg = Y.to_global() / jnp.where(d == 0, 1, d)[:, None].astype(B.dtype)
+        Ym = B._with(data=tiles_from_global(Yg.astype(B.dtype), B.layout))
+        return blas3.trsm(Side.Left, 1.0, conj_transpose(L), Ym, opts)
+
+    du, n = rbt
+    n2 = L.n
+    B2 = B.to_global()
+    Bp = jnp.pad(B2, ((0, n2 - n), (0, 0)))
+    Rp = _apply_butterfly(Bp, jnp.conj(du), transpose=True)  # U^H b
+    Lg = L._with(op=Op.NoTrans).to_global()
+    Y = lax.linalg.triangular_solve(
+        Lg, Rp, left_side=True, lower=True, unit_diagonal=True
+    )
+    Y = Y / jnp.where(d == 0, 1, d)[:, None].astype(B.dtype)
+    Z = lax.linalg.triangular_solve(
+        jnp.conj(Lg).T if L.is_complex else Lg.T,
+        Y,
+        left_side=True,
+        lower=False,
+    )
+    X = _apply_butterfly(Z, du, transpose=False)[:n]
+    return B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
 
 
 def hesv(

@@ -6,10 +6,17 @@ value of element (i, j) depends only on (seed, i, j), never on tiling or
 process count, which is what makes rank-count-independent verification
 possible (SURVEY §4).
 
-Implemented twice with identical bit-exact results:
+Implemented twice:
   * numpy (vectorized uint64) — host-side generation for compat buffers;
   * jax (uint32-pair arithmetic) — device-side generation inside jit,
     usable under shard_map so every process generates only its local tiles.
+
+Bit-exactness between the two paths holds for the uniform/binary
+families (pure integer pipeline + one exact float scale).  The
+transcendental distributions (normal, unit_circle, unit_disk) agree only
+to a few ULPs (libm vs XLA transcendentals), and accelerator backends may
+round the final f64 scale differently (~1e-16 relative); verification
+comparisons for those families must be tolerance-based, not bitwise.
 
 The jax path avoids uint64 entirely (TPUs have no native 64-bit integer
 units) by carrying each 64-bit lane as a (hi32, lo32) pair.

@@ -112,8 +112,16 @@ def larft(V: jnp.ndarray, taus: jnp.ndarray) -> jnp.ndarray:
     """Build the nb x nb T factor from unit-lower V (m x nb) and taus.
 
     V must have the unit diagonal materialized (V[j, j] == 1, zeros above).
+
+    taus may be shorter than V.shape[1] (XLA geqrf returns min(m, n) taus
+    for a short panel with fewer rows than columns); missing reflectors are
+    treated as absent (tau == 0), which zeroes their T rows/columns.
     """
     nb = V.shape[1]
+    if taus.shape[0] < nb:
+        taus = jnp.concatenate(
+            [taus, jnp.zeros((nb - taus.shape[0],), taus.dtype)]
+        )
     complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
     VhV = (jnp.conj(V).T if complex_t else V.T) @ V
     U = jnp.triu(VhV, 1)

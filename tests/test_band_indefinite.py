@@ -180,3 +180,28 @@ def test_public_api_surface():
         "ProcessGrid", "TileLayout", "Pivots", "TriangularFactors",
     ):
         assert hasattr(st, name), name
+
+
+def test_hesv_zero_leading_minors(rng):
+    """hetrf must survive exactly-singular leading minors via the RBT
+    fallback (reference hetrf's Aasen pivoting handles these natively)."""
+    import jax.numpy as jnp
+
+    n = 32
+    A0 = np.kron(np.eye(n // 2), np.array([[0.0, 1.0], [1.0, 0.0]]))
+    B0 = rng.standard_normal((n, 3))
+    A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
+    X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
+    assert hasattr(L, "_rbt")
+    assert np.abs(A0 @ np.asarray(X.to_global()) - B0).max() < 1e-8
+
+
+def test_hesv_zero_minors_complex(rng):
+    import jax.numpy as jnp
+
+    n = 16
+    A0 = np.kron(np.eye(n // 2), np.array([[0, 1j], [-1j, 0]])).astype(complex)
+    B0 = (rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2)))
+    A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
+    X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
+    assert np.abs(A0 @ np.asarray(X.to_global()) - B0).max() < 1e-8

@@ -162,3 +162,32 @@ def test_bdsqr(rng):
     np.testing.assert_allclose(
         np.asarray(s), np.linalg.svd(B, compute_uv=False), atol=1e-12
     )
+
+
+@pytest.mark.parametrize("n,nb", [(50, 16), (23, 8)])
+def test_heev_ragged(rng, n, nb):
+    """Ragged last panel: rows < taus columns in larft (regression for the
+    short-panel crash at n % nb != 0; reference he2hb.cc:174-185 handles
+    short panels via per-group batching)."""
+    A0 = _herm(rng, n)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    w, Z = eig.heev(A)
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(A0), atol=1e-9)
+    Zg = np.asarray(Z.to_global())
+    R = A0 @ Zg - Zg * np.asarray(w)[None, :]
+    assert np.abs(R).max() < 1e-8
+
+
+@pytest.mark.parametrize("m,n,nb", [(50, 50, 16), (50, 34, 16), (34, 50, 16)])
+def test_svd_ragged(rng, m, n, nb):
+    A0 = rng.standard_normal((m, n))
+    A = Matrix.from_global(A0, nb)
+    s, U, Vh = svd_mod.svd(A, vectors=True)
+    np.testing.assert_allclose(
+        np.asarray(s), np.linalg.svd(A0, compute_uv=False), atol=1e-10
+    )
+    k = min(m, n)
+    Ug = np.asarray(U.to_global())[:, :k]
+    Vhg = np.asarray(Vh.to_global())[:k]
+    rec = (Ug * np.asarray(s)[None, :k]) @ Vhg
+    assert np.abs(rec - A0).max() < 1e-8, np.abs(rec - A0).max()
