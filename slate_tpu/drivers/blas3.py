@@ -35,8 +35,8 @@ from ..matrix.matrix import (
 )
 from ..options import Options, get_option
 from ..ops import blas2d
-from ..parallel import spmd_blas
-from ..parallel.layout import tiles_from_global
+from ..parallel import spmd_blas, spmd_trsm
+from ..parallel.layout import eye_splice, tiles_from_global
 
 
 def _is_distributed(M: BaseMatrix) -> bool:
@@ -200,13 +200,46 @@ def trmm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix
     return _repack_like(out, B)
 
 
+def _trsm_spmd_ok(A: TriangularMatrix, B: Matrix) -> bool:
+    layT, layB = A.layout, B.layout
+    return (
+        layT.m == layT.n
+        and layT.mb == layT.nb == layB.mb
+        and (layT.p, layT.q) == (layB.p, layB.q)
+        and layT.nt == layB.mt
+        and B.op == Op.NoTrans
+    )
+
+
 def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
     """Solve op(A) X = alpha B (or right) (reference: src/trsm.cc ->
     trsmA/trsmB work pipelines, src/work/work_trsm.cc).
 
     Global path: one XLA triangular_solve (internally blocked/pipelined by
     XLA — the work_trsm row pipeline is the compiler's job on TPU).
+    SPMD path (left side, distributed): the shard_map row pipeline in
+    parallel/spmd_trsm.py — no gather of A or B to a global array.
     """
+    if (
+        side == Side.Left
+        and _is_distributed(B)
+        and get_option(opts, Option.UseShardMap)
+        and _trsm_spmd_ok(A, B)
+    ):
+        TT = eye_splice(A.layout, A.data)
+        data = spmd_trsm.spmd_trsm_left(
+            B.grid,
+            TT,
+            A.layout,
+            B.data,
+            B.layout,
+            lower=A.uplo == Uplo.Lower,
+            trans=A.op != Op.NoTrans,
+            conj=A.op == Op.ConjTrans,
+            unit_diag=A.diag == Diag.Unit,
+            alpha=alpha,
+        )
+        return B._with(data=data)
     A2 = A._with(op=Op.NoTrans).to_global()
     out = blas2d.trsm2d(side, A.uplo, A.op, A.diag, alpha, A2, B.to_global())
     return _repack_like(out, B)

@@ -1,0 +1,190 @@
+"""Distributed triangular solve + row permutation over the mesh.
+
+TPU-native re-design of the reference's trsm work pipelines (reference:
+src/trsm.cc:1-150 -> trsmA/trsmB dispatch, src/work/work_trsm.cc:106-140 —
+per-k: tileBcast of the diagonal block down the column, internal::trsm of
+block row k, listBcast of X's row k, internal::gemm trailing update with
+lookahead) and of internal_swap.cc's pivot row exchanges.
+
+The TPU schedule per step k (inside one lax.fori_loop, static shapes):
+
+1. **factor column/row gather**: rebuild the tiles op(T)(i, k) needed by
+   this process's local rows — one all_gather over the 'q' axis (NoTrans:
+   T's tile column k stays row-distributed) or an all_gather + psum
+   broadcast (Trans/ConjTrans: T's tile row k lives on one process row) —
+   replacing the reference's per-tile MPI broadcasts with ICI collectives;
+2. **block-row solve**: the owner process row triangular-solves
+   op(T)(k,k)^-1 B(k,:) locally and the result is psum-broadcast down the
+   'p' axis (work_trsm.cc's bcast of the solved row);
+3. **trailing update**: B(i,:) -= op(T)(i,k) X(k,:) for the not-yet-solved
+   local rows — one masked einsum over the local tile stack, the analogue
+   of internal::gemm's one batched device call.
+
+Forward (effective-lower) solves run k = 0..nt-1; backward
+(effective-upper) run k = nt-1..0; both directions share the same step.
+
+Unlike the reference there is no stationary-A variant: on TPU the solved
+row broadcast rides ICI and XLA overlaps it with the trailing einsum, so
+the single pipeline covers both regimes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
+from ..parallel.layout import TileLayout
+from .spmd_blas import shard_map
+
+
+def spmd_trsm_left(
+    grid: ProcessGrid,
+    TT: jnp.ndarray,
+    layT: TileLayout,
+    TB: jnp.ndarray,
+    layB: TileLayout,
+    *,
+    lower: bool,
+    trans: bool,
+    conj: bool,
+    unit_diag: bool,
+    alpha=1.0,
+) -> jnp.ndarray:
+    """Solve op(T) X = alpha B in place of B's tile array.
+
+    TT: storage-order tiles of the square triangular matrix (mb == nb;
+    padding diagonal spliced to 1 by the caller, see layout.eye_splice).
+    ``lower`` refers to the *storage* triangle; ``trans``/``conj`` give the
+    op of the view being solved.  Only the relevant triangle of TT is read,
+    so an LU-packed tile array works for both its L and U solves.
+    """
+    p, q = grid.p, grid.q
+    assert layT.m == layT.n and layT.mb == layT.nb, "trsm T must be square tiles"
+    assert layT.mb == layB.mb, "T/B tile-row mismatch"
+    assert (layT.p, layT.q) == (layB.p, layB.q) == (p, q), "grid mismatch"
+    nt = layT.nt
+    assert layB.mt == nt, "T/B tile-count mismatch"
+    mtlT, ntlT = layT.mtl, layT.ntl
+    mtlB = layB.mtl
+    mb = layT.mb
+    eff_lower = lower != trans  # triangle of op(T)
+    forward = eff_lower
+    complex_t = jnp.issubdtype(TT.dtype, jnp.complexfloating)
+    do_conj = conj and complex_t
+
+    def local(tt, tb):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtlB) * p + r  # global tile rows of local B rows
+
+        tb = (jnp.asarray(alpha, tb.dtype) * tb) if alpha != 1.0 else tb
+
+        def step(kk, tb):
+            k = kk if forward else nt - 1 - kk
+
+            # -- 1. tiles op(T)(gi, k) for local rows + replicated diag ---
+            if not trans:
+                col_loc = lax.dynamic_slice_in_dim(tt, k // q, 1, axis=1)[:, 0]
+                col_q = lax.all_gather(col_loc, COL_AXIS)  # (q, mtlT, mb, mb)
+                left_tiles = lax.dynamic_index_in_dim(
+                    col_q, k % q, 0, keepdims=False
+                )  # (mtlT, mb, mb) = T(gi, k)
+                own_diag = r == (k % p)
+                dcand = lax.dynamic_index_in_dim(
+                    left_tiles, k // p, 0, keepdims=False
+                )
+                Tkk = lax.psum(
+                    jnp.where(own_diag, dcand, jnp.zeros_like(dcand)), ROW_AXIS
+                )
+            else:
+                row_loc = lax.dynamic_index_in_dim(tt, k // p, 0, keepdims=False)
+                row_q = lax.all_gather(row_loc, COL_AXIS)  # (q, ntlT, mb, mb)
+                row_full = row_q.reshape(q * ntlT, mb, mb)
+                own_row_T = r == (k % p)
+                row_full = lax.psum(
+                    jnp.where(own_row_T, row_full, jnp.zeros_like(row_full)),
+                    ROW_AXIS,
+                )  # replicated T(k, :) in storage-column order
+                slots = (gi % q) * ntlT + gi // q
+                sel = row_full[slots]  # T(k, gi)
+                left_tiles = jnp.swapaxes(sel, -1, -2)
+                dslot = (k % q) * ntlT + k // q
+                Tkk = jnp.swapaxes(row_full[dslot], -1, -2)
+                if do_conj:
+                    left_tiles = jnp.conj(left_tiles)
+                    Tkk = jnp.conj(Tkk)
+
+            # -- 2. solve block row k on its owner process row ------------
+            row_tiles = lax.dynamic_index_in_dim(tb, k // p, 0, keepdims=False)
+            X_row = lax.linalg.triangular_solve(
+                jnp.broadcast_to(Tkk, row_tiles.shape[:1] + Tkk.shape),
+                row_tiles,
+                left_side=True,
+                lower=eff_lower,
+                unit_diagonal=unit_diag,
+            )
+            own_row = r == (k % p)
+            X_row = lax.psum(
+                jnp.where(own_row, X_row, jnp.zeros_like(X_row)), ROW_AXIS
+            )
+            new_row = jnp.where(own_row, X_row, row_tiles)
+            tb = lax.dynamic_update_index_in_dim(tb, new_row, k // p, axis=0)
+
+            # -- 3. trailing update over not-yet-solved local rows --------
+            mask_i = (gi > k) if forward else (gi < k)
+            left_act = jnp.where(
+                mask_i[:, None, None], left_tiles, jnp.zeros_like(left_tiles)
+            )
+            upd = jnp.einsum("iab,jbc->ijac", left_act, X_row)
+            return tb - upd.astype(tb.dtype)
+
+        return lax.fori_loop(0, nt, step, tb)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(local, mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(TT, TB)
+
+
+def spmd_permute_rows(
+    grid: ProcessGrid,
+    TB: jnp.ndarray,
+    layB: TileLayout,
+    perm: jnp.ndarray,
+) -> jnp.ndarray:
+    """Apply a global row permutation: new row i = old row perm[i].
+
+    TPU-native analogue of internal::permuteRows (reference:
+    internal_swap.cc:115-370 — per-row MPI exchanges with the pivot root):
+    every destination row is fetched from its owner with one masked psum
+    over the 'p' axis.  ``perm`` indexes the padded natural element rows
+    (length layB.P * mb), as produced by spmd_lu.spmd_getrf.
+    """
+    p = layB.p
+    mtl, mb = layB.mtl, layB.mb
+    P_ = layB.P
+
+    def local(tb, perm):
+        # The psum must carry contributions for EVERY destination row (all
+        # process rows sum the same array), so fetch the full padded row
+        # space and extract the local tile rows afterwards.
+        r = lax.axis_index(ROW_AXIS)
+        src = perm  # (P_*mb,) source element row of each dest row
+        sti = src // mb
+        sli = sti // p
+        soff = src % mb
+        own = (sti % p) == r
+        vals = jax.vmap(lambda l, o: tb[l, :, o, :])(sli, soff)
+        vals = jnp.where(own[:, None, None], vals, jnp.zeros_like(vals))
+        vals = lax.psum(vals, ROW_AXIS)  # (P_*mb, ntl, nb)
+        vals = vals.reshape(P_, mb, tb.shape[1], tb.shape[3])
+        gi = jnp.arange(mtl) * p + r  # global tile rows stored locally
+        return vals[gi].transpose(0, 2, 1, 3)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local, mesh=grid.mesh, in_specs=(spec, P()), out_specs=spec
+    )
+    return fn(TB, perm.astype(jnp.int32))

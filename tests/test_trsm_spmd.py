@@ -1,0 +1,165 @@
+"""SPMD triangular-solve pipeline tests (reference: test/test_trsm.cc;
+the distributed solve stages of test_gesv.cc / test_posv.cc).
+
+These exercise parallel/spmd_trsm.py — the shard_map row pipeline — both
+directly and through the drivers, and assert the drivers do route
+distributed solves through it (no global gather in the hot path).
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import blas3, chol, lu
+from slate_tpu.enums import Diag, Option, Side, Uplo
+from slate_tpu.matrix.base import conj_transpose, transpose
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix, TriangularMatrix
+from slate_tpu.parallel import spmd_trsm
+from slate_tpu.testing import checks
+
+
+def _lower(rng, n, dtype=np.float64):
+    L = np.tril(rng.standard_normal((n, n)))
+    if np.dtype(dtype).kind == "c":
+        L = L + 1j * np.tril(rng.standard_normal((n, n)))
+    return (L + n * np.eye(n)).astype(dtype)
+
+
+@pytest.mark.parametrize("n,nb", [(64, 16), (50, 16), (72, 8)])
+def test_trsm_lower_distributed(rng, grid22, n, nb):
+    L0 = _lower(rng, n)
+    B0 = rng.standard_normal((n, 12))
+    L = TriangularMatrix.from_global(L0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Left, 1.0, L, B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()), np.linalg.solve(L0, B0), atol=1e-12
+    )
+
+
+@pytest.mark.parametrize("alpha", [1.0, -2.5])
+def test_trsm_upper_distributed(rng, grid22, alpha):
+    n, nb = 60, 16
+    U0 = np.triu(rng.standard_normal((n, n))) + n * np.eye(n)
+    B0 = rng.standard_normal((n, 8))
+    U = TriangularMatrix.from_global(U0, nb, grid=grid22, uplo=Uplo.Upper)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Left, alpha, U, B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()), np.linalg.solve(U0, alpha * B0), atol=1e-12
+    )
+
+
+def test_trsm_transposed_view_distributed(rng, grid22):
+    """L^T X = B runs the backward (row-gather) pipeline."""
+    n, nb = 50, 16
+    L0 = _lower(rng, n)
+    B0 = rng.standard_normal((n, 8))
+    L = TriangularMatrix.from_global(L0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Left, 1.0, transpose(L), B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()), np.linalg.solve(L0.T, B0), atol=1e-12
+    )
+
+
+def test_trsm_conj_transpose_complex_distributed(rng, grid42):
+    n, nb = 64, 8
+    L0 = _lower(rng, n, np.complex128)
+    B0 = rng.standard_normal((n, 8)) + 1j * rng.standard_normal((n, 8))
+    L = TriangularMatrix.from_global(L0, nb, grid=grid42, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid42)
+    X = blas3.trsm(Side.Left, 1.0, conj_transpose(L), B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()), np.linalg.solve(L0.conj().T, B0), atol=1e-12
+    )
+
+
+def test_trsm_unit_diag_distributed(rng, grid22):
+    n, nb = 48, 16
+    L0 = np.tril(rng.standard_normal((n, n)), -1)
+    B0 = rng.standard_normal((n, 4))
+    L = TriangularMatrix.from_global(
+        L0 + 7.0 * np.eye(n), nb, grid=grid22, uplo=Uplo.Lower, diag=Diag.Unit
+    )
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Left, 1.0, L, B)
+    # unit diag: stored diagonal (7.0) must be ignored
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()),
+        np.linalg.solve(L0 + np.eye(n), B0),
+        atol=1e-12,
+    )
+
+
+def test_spmd_permute_rows(rng, grid22):
+    n, nb = 50, 16
+    B0 = rng.standard_normal((n, 8))
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    m_pad = B.layout.P * B.layout.mb
+    perm = np.arange(m_pad)
+    rng.shuffle(perm[:n])  # padding rows stay in place
+    out = spmd_trsm.spmd_permute_rows(
+        grid22, B.data, B.layout, np.asarray(perm, np.int32)
+    )
+    got = np.asarray(Matrix(out, B.layout, grid=grid22).to_global())
+    np.testing.assert_allclose(got, B0[perm[:n]], atol=0)
+
+
+def test_getrs_distributed_no_gather(rng, grid22, monkeypatch):
+    """gesv distributed must not gather LU or B to global in the solve."""
+    n, nb = 96, 16
+    M0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    B0 = rng.standard_normal((n, 16))
+    Am = Matrix.from_global(M0, nb, grid=grid22)
+    Bm = Matrix.from_global(B0, nb, grid=grid22)
+    LU, piv, info = lu.getrf(Am)
+    assert int(info) == 0
+
+    calls = {"n": 0}
+    orig = spmd_trsm.spmd_trsm_left
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(spmd_trsm, "spmd_trsm_left", counting)
+    X = lu.getrs(LU, piv, Bm)
+    assert calls["n"] == 2, "distributed getrs must use the SPMD trsm path"
+    err = checks.solve_residual(M0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_posv_distributed_spmd_solve(rng, grid22, monkeypatch):
+    n, nb = 96, 16
+    A0 = rng.standard_normal((n, n))
+    A0 = A0 @ A0.T + n * np.eye(n)
+    B0 = rng.standard_normal((n, 8))
+    A = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+
+    calls = {"n": 0}
+    orig = spmd_trsm.spmd_trsm_left
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(spmd_trsm, "spmd_trsm_left", counting)
+    X, L, info = chol.posv(A, B)
+    assert int(info) == 0
+    assert calls["n"] == 2, "distributed potrs must use the SPMD trsm path"
+    err = checks.solve_residual(A0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
+
+
+def test_gesv_distributed_ragged(rng, grid42):
+    n, nb = 90, 16  # ragged last tile across a 4x2 grid
+    M0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    B0 = rng.standard_normal((n, 4))
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(M0, nb, grid=grid42),
+        Matrix.from_global(B0, nb, grid=grid42),
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(M0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=30), err
