@@ -141,11 +141,14 @@ def _gathered_band_eig(
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Stage 2+: eigensolve the gathered band matrix on one device via the
     XLA vendor eigensolver (reference analogue: gathered hb2st + LAPACK
-    steqr/stedc on one node, heev.cc:135-180)."""
-    if vectors:
-        w, Z = jnp.linalg.eigh(band_2d)
-        return w, Z
-    return jnp.linalg.eigvalsh(band_2d), None
+    steqr/stedc on one node, heev.cc:135-180).
+
+    On TPU f64 the vendor eigh stops ~1e-7 short of working precision;
+    ops/jacobi.py's parallel-order Jacobi polish restores LAPACK-level
+    accuracy (SURVEY §7 hard-part (5))."""
+    from ..ops.jacobi import eigh_accurate
+
+    return eigh_accurate(band_2d, vectors=vectors)
 
 
 def heev(
@@ -174,9 +177,10 @@ def heev(
 def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
     """Eigenvalues of a symmetric tridiagonal matrix, no vectors
     (reference: src/sterf.cc QL/QR iteration).  Vendor eigensolver on the
-    assembled tridiagonal."""
+    assembled tridiagonal, Jacobi-polished on TPU f64."""
     Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
-    return jnp.linalg.eigvalsh(Tm)
+    w, _ = _gathered_band_eig(Tm, vectors=False)
+    return w
 
 
 def steqr(

@@ -24,7 +24,8 @@ from ..exceptions import slate_assert
 from ..matrix.base import BaseMatrix
 from ..matrix.matrix import Matrix, TriangularMatrix
 from ..options import Options, get_option
-from ..parallel import spmd_lu
+from ..ops import lu_kernels
+from ..parallel import spmd_lu, spmd_trsm
 from ..parallel.layout import eye_splice, tiles_from_global, tiles_to_global
 from ..types import Pivots
 from . import blas3
@@ -50,6 +51,24 @@ def _padded_global(A: BaseMatrix, splice_diag=True) -> jnp.ndarray:
     return Gp
 
 
+def _lu_dense(A2: jnp.ndarray, nb: int = 32) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """LU of an unpadded square array with platform dispatch; returns
+    (LU[:n,:n], perm[:n]).  Pads to a multiple of nb with a unit diagonal
+    so the native blocked kernel sees static full tiles."""
+    n = A2.shape[0]
+    if lu_kernels.lu_supported(A2.dtype):
+        lu2d, _, perm = lax.linalg.lu(A2)
+        return lu2d, perm.astype(jnp.int32)
+    npad = -(-n // nb) * nb
+    Gp = jnp.pad(A2, ((0, npad - n), (0, npad - n)))
+    Gp = Gp + jnp.diag(
+        jnp.concatenate([jnp.zeros(n), jnp.ones(npad - n)]).astype(A2.dtype)
+    )
+    LU, perm = lu_kernels.blocked_getrf(Gp, nb)
+    # padding rows can never be pivoted into the leading n rows
+    return LU[:n, :n], perm[:n]
+
+
 def getrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Pivots, jnp.ndarray]:
@@ -69,8 +88,10 @@ def getrf(
         m_valid = lay.m
     else:
         Gp = _padded_global(A)
-        lu2d, _, perm = lax.linalg.lu(Gp)
-        perm = perm.astype(jnp.int32)
+        # vendor LU when the backend supports the dtype (TPU: f32/c64
+        # only), else the native blocked right-looking kernel
+        # (ops/lu_kernels.py; reference: src/getrf.cc:85-214)
+        lu2d, perm = lu_kernels.lu_global(Gp, lay.nb)
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
         m_valid = lay.m
 
@@ -163,7 +184,36 @@ def getrs(
     opts: Optional[Options] = None,
 ) -> Matrix:
     """Solve A X = B from getrf factors (reference: src/getrs.cc:
-    permuteRows forward, trsm L, trsm U)."""
+    permuteRows forward, trsm L, trsm U).
+
+    Distributed path: SPMD permute-rows + two shard_map trsm pipelines
+    over the LU-packed tile array — B never gathers to a global array
+    (reference: internal::permuteRows + work::trsm, getrs.cc)."""
+    lay = LU.layout
+    layB = B.layout
+    if (
+        _is_distributed(B)
+        and get_option(opts, Option.UseShardMap)
+        and lay.mb == lay.nb == layB.mb
+        and (lay.p, lay.q) == (layB.p, layB.q)
+        and layB.mt == lay.mt
+        and LU.op == Op.NoTrans
+        and B.op == Op.NoTrans
+        and (pivots is None or pivots.perm.shape[0] == lay.P * lay.mb)
+    ):
+        TBd = B.data
+        if pivots is not None:
+            TBd = spmd_trsm.spmd_permute_rows(B.grid, TBd, layB, pivots.perm)
+        TT = eye_splice(lay, LU.data)
+        Y = spmd_trsm.spmd_trsm_left(
+            B.grid, TT, lay, TBd, layB,
+            lower=True, trans=False, conj=False, unit_diag=True,
+        )
+        X = spmd_trsm.spmd_trsm_left(
+            B.grid, TT, lay, Y, layB,
+            lower=False, trans=False, conj=False, unit_diag=False,
+        )
+        return B._with(data=X)
     G = LU.to_global()
     B2 = B.to_global()
     if pivots is not None:
@@ -378,7 +428,7 @@ def gesv_mixed(
             break
         X = X + solve_lo(R)
     if not converged and use_fallback:
-        lu_w, _, perm_w = lax.linalg.lu(A2)
+        lu_w, perm_w = _lu_dense(A2)
         Y = lax.linalg.triangular_solve(
             lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True
         )
@@ -455,7 +505,7 @@ def gesv_mixed_gmres(
     ok = bool(jnp.abs(R).max() <= 10 * tol * float(anorm) * float(jnp.abs(X).max()) + 1e-300)
     iters = restart
     if not ok and bool(get_option(opts, Option.UseFallbackSolver, True)):
-        lu_w, _, perm_w = lax.linalg.lu(A2)
+        lu_w, perm_w = _lu_dense(A2)
         Y = lax.linalg.triangular_solve(
             lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True
         )

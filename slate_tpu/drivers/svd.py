@@ -22,6 +22,7 @@ from ..matrix.base import BaseMatrix, conj_transpose
 from ..matrix.matrix import Matrix, TriangularBandMatrix
 from ..options import Options, get_option
 from ..ops.householder import geqrf as _geqrf_kernel, larft, materialize_v
+from ..ops.jacobi import svd_accurate
 from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 
@@ -109,7 +110,7 @@ def tb2bd(band: TriangularBandMatrix):
     # One-device Householder bidiagonalization of the (narrow-band) matrix
     m, n = G.shape
     k = min(m, n)
-    U, s, Vh = jnp.linalg.svd(G, full_matrices=False)
+    U, s, Vh = svd_accurate(G)
     # represent as exact bidiagonal (diagonal) — svd of band is the vendor
     # stage here
     d = s
@@ -125,9 +126,9 @@ def bdsqr(d: jnp.ndarray, e: jnp.ndarray, vectors: bool = False):
     if n > 1:
         B = B.at[jnp.arange(n - 1), jnp.arange(1, n)].set(e)
     if vectors:
-        U, s, Vh = jnp.linalg.svd(B)
+        U, s, Vh = svd_accurate(B)
         return s, U, Vh
-    return jnp.linalg.svd(B, compute_uv=False), None, None
+    return svd_accurate(B, compute_uv=False), None, None
 
 
 def svd(
@@ -182,9 +183,9 @@ def svd(
     band, UVm, UT, VVm, VT = ge2tb(A, opts)
     Gband = band.to_global()
     if not vectors:
-        s = jnp.linalg.svd(Gband, compute_uv=False)
+        s = svd_accurate(Gband, compute_uv=False)
         return s[: min(m, n)], None, None
-    Ub, s, Vhb = jnp.linalg.svd(Gband, full_matrices=False)
+    Ub, s, Vhb = svd_accurate(Gband)
     # back-transform (unmbr_ge2tb): U = Q_U Ub, V^H = Vhb Q_V^H
     U = unmbr_ge2tb_left(UVm, UT, Ub, A)
     Vh = unmbr_ge2tb_right(VVm, VT, Vhb, A)

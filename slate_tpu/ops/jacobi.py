@@ -1,0 +1,269 @@
+"""Parallel-order Jacobi polishing for eigen/SVD accuracy on TPU.
+
+XLA's TPU eigh/svd are Jacobi-type iterations that stop around 1e-7-1e-8
+relative residual in f64 — five orders short of the reference's LAPACK
+accuracy (reference acceptance: test_heev.cc residual <= tol*eps).  These
+kernels polish a vendor (or any) approximate decomposition to full working
+precision with round-robin parallel-order Jacobi sweeps: each round
+rotates n/2 *disjoint* index pairs simultaneously, so one round is two
+row/column pair-updates over the whole matrix — vectorized, static-shape,
+MXU/VPU friendly.  Near-diagonal input converges in 1-3 sweeps
+(quadratic convergence).
+
+This is the TPU answer to SURVEY §7 hard-part (5) (f64 parity on
+low-precision-first hardware) for the spectral routines; the reference
+gets it for free from LAPACK steqr/bdsqr on the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _round_robin(n: int) -> np.ndarray:
+    """Static (n-1, n//2, 2) round-robin pairing schedule (n even):
+    every round is a perfect matching; over n-1 rounds every pair meets."""
+    assert n % 2 == 0
+    arr = list(range(1, n))
+    rounds = []
+    for _ in range(n - 1):
+        cur = [0] + arr
+        pairs = [
+            (min(cur[i], cur[n - 1 - i]), max(cur[i], cur[n - 1 - i]))
+            for i in range(n // 2)
+        ]
+        rounds.append(pairs)
+        arr = arr[-1:] + arr[:-1]
+    return np.asarray(rounds, dtype=np.int32)
+
+
+def _rotation(app, aqq, apq):
+    """Jacobi rotation (c, s, u) zeroing the (p, q) coupling of the 2x2
+    [[app, apq], [conj(apq), aqq]]: G = [[c, s*u], [-s*conj(u), c]].
+
+    TPU note: f64 emulation keeps float32's exponent range, so tau*tau
+    overflows to NaN already around |tau| ~ 1e19.  Large tau takes the
+    asymptotic branch t = 1/(2 tau) (relative error ~ 1/(4 tau^2), below
+    eps for |tau| > 1e8), and couplings below eps * (|app| + |aqq|) are
+    skipped outright — their rotation angle is under eps anyway.
+    """
+    absa = jnp.abs(apq)
+    real_t = absa.dtype
+    eps = jnp.finfo(real_t).eps
+    diag_mag = jnp.abs(jnp.real(app)) + jnp.abs(jnp.real(aqq))
+    negligible = absa <= 0.25 * eps * diag_mag
+    skip = (absa == 0) | negligible
+    safe = jnp.where(skip, jnp.ones_like(absa), absa)
+    u = jnp.where(skip, jnp.ones_like(apq), apq / safe)
+    tau = (jnp.real(aqq) - jnp.real(app)) / (2 * safe)
+    big = jnp.abs(tau) > 1e8
+    tau_s = jnp.where(big, jnp.ones_like(tau), tau)
+    t_small = jnp.sign(tau_s) / (jnp.abs(tau_s) + jnp.sqrt(1 + tau_s * tau_s))
+    t_big = 1.0 / (2.0 * jnp.where(big, tau, jnp.ones_like(tau)))
+    t = jnp.where(big, t_big, t_small)
+    t = jnp.where(tau == 0, jnp.ones_like(t), t)
+    c = 1.0 / jnp.sqrt(1 + t * t)
+    s = t * c
+    c = jnp.where(skip, jnp.ones_like(c), c).astype(real_t)
+    s = jnp.where(skip, jnp.zeros_like(s), s).astype(real_t)
+    return c, s, u
+
+
+def _offdiag_norm(M):
+    off = M - jnp.diag(jnp.diag(M))
+    return jnp.linalg.norm(off)
+
+
+@partial(jax.jit, static_argnames=("max_sweeps", "want_vectors"))
+def jacobi_eigh_polish(
+    A: jnp.ndarray, V0: jnp.ndarray, max_sweeps: int = 12,
+    want_vectors: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Polish an approximate eigenbasis V0 of Hermitian A to working
+    precision.  Returns (w ascending, V with matching columns).
+
+    M = V0^H A V0 is near-diagonal; parallel-order Jacobi sweeps drive the
+    off-diagonal below n*eps*||A|| while accumulating rotations into V.
+    """
+    n = A.shape[0]
+    complex_t = jnp.issubdtype(A.dtype, jnp.complexfloating)
+    npad = n + (n % 2)
+    sched = jnp.asarray(_round_robin(npad))
+    R, m, _ = sched.shape
+
+    M = V0.conj().T @ A @ V0 if complex_t else V0.T @ A @ V0
+    M = 0.5 * (M + M.conj().T)
+    V = V0
+    if npad != n:
+        big = 2.0 * jnp.max(jnp.abs(jnp.diag(M))) + 1.0
+        M = jnp.pad(M, ((0, 1), (0, 1)))
+        M = M.at[n, n].set(big.astype(M.dtype))
+        V = jnp.pad(V, ((0, 1), (0, 1)))
+        V = V.at[n, n].set(1.0)
+
+    eps = jnp.finfo(jnp.real(M).dtype).eps
+    scale = jnp.linalg.norm(M)
+    tol = eps * scale * npad
+
+    def conj_u(u):
+        return jnp.conj(u) if complex_t else u
+
+    def one_round(r, carry):
+        M, V = carry
+        pq = sched[r]
+        p, q = pq[:, 0], pq[:, 1]
+        c, s, u = _rotation(M[p, p], M[q, q], M[p, q])
+        cu = c if not complex_t else c.astype(M.dtype)
+        su_r = (s * u) if complex_t else s * jnp.real(u)
+        # columns: M G, V G
+        Mp, Mq = M[:, p], M[:, q]
+        M = M.at[:, p].set(cu * Mp - s * conj_u(u) * Mq)
+        M = M.at[:, q].set(su_r * Mp + cu * Mq)
+        if want_vectors:
+            Vp, Vq = V[:, p], V[:, q]
+            V = V.at[:, p].set(cu * Vp - s * conj_u(u) * Vq)
+            V = V.at[:, q].set(su_r * Vp + cu * Vq)
+        # rows: G^H M (coefficients broadcast over the row axis)
+        Rp, Rq = M[p, :], M[q, :]
+        M = M.at[p, :].set(cu[:, None] * Rp - su_r[:, None] * Rq)
+        M = M.at[q, :].set((s * conj_u(u))[:, None] * Rp + cu[:, None] * Rq)
+        return M, V
+
+    def one_sweep(carry):
+        M, V, it = carry
+        M, V = lax.fori_loop(0, R, one_round, (M, V))
+        return M, V, it + 1
+
+    def keep_going(carry):
+        M, _, it = carry
+        return (it < max_sweeps) & (_offdiag_norm(M) > tol)
+
+    M, V, _ = lax.while_loop(keep_going, one_sweep, (M, V, 0))
+
+    w = jnp.real(jnp.diag(M))[:n]
+    V = V[:n, :n]
+    order = jnp.argsort(w)
+    return w[order], V[:, order]
+
+
+@partial(jax.jit, static_argnames=("max_sweeps",))
+def jacobi_svd_polish(
+    A: jnp.ndarray, V0: jnp.ndarray, max_sweeps: int = 12
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Polish an approximate right singular basis V0 of square A.
+
+    One-sided Jacobi on B = A V0: rotate column pairs of B (and V)
+    until mutually orthogonal; then s = ||b_j||, U = B diag(1/s).
+    Returns (U, s descending, V).
+    """
+    n = A.shape[0]
+    complex_t = jnp.issubdtype(A.dtype, jnp.complexfloating)
+    npad = n + (n % 2)
+    sched = jnp.asarray(_round_robin(npad))
+    R, m, _ = sched.shape
+
+    B = A @ V0
+    V = V0
+    if npad != n:
+        B = jnp.pad(B, ((0, 1), (0, 1)))
+        B = B.at[n, n].set(1.0)
+        V = jnp.pad(V, ((0, 1), (0, 1)))
+        V = V.at[n, n].set(1.0)
+
+    eps = jnp.finfo(jnp.real(B).dtype).eps
+    fro = jnp.linalg.norm(B)
+    tol2 = eps * fro * fro * npad  # <bp,bq> scale threshold
+
+    def conj_u(u):
+        return jnp.conj(u) if complex_t else u
+
+    def one_round(r, carry):
+        B, V = carry
+        pq = sched[r]
+        p, q = pq[:, 0], pq[:, 1]
+        Bp, Bq = B[:, p], B[:, q]
+        x = jnp.sum(jnp.abs(Bp) ** 2, axis=0)
+        y = jnp.sum(jnp.abs(Bq) ** 2, axis=0)
+        z = jnp.sum(jnp.conj(Bp) * Bq, axis=0)
+        c, s, u = _rotation(x, y, z)
+        cu = c if not complex_t else c.astype(B.dtype)
+        su_r = (s * u) if complex_t else s * jnp.real(u)
+        B = B.at[:, p].set(cu * Bp - s * conj_u(u) * Bq)
+        B = B.at[:, q].set(su_r * Bp + cu * Bq)
+        Vp, Vq = V[:, p], V[:, q]
+        V = V.at[:, p].set(cu * Vp - s * conj_u(u) * Vq)
+        V = V.at[:, q].set(su_r * Vp + cu * Vq)
+        return B, V
+
+    def gram_off(B):
+        G = B.conj().T @ B
+        return jnp.linalg.norm(G - jnp.diag(jnp.diag(G)))
+
+    def one_sweep(carry):
+        B, V, it = carry
+        B, V = lax.fori_loop(0, R, one_round, (B, V))
+        return B, V, it + 1
+
+    def keep_going(carry):
+        B, _, it = carry
+        return (it < max_sweeps) & (gram_off(B) > tol2)
+
+    B, V, _ = lax.while_loop(keep_going, one_sweep, (B, V, 0))
+
+    # U from a QR of the (orthogonal-columned) B: R is diagonal to within
+    # the sweep tolerance, and QR's orthonormal completion covers zero
+    # columns (rank-deficient A), unlike a plain column normalization.
+    Q, Rr = lax.linalg.qr(B, full_matrices=False)
+    rd = jnp.diagonal(Rr)
+    s = jnp.abs(rd)
+    phase = jnp.where(s == 0, jnp.ones_like(rd), rd / jnp.where(s == 0, 1, s))
+    U = Q * phase[None, :]
+    s, U, V = s[:n], U[:n, :n], V[:n, :n]
+    order = jnp.argsort(-s)
+    return U[:, order], s[order], V[:, order]
+
+
+def eigh_accurate(
+    A: jnp.ndarray, vectors: bool = True
+) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Vendor eigh + Jacobi polish when the backend's eigh is inexact
+    (TPU f64); plain vendor eigh/eigvalsh elsewhere."""
+    if jax.default_backend() == "cpu" or jnp.finfo(jnp.real(A).dtype).bits <= 32:
+        if vectors:
+            return jnp.linalg.eigh(A)
+        return jnp.linalg.eigvalsh(A), None
+    w, V = jnp.linalg.eigh(A)
+    w, V = jacobi_eigh_polish(A, V, want_vectors=vectors)
+    return (w, V) if vectors else (w, None)
+
+
+def svd_accurate(A: jnp.ndarray, compute_uv: bool = True):
+    """Vendor svd + one-sided Jacobi polish on TPU f64.
+
+    Rectangular inputs are QR/LQ-pre-reduced to the square core first
+    (the TPU vendor QR is full-accuracy, unlike its svd); returns
+    (U, s, Vh) matching jnp.linalg.svd(full_matrices=False), or just s
+    when compute_uv=False (the vendor's singular *values* are already
+    accurate; only the vectors need polishing).
+    """
+    if not compute_uv:
+        return jnp.linalg.svd(A, compute_uv=False)
+    if jax.default_backend() == "cpu" or jnp.finfo(jnp.real(A).dtype).bits <= 32:
+        return jnp.linalg.svd(A, full_matrices=False)
+    m, n = A.shape
+    if m > n:
+        Q, R = lax.linalg.qr(A, full_matrices=False)
+        U2, s, Vh = svd_accurate(R)
+        return Q @ U2, s, Vh
+    if m < n:
+        U2, s, Vh2 = svd_accurate(A.conj().T)
+        return Vh2.conj().T, s, U2.conj().T
+    _, _, Vh = jnp.linalg.svd(A, full_matrices=False)
+    U2, s2, V2 = jacobi_svd_polish(A, Vh.conj().T)
+    return U2, s2, V2.conj().T

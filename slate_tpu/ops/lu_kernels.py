@@ -1,0 +1,139 @@
+"""Native blocked LU kernels (no vendor LuDecomposition).
+
+The XLA TPU backend implements lax.linalg.lu only for f32/c64; the
+reference's own blocked right-looking getrf (reference: src/getrf.cc:85-214
+— panel factor, pivot broadcast, row swaps, trailing update) is the model
+for the f64/c128 path here.  Everything is static-shape fori_loop code:
+
+* ``panel_lu``     — unblocked partial-pivot LU of one (M, nb) panel,
+  the analogue of the reference's threaded panel kernel
+  (Tile_getrf.hh:164-452) with the per-column argmax done by lax.argmax
+  over the whole gathered panel instead of a thread/MPI reduction tree.
+* ``blocked_getrf`` — right-looking blocked LU over the padded global
+  array: per step the panel is rolled to the top (static shapes), factored
+  redundantly, row swaps applied as one gather, then one triangular solve
+  + one matmul for the trailing update (getrf.cc:183-214's permuteRows +
+  trsm + gemm fused into three XLA ops).
+
+Used by drivers/lu.py whenever the platform lacks a vendor LU for the
+dtype, and by parallel/spmd_lu.py for the in-loop panel factor.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def panel_lu(panel: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Unblocked partial-pivot LU of an (M, nb) panel.
+
+    Returns (lu, perm) with lu holding unit-lower L below the diagonal and
+    U on/above, and perm the forward permutation: lu rows correspond to
+    panel[perm].  Matches lax.linalg.lu's (lu, _, permutation) contract.
+    Zero pivot columns produce zero L columns (flagged by the caller's
+    info check), not NaNs.
+    """
+    M, nb = panel.shape
+    rows = jnp.arange(M)
+
+    def body(j, carry):
+        a, perm = carry
+        col = a[:, j]
+        mag = jnp.where(rows >= j, jnp.abs(col), -jnp.inf)
+        piv = jnp.argmax(mag)
+        # swap rows j <-> piv (gather-free: two dynamic row updates)
+        rj = a[j]
+        rp = a[piv]
+        a = a.at[j].set(rp).at[piv].set(rj)
+        pj = perm[j]
+        pp = perm[piv]
+        perm = perm.at[j].set(pp).at[piv].set(pj)
+        pivot = a[j, j]
+        safe = jnp.where(pivot == 0, jnp.ones_like(pivot), pivot)
+        l = jnp.where((rows > j) & (pivot != 0), a[:, j] / safe, jnp.zeros(M, a.dtype))
+        a = a.at[:, j].set(jnp.where(rows > j, l, a[:, j]))
+        urow = jnp.where(jnp.arange(nb) > j, a[j], jnp.zeros(nb, a.dtype))
+        return a - jnp.outer(l, urow), perm
+
+    perm0 = jnp.arange(M, dtype=jnp.int32)
+    lu, perm = lax.fori_loop(0, min(M, nb), body, (panel, perm0))
+    return lu, perm
+
+
+def blocked_getrf(
+    Gp: jnp.ndarray, nb: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked right-looking LU with partial pivoting of a padded array.
+
+    Gp: (Mp, Np) with Mp, Np multiples of nb and the padding diagonal
+    spliced to 1 (layout.eye_splice semantics).  Returns (LU, perm) with
+    perm the net forward row permutation: LU = (L\\U) of Gp[perm].
+    Reference: src/getrf.cc:85-214.
+    """
+    Mp, Np = Gp.shape
+    kt = min(Mp, Np) // nb
+    rows = jnp.arange(Mp)
+    cols = jnp.arange(Np)
+
+    def step(k, carry):
+        G, perm = carry
+        # -- panel: roll active rows to the top, factor ----------------
+        col = lax.dynamic_slice(G, (0, k * nb), (Mp, nb))
+        colr = jnp.roll(col, -k * nb, axis=0)
+        active_len = Mp - k * nb
+        colr = jnp.where((rows < active_len)[:, None], colr, jnp.zeros_like(colr))
+        lu_pan, piv = panel_lu(colr)
+        # step permutation in global row space (identity above the panel)
+        act = rows - k * nb
+        mapped = piv[jnp.clip(act, 0, Mp - 1)] + k * nb
+        step_perm = jnp.where(act >= 0, mapped, rows)
+        # -- row exchange across the whole matrix ----------------------
+        G = G[step_perm]
+        perm = perm[step_perm]
+        # -- write the factored panel back (rows >= k*nb) ---------------
+        lu_nat = jnp.roll(lu_pan, k * nb, axis=0)
+        col_cur = lax.dynamic_slice(G, (0, k * nb), (Mp, nb))
+        col_new = jnp.where((rows >= k * nb)[:, None], lu_nat, col_cur)
+        G = lax.dynamic_update_slice(G, col_new, (0, k * nb))
+        # -- U row: Lkk^-1 A(k, j>k) ------------------------------------
+        Lkk = jnp.tril(lu_pan[:nb], -1) + jnp.eye(nb, dtype=G.dtype)
+        row = lax.dynamic_slice(G, (k * nb, 0), (nb, Np))
+        rs = lax.linalg.triangular_solve(
+            Lkk, row, left_side=True, lower=True, unit_diagonal=True
+        )
+        row_new = jnp.where((cols >= (k + 1) * nb)[None, :], rs, row)
+        G = lax.dynamic_update_slice(G, row_new, (k * nb, 0))
+        # -- trailing update --------------------------------------------
+        Lpan = jnp.where((rows >= (k + 1) * nb)[:, None], col_new, 0)
+        Urow = jnp.where((cols >= (k + 1) * nb)[None, :], row_new, 0)
+        return G - Lpan @ Urow, perm
+
+    perm0 = jnp.arange(Mp, dtype=jnp.int32)
+    return lax.fori_loop(0, kt, step, (Gp, perm0))
+
+
+def lu_supported(dtype) -> bool:
+    """Whether the vendor lax.linalg.lu compiles for this dtype on the
+    current default backend (TPU: f32/c64 only)."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return True
+    return jnp.dtype(dtype).itemsize <= 4 or jnp.issubdtype(
+        jnp.dtype(dtype), jnp.complexfloating
+    ) and jnp.dtype(dtype).itemsize <= 8
+
+
+def lu_global(Gp: jnp.ndarray, nb: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Vendor LU when supported, native blocked LU otherwise.
+
+    Returns (LU, perm), perm over Gp's (padded) rows.
+    """
+    if lu_supported(Gp.dtype):
+        lu2d, _, perm = lax.linalg.lu(Gp)
+        return lu2d, perm.astype(jnp.int32)
+    LU, perm = blocked_getrf(Gp, nb)
+    return LU, perm

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..ops.lu_kernels import lu_supported, panel_lu
 from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
@@ -125,7 +126,12 @@ def spmd_getrf(
             )
 
             # -- 2. redundant panel LU ------------------------------------
-            lu_pan, _, piv_perm = lax.linalg.lu(panel_act)
+            # vendor LU where the backend supports the dtype; the native
+            # unblocked panel kernel otherwise (TPU f64/c128)
+            if lu_supported(panel_act.dtype):
+                lu_pan, _, piv_perm = lax.linalg.lu(panel_act)
+            else:
+                lu_pan, piv_perm = panel_lu(panel_act)
             # piv_perm (active frame): permuted[i] = panel_act[piv_perm[i]]
             # -> global step permutation, identity above the panel
             act_idx = g_rows - k * mb

@@ -1,0 +1,101 @@
+"""Parallel-order Jacobi polish kernels (ops/jacobi.py) — the TPU-f64
+accuracy layer for spectral routines (SURVEY §7 hard-part (5)).
+
+On CPU eigh/svd are already exact, so these tests feed the polishers a
+*perturbed* starting basis and check they recover working precision.
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.ops import jacobi
+
+
+def _perturbed_basis(rng, V, scale=1e-7):
+    """Orthonormal basis a small rotation away from V (mimics the TPU
+    vendor eigh's ~1e-7 residual)."""
+    n = V.shape[0]
+    E = rng.standard_normal((n, n)) * scale
+    if np.iscomplexobj(V):
+        E = E + 1j * rng.standard_normal((n, n)) * scale
+    Q, _ = np.linalg.qr(V + V @ E)
+    return Q
+
+
+@pytest.mark.parametrize("n", [16, 50, 65])
+def test_eigh_polish_real(rng, n):
+    A = rng.standard_normal((n, n))
+    S = (A + A.T) / 2
+    w_ref, V_ref = np.linalg.eigh(S)
+    V0 = _perturbed_basis(rng, V_ref)
+    w, V = jacobi.jacobi_eigh_polish(S, V0)
+    w, V = np.asarray(w), np.asarray(V)
+    res = np.abs(S @ V - V * w[None, :]).max() / max(np.abs(S).max(), 1)
+    assert res < 1e-13, res
+    assert np.abs(V.T @ V - np.eye(n)).max() < 1e-13
+    np.testing.assert_allclose(w, w_ref, atol=1e-12 * np.abs(w_ref).max())
+
+
+def test_eigh_polish_complex(rng):
+    n = 40
+    A = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    H = (A + A.conj().T) / 2
+    w_ref, V_ref = np.linalg.eigh(H)
+    V0 = _perturbed_basis(rng, V_ref)
+    w, V = jacobi.jacobi_eigh_polish(H.astype(np.complex128), V0)
+    w, V = np.asarray(w), np.asarray(V)
+    res = np.abs(H @ V - V * w[None, :]).max() / np.abs(H).max()
+    assert res < 1e-13, res
+    assert np.abs(V.conj().T @ V - np.eye(n)).max() < 1e-13
+
+
+def test_eigh_polish_clustered(rng):
+    """Tight eigenvalue clusters: the invariant-subspace residual must
+    still reach working precision (Jacobi handles clusters natively)."""
+    n = 32
+    w_true = np.sort(np.concatenate([np.ones(8), np.ones(8) + 1e-12,
+                                     rng.standard_normal(16) * 10]))
+    Q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    S = (Q * w_true[None, :]) @ Q.T
+    S = (S + S.T) / 2
+    V0 = _perturbed_basis(rng, Q)
+    w, V = jacobi.jacobi_eigh_polish(S, V0)
+    w, V = np.asarray(w), np.asarray(V)
+    res = np.abs(S @ V - V * w[None, :]).max() / np.abs(S).max()
+    assert res < 1e-12, res
+
+
+@pytest.mark.parametrize("n", [16, 50])
+def test_svd_polish(rng, n):
+    A = rng.standard_normal((n, n))
+    U_ref, s_ref, Vh_ref = np.linalg.svd(A)
+    V0 = _perturbed_basis(rng, Vh_ref.T)
+    U, s, V = jacobi.jacobi_svd_polish(A, V0)
+    U, s, V = np.asarray(U), np.asarray(s), np.asarray(V)
+    res = np.abs((U * s[None, :]) @ V.T - A).max() / np.abs(A).max()
+    assert res < 1e-13, res
+    assert np.abs(U.T @ U - np.eye(n)).max() < 1e-12
+    np.testing.assert_allclose(s, s_ref, atol=1e-12 * s_ref.max())
+
+
+def test_svd_polish_complex(rng):
+    n = 24
+    A = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    U_ref, s_ref, Vh_ref = np.linalg.svd(A)
+    V0 = _perturbed_basis(rng, Vh_ref.conj().T)
+    U, s, V = jacobi.jacobi_svd_polish(A.astype(np.complex128), V0)
+    U, s, V = np.asarray(U), np.asarray(s), np.asarray(V)
+    res = np.abs((U * s[None, :]) @ V.conj().T - A).max() / np.abs(A).max()
+    assert res < 1e-13, res
+    np.testing.assert_allclose(s, s_ref, atol=1e-12 * s_ref.max())
+
+
+def test_accurate_wrappers_cpu_passthrough(rng):
+    """On CPU the wrappers are the vendor kernels (no polish cost)."""
+    n = 20
+    A = rng.standard_normal((n, n))
+    S = (A + A.T) / 2
+    w, V = jacobi.eigh_accurate(S)
+    np.testing.assert_allclose(np.asarray(w), np.linalg.eigvalsh(S), atol=1e-12)
+    U, s, Vh = jacobi.svd_accurate(A)
+    np.testing.assert_allclose(np.asarray(s), np.linalg.svd(A, compute_uv=False), atol=1e-12)
