@@ -1,0 +1,10 @@
+"""Compatibility surfaces (reference: scalapack_api/, lapack_api/).
+
+- compat.scalapack: BLACS grid + descriptor ingestion, p?gemm/p?potrf/
+  p?getrf/p?gesv/p?posv/p?geqrf/p?trsm/p?lange over ScaLAPACK-layout
+  per-process buffers.
+- compat.lapack: slate_?gemm/... single-node LAPACK-style entry points
+  over plain numpy arrays.
+"""
+
+from . import lapack, scalapack  # noqa: F401

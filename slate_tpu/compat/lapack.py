@@ -1,0 +1,234 @@
+"""LAPACK compatibility surface (reference: lapack_api/lapack_slate.hh:
+34-92, lapack_api/lapack_*.cc — the single-node `slate_dgetrf` etc. ABI).
+
+Each entry point takes plain numpy arrays in LAPACK's calling shapes,
+routes through the slate_tpu drivers on the default (single-chip) layout,
+and returns results functionally (no aliasing surprises; the reference
+shim mutates user buffers because LAPACK's ABI demands it — a Python
+surface does not).  Tile size comes from SLATE_LAPACK_NB (reference env
+singletons, lapack_slate.hh:60-78), default 256.
+
+Typed aliases slate_sgemm / slate_dgemm / ... are generated for all
+routines, mirroring the reference's macro-expanded symbols.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..enums import Diag, Norm, Op, Side, Uplo
+
+_OP = {"n": Op.NoTrans, "t": Op.Trans, "c": Op.ConjTrans}
+_UPLO = {"l": Uplo.Lower, "u": Uplo.Upper}
+_SIDE = {"l": Side.Left, "r": Side.Right}
+_DIAG = {"n": Diag.NonUnit, "u": Diag.Unit}
+
+
+def _nb(n: int) -> int:
+    return min(int(os.environ.get("SLATE_LAPACK_NB", 256)), max(int(n), 1))
+
+
+def _op_apply(M, trans):
+    from ..matrix.base import conj_transpose, transpose
+
+    op = _OP[trans.lower()[0]]
+    if op == Op.Trans:
+        return transpose(M)
+    if op == Op.ConjTrans:
+        return conj_transpose(M)
+    return M
+
+
+def gemm(transa, transb, alpha, A: np.ndarray, B: np.ndarray, beta, C: np.ndarray):
+    """C = alpha op(A) op(B) + beta C (reference: lapack_api/lapack_gemm.cc)."""
+    from ..drivers import blas3
+    from ..matrix.matrix import Matrix
+
+    nb = _nb(max(C.shape))
+    Am = _op_apply(Matrix.from_global(np.asarray(A), nb), transa)
+    Bm = _op_apply(Matrix.from_global(np.asarray(B), nb), transb)
+    Cm = Matrix.from_global(np.asarray(C), nb)
+    return np.asarray(blas3.gemm(alpha, Am, Bm, beta, Cm).to_global())
+
+
+def getrf(A: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+    """LU: returns (LU, perm, info) (reference: lapack_api/lapack_getrf.cc)."""
+    from ..drivers import lu
+    from ..matrix.matrix import Matrix
+
+    Am = Matrix.from_global(np.asarray(A), _nb(min(A.shape)))
+    LU, piv, info = lu.getrf(Am)
+    return np.asarray(LU.to_global()), np.asarray(piv.perm), int(info)
+
+
+def getrs(trans, LU: np.ndarray, perm: np.ndarray, B: np.ndarray) -> np.ndarray:
+    from jax import lax
+
+    from ..drivers import lu
+    from ..matrix.matrix import Matrix
+    from ..types import Pivots
+
+    n = LU.shape[0]
+    op = _OP[trans.lower()[0]]
+    if op == Op.NoTrans:
+        nb = _nb(n)
+        LUm = Matrix.from_global(np.asarray(LU), nb)
+        Bm = Matrix.from_global(np.asarray(B), nb)
+        X = lu.getrs(LUm, Pivots(np.asarray(perm)), Bm)
+        return np.asarray(X.to_global())
+    # op(A) X = B with A = P^T L U:  A^T = U^T L^T P, so solve
+    # U^T Y = B, L^T Z = Y, X = P^T Z (inverse permutation).
+    import jax.numpy as jnp
+
+    G = jnp.asarray(LU)
+    conj = op == Op.ConjTrans and np.iscomplexobj(LU)
+    Y = lax.linalg.triangular_solve(
+        G, jnp.asarray(B), left_side=True, lower=False,
+        transpose_a=True, conjugate_a=conj,
+    )
+    Z = lax.linalg.triangular_solve(
+        G, Y, left_side=True, lower=True, unit_diagonal=True,
+        transpose_a=True, conjugate_a=conj,
+    )
+    p = np.asarray(perm)[:n]
+    inv = np.empty_like(p)
+    inv[p] = np.arange(n, dtype=p.dtype)
+    return np.asarray(Z)[inv]
+
+
+def gesv(A: np.ndarray, B: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Solve AX=B; returns (X, info)."""
+    from ..drivers import lu
+    from ..matrix.matrix import Matrix
+
+    nb = _nb(A.shape[0])
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(np.asarray(A), nb), Matrix.from_global(np.asarray(B), nb)
+    )
+    return np.asarray(X.to_global()), int(info)
+
+
+def potrf(uplo, A: np.ndarray) -> Tuple[np.ndarray, int]:
+    from ..drivers import chol
+    from ..matrix.matrix import HermitianMatrix
+
+    up = _UPLO[uplo.lower()[0]]
+    Am = HermitianMatrix.from_global(np.asarray(A), _nb(A.shape[0]), uplo=up)
+    L, info = chol.potrf(Am)
+    Lg = np.asarray(L.to_global())
+    return (np.tril(Lg) if up == Uplo.Lower else np.triu(Lg)), int(info)
+
+
+def posv(uplo, A: np.ndarray, B: np.ndarray) -> Tuple[np.ndarray, int]:
+    from ..drivers import chol
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    up = _UPLO[uplo.lower()[0]]
+    nb = _nb(A.shape[0])
+    X, L, info = chol.posv(
+        HermitianMatrix.from_global(np.asarray(A), nb, uplo=up),
+        Matrix.from_global(np.asarray(B), nb),
+    )
+    return np.asarray(X.to_global()), int(info)
+
+
+def trsm(side, uplo, transa, diag, alpha, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    from ..drivers import blas3
+    from ..matrix.matrix import Matrix, TriangularMatrix
+
+    nb = _nb(A.shape[0])
+    Am = TriangularMatrix.from_global(
+        np.asarray(A), nb, uplo=_UPLO[uplo.lower()[0]], diag=_DIAG[diag.lower()[0]]
+    )
+    Am = _op_apply(Am, transa)
+    Bm = Matrix.from_global(np.asarray(B), nb)
+    return np.asarray(blas3.trsm(_SIDE[side.lower()[0]], alpha, Am, Bm).to_global())
+
+
+def geqrf(A: np.ndarray):
+    """Returns (QR-packed, T-factors) (reference: lapack_api/lapack_geqrf.cc)."""
+    from ..drivers import qr
+    from ..matrix.matrix import Matrix
+
+    fac, T = qr.geqrf(Matrix.from_global(np.asarray(A), _nb(min(A.shape))))
+    return np.asarray(fac.to_global()), T
+
+
+def gels(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    from ..drivers import qr
+    from ..matrix.matrix import Matrix
+
+    nb = _nb(min(A.shape))
+    X = qr.gels(Matrix.from_global(np.asarray(A), nb),
+                Matrix.from_global(np.asarray(B), nb))
+    return np.asarray(X.to_global())
+
+
+def heev(jobz, uplo, A: np.ndarray):
+    """Returns (w, Z or None, info) (reference: lapack_api/lapack_heev.cc)."""
+    from ..drivers import eig
+    from ..matrix.matrix import HermitianMatrix
+
+    Am = HermitianMatrix.from_global(
+        np.asarray(A), _nb(A.shape[0]), uplo=_UPLO[uplo.lower()[0]]
+    )
+    vectors = jobz.lower().startswith("v")
+    w, Z = eig.heev(Am, vectors=vectors)
+    return np.asarray(w), (np.asarray(Z.to_global()) if Z is not None else None), 0
+
+
+def syev(jobz, uplo, A):
+    return heev(jobz, uplo, A)
+
+
+def gesvd(jobu, jobvt, A: np.ndarray):
+    """Returns (s, U or None, VH or None) (reference: lapack_api svd)."""
+    from ..drivers import svd as svd_mod
+    from ..matrix.matrix import Matrix
+
+    vectors = jobu.lower().startswith(("a", "s")) or jobvt.lower().startswith(("a", "s"))
+    s, U, Vh = svd_mod.svd(
+        Matrix.from_global(np.asarray(A), _nb(min(A.shape))), vectors=vectors
+    )
+    return (
+        np.asarray(s),
+        np.asarray(U.to_global()) if U is not None else None,
+        np.asarray(Vh.to_global()) if Vh is not None else None,
+    )
+
+
+def lange(norm, A: np.ndarray) -> float:
+    from ..drivers import aux
+    from ..matrix.matrix import Matrix
+
+    Am = Matrix.from_global(np.asarray(A), _nb(max(A.shape)))
+    nt = {"m": Norm.Max, "1": Norm.One, "o": Norm.One, "i": Norm.Inf,
+          "f": Norm.Fro, "e": Norm.Fro}[norm.lower()[0]]
+    return float(aux.norm(nt, Am))
+
+
+def _typed(name: str, fn):
+    """slate_sgemm / slate_dgemm / ... aliases (the reference's generated
+    lapack_api symbol set, lapack_slate.hh:34-92)."""
+
+    def make(tc):
+        def wrapper(*args, **kw):
+            return fn(*args, **kw)
+
+        wrapper.__name__ = f"slate_{tc}{name}"
+        wrapper.__doc__ = f"Typed LAPACK shim slate_{tc}{name} -> {fn.__name__}."
+        return wrapper
+
+    return {f"slate_{tc}{name}": make(tc) for tc in "sdcz"}
+
+
+_g = globals()
+for _name, _fn in [
+    ("gemm", gemm), ("getrf", getrf), ("getrs", getrs), ("gesv", gesv),
+    ("potrf", potrf), ("posv", posv), ("trsm", trsm), ("geqrf", geqrf),
+    ("gels", gels), ("heev", heev), ("gesvd", gesvd), ("lange", lange),
+]:
+    _g.update(_typed(_name, _fn))

@@ -1,0 +1,334 @@
+"""ScaLAPACK compatibility surface (reference: scalapack_api/
+scalapack_slate.hh:144-372, scalapack_gemm.cc:24-148, scalapack_*.cc).
+
+The reference's shim runs inside each MPI rank: it reads the BLACS grid
+with Cblacs_gridinfo, wraps the rank's local block-cyclic buffer zero-copy
+via Matrix::fromScaLAPACK, and calls SLATE.  On TPU there is one host
+process driving the mesh, so the shim ingests *all* per-process local
+buffers (or one replicated global array), assembles the matrix onto the
+slate_tpu block-cyclic layout, runs the driver, and scatters results back
+into ScaLAPACK-layout buffers:
+
+    grid = BlacsGrid(p=2, q=2)
+    desc = descinit(m, n, mb, nb, grid)
+    locs = to_scalapack(desc, A_global)        # dict {(pr,pc): buffer}
+    info = pdpotrf("L", n, locs, desc)          # in-place, like ScaLAPACK
+
+Index math (numroc / l2g maps) follows the ScaLAPACK TOOLS conventions so
+buffers round-trip bit-exactly with real ScaLAPACK layouts.  Env
+configuration mirrors the reference shim: SLATE_SCALAPACK_VERBOSE and
+SLATE_SCALAPACK_NB (scalapack_slate.hh:325, :144-372).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..enums import Diag, Norm, Op, Side, Uplo
+from ..exceptions import DimensionError, slate_assert
+
+_TYPE_CHAR = {"s": np.float32, "d": np.float64, "c": np.complex64, "z": np.complex128}
+
+
+def _verbose() -> bool:
+    return os.environ.get("SLATE_SCALAPACK_VERBOSE", "0") not in ("", "0")
+
+
+@dataclass(frozen=True)
+class BlacsGrid:
+    """A p x q BLACS-style process grid (reference: Cblacs_gridinfo use in
+    scalapack_gemm.cc:36-44).  Row-major process numbering by default,
+    matching BLACS 'R' ordering."""
+
+    p: int
+    q: int
+
+    @property
+    def size(self) -> int:
+        return self.p * self.q
+
+
+@dataclass(frozen=True)
+class Desc:
+    """ScaLAPACK array descriptor (DESC_) — dtype tag omitted; the numpy
+    buffers carry their dtype."""
+
+    m: int
+    n: int
+    mb: int
+    nb: int
+    rsrc: int
+    csrc: int
+    grid: BlacsGrid
+
+    def __post_init__(self):
+        slate_assert(self.rsrc == 0 and self.csrc == 0, "rsrc/csrc != 0 unsupported")
+
+
+def descinit(m: int, n: int, mb: int, nb: int, grid: BlacsGrid) -> Desc:
+    """descinit_ analogue (rsrc = csrc = 0)."""
+    return Desc(m, n, mb, nb, 0, 0, grid)
+
+
+def numroc(n: int, nb: int, iproc: int, isrc: int, nprocs: int) -> int:
+    """Number of rows/cols of a distributed array owned by process iproc
+    (ScaLAPACK TOOLS/numroc.f semantics)."""
+    mydist = (nprocs + iproc - isrc) % nprocs
+    nblocks = n // nb
+    num = (nblocks // nprocs) * nb
+    extrablks = nblocks % nprocs
+    if mydist < extrablks:
+        num += nb
+    elif mydist == extrablks:
+        num += n % nb
+    return num
+
+
+def _local_rows(desc: Desc, pr: int) -> int:
+    return numroc(desc.m, desc.mb, pr, desc.rsrc, desc.grid.p)
+
+
+def _local_cols(desc: Desc, pc: int) -> int:
+    return numroc(desc.n, desc.nb, pc, desc.csrc, desc.grid.q)
+
+
+def _global_indices(n: int, nb: int, iproc: int, nprocs: int) -> np.ndarray:
+    """Global indices (0-based) of the local rows/cols owned by iproc, in
+    local storage order (ScaLAPACK INDXL2G)."""
+    loc = numroc(n, nb, iproc, 0, nprocs)
+    lidx = np.arange(loc)
+    lblk = lidx // nb
+    return (lblk * nprocs + iproc) * nb + lidx % nb
+
+
+def alloc_locals(desc: Desc, dtype) -> Dict[Tuple[int, int], np.ndarray]:
+    """Allocate zeroed local buffers for every grid process (column-major,
+    shape (lld, nloc) like ScaLAPACK's lld x locc storage)."""
+    out = {}
+    for pr in range(desc.grid.p):
+        for pc in range(desc.grid.q):
+            out[(pr, pc)] = np.zeros(
+                (_local_rows(desc, pr), _local_cols(desc, pc)), dtype=dtype, order="F"
+            )
+    return out
+
+
+def to_scalapack(desc: Desc, A: np.ndarray) -> Dict[Tuple[int, int], np.ndarray]:
+    """Scatter a global (m, n) array into per-process ScaLAPACK buffers."""
+    if A.shape != (desc.m, desc.n):
+        raise DimensionError(f"expected {(desc.m, desc.n)}, got {A.shape}")
+    out = {}
+    for pr in range(desc.grid.p):
+        gi = _global_indices(desc.m, desc.mb, pr, desc.grid.p)
+        for pc in range(desc.grid.q):
+            gj = _global_indices(desc.n, desc.nb, pc, desc.grid.q)
+            out[(pr, pc)] = np.asfortranarray(A[np.ix_(gi, gj)])
+    return out
+
+
+def from_scalapack(
+    desc: Desc, locals_: Dict[Tuple[int, int], np.ndarray]
+) -> np.ndarray:
+    """Assemble per-process ScaLAPACK buffers into the global array
+    (Matrix::fromScaLAPACK semantics, reference Matrix.hh:73-99)."""
+    dtype = next(iter(locals_.values())).dtype
+    A = np.zeros((desc.m, desc.n), dtype=dtype)
+    for pr in range(desc.grid.p):
+        gi = _global_indices(desc.m, desc.mb, pr, desc.grid.p)
+        for pc in range(desc.grid.q):
+            gj = _global_indices(desc.n, desc.nb, pc, desc.grid.q)
+            buf = locals_[(pr, pc)]
+            slate_assert(
+                buf.shape == (len(gi), len(gj)),
+                f"local buffer {(pr, pc)} shape {buf.shape} != {(len(gi), len(gj))}",
+            )
+            A[np.ix_(gi, gj)] = buf
+    return A
+
+
+def _scatter_back(desc, locals_, A):
+    new = to_scalapack(desc, A)
+    for k, buf in new.items():
+        locals_[k][...] = buf
+
+
+def _nb_env(nb: int) -> int:
+    return int(os.environ.get("SLATE_SCALAPACK_NB", nb))
+
+
+_OP = {"n": Op.NoTrans, "t": Op.Trans, "c": Op.ConjTrans}
+_UPLO = {"l": Uplo.Lower, "u": Uplo.Upper}
+_SIDE = {"l": Side.Left, "r": Side.Right}
+_DIAG = {"n": Diag.NonUnit, "u": Diag.Unit}
+
+
+def pgemm(transa, transb, m, n, k, alpha, a, desca, b, descb, beta, c, descc):
+    """p?gemm: C = alpha op(A) op(B) + beta C (reference:
+    scalapack_api/scalapack_gemm.cc:24-148)."""
+    from ..drivers import blas3
+    from ..matrix.base import conj_transpose, transpose
+    from ..matrix.matrix import Matrix
+
+    A = from_scalapack(desca, a)
+    B = from_scalapack(descb, b)
+    C = from_scalapack(descc, c)
+    opa = _OP[transa.lower()[0]]
+    opb = _OP[transb.lower()[0]]
+    Am = Matrix.from_global(A, desca.mb, desca.nb)
+    Bm = Matrix.from_global(B, descb.mb, descb.nb)
+    Cm = Matrix.from_global(C, descc.mb, descc.nb)
+    if opa == Op.Trans:
+        Am = transpose(Am)
+    elif opa == Op.ConjTrans:
+        Am = conj_transpose(Am)
+    if opb == Op.Trans:
+        Bm = transpose(Bm)
+    elif opb == Op.ConjTrans:
+        Bm = conj_transpose(Bm)
+    out = blas3.gemm(alpha, Am, Bm, beta, Cm)
+    _scatter_back(descc, c, np.asarray(out.to_global()))
+    return 0
+
+
+def ppotrf(uplo, n, a, desca) -> int:
+    """p?potrf: in-place Cholesky of the distributed buffers (reference:
+    scalapack_api/scalapack_potrf.cc)."""
+    from ..drivers import chol
+    from ..matrix.matrix import HermitianMatrix
+
+    A = from_scalapack(desca, a)
+    up = _UPLO[uplo.lower()[0]]
+    Am = HermitianMatrix.from_global(A, _nb_env(desca.nb), uplo=up)
+    L, info = chol.potrf(Am)
+    Lg = np.asarray(L.to_global())
+    tri = np.tril(Lg) if up == Uplo.Lower else np.triu(Lg)
+    keep = np.triu(A, 1) if up == Uplo.Lower else np.tril(A, -1)
+    _scatter_back(desca, a, tri + keep)
+    return int(info)
+
+
+def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
+    """p?getrf: in-place LU; returns (perm, info).  ScaLAPACK's ipiv is a
+    per-panel swap list; slate_tpu records the net forward permutation
+    (types.Pivots), which is what p?getrs consumes here."""
+    from ..drivers import lu
+    from ..matrix.matrix import Matrix
+
+    A = from_scalapack(desca, a)
+    Am = Matrix.from_global(A, desca.mb, desca.nb)
+    LU, piv, info = lu.getrf(Am)
+    _scatter_back(desca, a, np.asarray(LU.to_global()))
+    perm = np.asarray(piv.perm)
+    if ipiv is not None:
+        k = min(len(ipiv), desca.m)  # perm covers padded rows; callers
+        ipiv[:k] = perm[:k]  # size ipiv by m, ScaLAPACK-style
+    return perm, int(info)
+
+
+def pgesv(n, nrhs, a, desca, b, descb) -> int:
+    """p?gesv: solve AX=B in place (B <- X) (reference:
+    scalapack_api/scalapack_gesv.cc)."""
+    from ..drivers import lu
+    from ..matrix.matrix import Matrix
+
+    A = from_scalapack(desca, a)
+    B = from_scalapack(descb, b)
+    Am = Matrix.from_global(A, desca.mb, desca.nb)
+    Bm = Matrix.from_global(B, descb.mb, descb.nb)
+    X, LU, piv, info = lu.gesv(Am, Bm)
+    _scatter_back(desca, a, np.asarray(LU.to_global()))
+    _scatter_back(descb, b, np.asarray(X.to_global()))
+    return int(info)
+
+
+def pposv(uplo, n, nrhs, a, desca, b, descb) -> int:
+    from ..drivers import chol
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    A = from_scalapack(desca, a)
+    B = from_scalapack(descb, b)
+    up = _UPLO[uplo.lower()[0]]
+    Am = HermitianMatrix.from_global(A, _nb_env(desca.nb), uplo=up)
+    Bm = Matrix.from_global(B, descb.mb, descb.nb)
+    X, L, info = chol.posv(Am, Bm)
+    _scatter_back(descb, b, np.asarray(X.to_global()))
+    Lg = np.asarray(L.to_global())
+    tri = np.tril(Lg) if up == Uplo.Lower else np.triu(Lg)
+    keep = np.triu(A, 1) if up == Uplo.Lower else np.tril(A, -1)
+    _scatter_back(desca, a, tri + keep)
+    return int(info)
+
+
+def pgeqrf(m, n, a, desca):
+    """p?geqrf: in-place QR; returns the TriangularFactors (the TPU
+    analogue of ScaLAPACK's tau array)."""
+    from ..drivers import qr
+    from ..matrix.matrix import Matrix
+
+    A = from_scalapack(desca, a)
+    Am = Matrix.from_global(A, desca.mb, desca.nb)
+    fac, T = qr.geqrf(Am)
+    _scatter_back(desca, a, np.asarray(fac.to_global()))
+    return T, 0
+
+
+def ptrsm(side, uplo, transa, diag, m, n, alpha, a, desca, b, descb) -> int:
+    from ..drivers import blas3
+    from ..matrix.base import conj_transpose, transpose
+    from ..matrix.matrix import Matrix, TriangularMatrix
+
+    A = from_scalapack(desca, a)
+    B = from_scalapack(descb, b)
+    up = _UPLO[uplo.lower()[0]]
+    Am = TriangularMatrix.from_global(
+        A, _nb_env(desca.nb), uplo=up, diag=_DIAG[diag.lower()[0]]
+    )
+    op = _OP[transa.lower()[0]]
+    if op == Op.Trans:
+        Am = transpose(Am)
+    elif op == Op.ConjTrans:
+        Am = conj_transpose(Am)
+    Bm = Matrix.from_global(B, descb.mb, descb.nb)
+    X = blas3.trsm(_SIDE[side.lower()[0]], alpha, Am, Bm)
+    _scatter_back(descb, b, np.asarray(X.to_global()))
+    return 0
+
+
+def plange(norm, m, n, a, desca) -> float:
+    from ..drivers import aux
+    from ..matrix.matrix import Matrix
+
+    A = from_scalapack(desca, a)
+    Am = Matrix.from_global(A, desca.mb, desca.nb)
+    nt = {"m": Norm.Max, "1": Norm.One, "o": Norm.One, "i": Norm.Inf,
+          "f": Norm.Fro, "e": Norm.Fro}[norm.lower()[0]]
+    return float(aux.norm(nt, Am))
+
+
+def _typed(prefix: str, fn):
+    """Generate the s/d/c/z-typed ScaLAPACK entry points (reference: the
+    SLATE_PDGEMM etc. macro expansions in scalapack_gemm.cc:24-108)."""
+
+    def make(tc):
+        def wrapper(*args, **kw):
+            if _verbose():
+                print(f"slate_tpu compat: p{tc}{prefix}")
+            return fn(*args, **kw)
+
+        wrapper.__name__ = f"p{tc}{prefix}"
+        wrapper.__doc__ = f"Typed ScaLAPACK shim p{tc}{prefix} -> {fn.__name__}."
+        return wrapper
+
+    return {f"p{tc}{prefix}": make(tc) for tc in "sdcz"}
+
+
+_g = globals()
+for _name, _fn in [
+    ("gemm", pgemm), ("potrf", ppotrf), ("getrf", pgetrf), ("gesv", pgesv),
+    ("posv", pposv), ("geqrf", pgeqrf), ("trsm", ptrsm), ("lange", plange),
+]:
+    _g.update(_typed(_name, _fn))
