@@ -22,7 +22,7 @@ reflectors.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -32,12 +32,15 @@ from ..exceptions import slate_assert
 from ..matrix.base import BaseMatrix, conj_transpose
 from ..matrix.matrix import HermitianMatrix, HermitianBandMatrix, Matrix, TriangularMatrix
 from ..options import Options, get_option
-from ..ops.householder import geqrf as _geqrf_kernel, larft, materialize_v
+from ..ops.householder import larft, materialize_v
 from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 from . import blas3
 
+from ..internal.precision import accurate_matmul
 
+
+@accurate_matmul
 def he2hb(
     A: HermitianMatrix, opts: Optional[Options] = None
 ) -> Tuple[HermitianBandMatrix, Matrix, TriangularFactors]:
@@ -49,45 +52,76 @@ def he2hb(
     reflectors (panel k in tile column k, rows k+1..), T their compact-WY
     factors — the inputs of unmtr_he2hb."""
     slate_assert(A.m == A.n, "he2hb requires square")
+    from jax import lax
+
+    from ..ops.householder import _geqrf_panel
+
     lay = A.layout
     nb = lay.nb
     n = A.n
     G = A.full_global()
     kt = lay.nt
-    Vs = jnp.zeros_like(G)
-    Ts = []
     complex_t = A.is_complex
 
     def C(x):
         return jnp.conj(x) if complex_t else x
 
-    for k in range(kt - 1):
-        lo = (k + 1) * nb
-        w = min(nb, n - k * nb)
-        if lo >= n:
-            break
-        panel = G[lo:, k * nb : k * nb + w]
-        vr, taus = _geqrf_kernel(panel)
-        V = materialize_v(vr, offset=0)  # (n-lo, w) unit-lower
-        Tk = larft(V, taus)
-        # panel becomes [R; 0]
-        R = jnp.triu(vr)
-        G = G.at[lo:, k * nb : k * nb + w].set(R)
-        G = G.at[k * nb : k * nb + w, lo:].set(C(R).T)
-        # two-sided update of trailing A22 (Hermitian):
-        # A' = H^H A H,  H = I - V Tk V^H
-        A22 = G[lo:, lo:]
-        P = A22 @ (V @ Tk)  # (n-lo, w)
-        Q2 = C(Tk).T @ (C(V).T @ P)  # (w, w)
-        A22 = A22 - V @ C(P).T - P @ C(V).T + V @ Q2 @ C(V).T
-        G = G.at[lo:, lo:].set(A22)
-        Vs = Vs.at[lo:, k * nb : k * nb + w].set(V)
-        Tk_full = jnp.zeros((nb, nb), G.dtype).at[:w, :w].set(Tk)
-        Ts.append(Tk_full)
+    # static-shape pipeline: every step works on the full padded array
+    # with the active trailing block rolled to the origin — one traced
+    # step body under lax.fori_loop instead of kt unrolled iterations
+    # (the reference's per-panel task loop, he2hb.cc:174-185).
+    npad = kt * nb
+    Gp = jnp.pad(G, ((0, npad - n), (0, npad - n)))
+    Vs0 = jnp.zeros_like(Gp)
+    Ts0 = jnp.zeros((max(kt - 1, 1), nb, nb), Gp.dtype)
+    rows = jnp.arange(npad)
 
-    Tstack = (
-        jnp.stack(Ts) if Ts else jnp.zeros((0, nb, nb), G.dtype)
-    )
+    def step(k, carry):
+        Gp, Vs, Ts = carry
+        lo = (k + 1) * nb
+        h = n - lo  # active trailing size (may be <= 0 for last steps)
+        # panel: rows lo.., column block k, rolled to the top
+        colblk = lax.dynamic_slice(Gp, (0, k * nb), (npad, nb))
+        pan = jnp.roll(colblk, -lo, axis=0)
+        pan = jnp.where((rows < h)[:, None], pan, jnp.zeros_like(pan))
+        vr, taus = _geqrf_panel(pan)
+        V = materialize_v(vr, offset=0)  # (npad, nb) unit-lower, zero cols
+        Tk = larft(V, taus)
+        R = jnp.triu(vr)
+        # write [R; 0] back into the panel and its Hermitian mirror
+        newcol = jnp.where((rows < h)[:, None], R, jnp.zeros_like(R))
+        newcol = jnp.roll(newcol, lo, axis=0)
+        keep_above = (rows < lo)[:, None]
+        newcol = jnp.where(keep_above, colblk, newcol)
+        Gp = lax.dynamic_update_slice(Gp, newcol, (0, k * nb))
+        mirror = C(newcol).T  # (nb, npad)
+        rowblk = lax.dynamic_slice(Gp, (k * nb, 0), (nb, npad))
+        sel = (rows >= lo)[None, :]
+        Gp = lax.dynamic_update_slice(
+            Gp, jnp.where(sel, mirror, rowblk), (k * nb, 0)
+        )
+        # two-sided trailing update on the rolled A22
+        G22 = jnp.roll(Gp, (-lo, -lo), (0, 1))
+        act = (rows < h)[:, None] & (rows < h)[None, :]
+        A22 = jnp.where(act, G22, jnp.zeros_like(G22))
+        P = A22 @ (V @ Tk)
+        Q2 = C(Tk).T @ (C(V).T @ P)
+        A22n = A22 - V @ C(P).T - P @ C(V).T + V @ Q2 @ C(V).T
+        G22 = jnp.where(act, A22n, G22)
+        Gp = jnp.roll(G22, (lo, lo), (0, 1))
+        # stash reflectors (global row coordinates)
+        Vroll = jnp.roll(
+            jnp.where((rows < h)[:, None], V, jnp.zeros_like(V)), lo, axis=0
+        )
+        Vs = lax.dynamic_update_slice(Vs, Vroll, (0, k * nb))
+        Ts = Ts.at[k].set(Tk)
+        return Gp, Vs, Ts
+
+    Gp, Vs_p, Tstack = lax.fori_loop(0, max(kt - 1, 0), step, (Gp, Vs0, Ts0))
+    G = Gp[:n, :n]
+    Vs = Vs_p[:n, :n]
+    if kt - 1 <= 0:
+        Tstack = jnp.zeros((0, nb, nb), G.dtype)
     band = HermitianBandMatrix(
         tiles_from_global(G, lay), lay, grid=A.grid, kd=nb, uplo=A.uplo
     )
@@ -95,6 +129,7 @@ def he2hb(
     return band, Vm, TriangularFactors(Tstack)
 
 
+@accurate_matmul
 def unmtr_he2hb(
     side: Side,
     op: Op,
@@ -151,21 +186,48 @@ def _gathered_band_eig(
     return eigh_accurate(band_2d, vectors=vectors)
 
 
+@accurate_matmul
 def heev(
     A: HermitianMatrix,
     opts: Optional[Options] = None,
     vectors: bool = True,
 ) -> Tuple[jnp.ndarray, Optional[Matrix]]:
-    """Hermitian eigendecomposition (reference: src/heev.cc two-stage).
+    """Hermitian eigendecomposition (reference: src/heev.cc two-stage:
+    he2hb -> hb2st bulge chase -> tridiagonal eigensolve -> back-transform
+    unmtr_hb2st + unmtr_he2hb, heev.cc:123-210).
 
-    Returns (Lambda ascending, Z or None).  MethodEig selects the
-    tridiagonal-stage algorithm in the reference (QR iteration vs divide &
-    conquer); the vendor eigensolver is D&C-equivalent."""
+    Returns (Lambda ascending, Z or None).  Stage 2 runs the wavefront
+    bulge chase (ops/bulge.py) when the band is genuinely narrow
+    (n > 4 nb); small problems dense-eigensolve the band directly.
+    MethodEig.Bisection forces the two-stage chase + Sturm bisection."""
+    from ..ops import bulge
+
     band, V, T = he2hb(A, opts)
+    n = A.n
+    b = A.layout.nb
     Gband = band.to_global()
-    w, Z2 = _gathered_band_eig(Gband, vectors)
-    if not vectors:
-        return w, None
+
+    method = get_option(opts, Option.MethodEig, MethodEig.Auto)
+    if isinstance(method, str):
+        method = MethodEig.from_string(method)
+    two_stage = b >= 2 and n > 2 and (
+        method == MethodEig.Bisection or (method == MethodEig.Auto and n > 4 * b)
+    )
+    if two_stage:
+        W = bulge.band_to_storage(Gband, b, n + 4 * b + 8)
+        d, e, u, VS, TAUS = bulge.hb2st(W, n, b)
+        if not vectors:
+            return bulge.tridiag_eigvals_bisect(d, e), None
+        # tridiagonal stage with vectors (steqr role): dense vendor +
+        # Jacobi polish on the (n x n) tridiagonal assembly
+        w, ZT = steqr(d, e, vectors=True)
+        Z2 = bulge.unmtr_hb2st(
+            TAUS=TAUS, VS=VS, Z=(u[:, None] * ZT).astype(A.dtype), n=n, b=b
+        )
+    else:
+        w, Z2 = _gathered_band_eig(Gband, vectors)
+        if not vectors:
+            return w, None
     Zm = Matrix(
         tiles_from_global(Z2.astype(A.dtype), A.layout), A.layout, grid=A.grid
     )
@@ -176,11 +238,12 @@ def heev(
 
 def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
     """Eigenvalues of a symmetric tridiagonal matrix, no vectors
-    (reference: src/sterf.cc QL/QR iteration).  Vendor eigensolver on the
-    assembled tridiagonal, Jacobi-polished on TPU f64."""
-    Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
-    w, _ = _gathered_band_eig(Tm, vectors=False)
-    return w
+    (reference: src/sterf.cc QL/QR iteration) — bisection with
+    vectorized Sturm counts (ops/bulge.py), all eigenvalues in
+    parallel: the TPU-native replacement for the sequential QL/QR."""
+    from ..ops.bulge import tridiag_eigvals_bisect
+
+    return tridiag_eigvals_bisect(jnp.real(d), jnp.real(e))
 
 
 def steqr(
@@ -202,6 +265,7 @@ def stedc(
     return steqr(d, e, vectors)
 
 
+@accurate_matmul
 def hegst(
     itype: int,
     A: HermitianMatrix,
@@ -227,6 +291,7 @@ def hegst(
     )
 
 
+@accurate_matmul
 def hegv(
     itype: int,
     A: HermitianMatrix,

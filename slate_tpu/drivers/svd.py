@@ -11,7 +11,7 @@ svd.cc:270-304).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -21,12 +21,15 @@ from ..exceptions import slate_assert
 from ..matrix.base import BaseMatrix, conj_transpose
 from ..matrix.matrix import Matrix, TriangularBandMatrix
 from ..options import Options, get_option
-from ..ops.householder import geqrf as _geqrf_kernel, larft, materialize_v
+from ..ops.householder import larft, materialize_v
 from ..ops.jacobi import svd_accurate
 from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 
+from ..internal.precision import accurate_matmul
 
+
+@accurate_matmul
 def ge2tb(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[TriangularBandMatrix, Matrix, TriangularFactors, Matrix, TriangularFactors]:
@@ -36,6 +39,10 @@ def ge2tb(
 
     Returns (band, U_V, U_T, V_V, V_T) with the left/right reflector sets
     for unmbr_ge2tb."""
+    from jax import lax
+
+    from ..ops.householder import _geqrf_panel
+
     lay = A.layout
     nb = lay.nb
     m, n = A.m, A.n
@@ -46,48 +53,69 @@ def ge2tb(
     def C(x):
         return jnp.conj(x) if complex_t else x
 
-    UV = jnp.zeros_like(G)  # left reflectors live in A's row space (m)
-    VV = jnp.zeros((n, n), G.dtype)  # right reflectors live in the column space
-    UTs: List[jnp.ndarray] = []
-    VTs: List[jnp.ndarray] = []
+    # static-shape pipeline (see he2hb): per step the active trailing
+    # block is rolled to the origin so one traced body serves all kt
+    # panels; padded columns yield tau=0 no-op reflectors.
+    Mp, Np = lay.mt * nb, lay.nt * nb
+    Gp = jnp.pad(G, ((0, Mp - m), (0, Np - n)))
+    UV0 = jnp.zeros_like(Gp)
+    VV0 = jnp.zeros((Np, Np), Gp.dtype)
+    UT0 = jnp.zeros((kt, nb, nb), Gp.dtype)
+    VT0 = jnp.zeros((kt, nb, nb), Gp.dtype)
+    rowsM = jnp.arange(Mp)
+    rowsN = jnp.arange(Np)
 
-    for k in range(kt):
+    def step(k, carry):
+        Gp, UV, VV, UT, VT = carry
         lo = k * nb
-        w = min(nb, n - lo)
-        if lo >= m or w <= 0:
-            break
-        # left QR on panel A[lo:, lo:lo+w]
-        panel = G[lo:, lo : lo + w]
-        vr, taus = _geqrf_kernel(panel)
+        hM = m - lo  # active rows
+        # ---- left QR on the rolled frame ----------------------------
+        G1 = jnp.roll(Gp, (-lo, -lo), (0, 1))
+        actM = (rowsM < hM)[:, None]
+        actN = (rowsN < (n - lo))[None, :]
+        pan = jnp.where(actM, G1[:, :nb], 0)
+        pan = jnp.where((jnp.arange(nb) < (n - lo))[None, :], pan, 0)
+        vr, taus = _geqrf_panel(pan)
         V = materialize_v(vr, offset=0)
         Tk = larft(V, taus)
-        G = G.at[lo:, lo : lo + w].set(jnp.triu(vr))
-        # trailing: C <- (I - V T^H V^H) C for columns right of the panel
-        if lo + w < n:
-            Ct = G[lo:, lo + w :]
-            W = C(V).T @ Ct
-            G = G.at[lo:, lo + w :].set(Ct - V @ (C(Tk).T @ W))
-        UV = UV.at[lo:, lo : lo + w].set(V)
-        UTs.append(jnp.zeros((nb, nb), G.dtype).at[:w, :w].set(Tk))
+        G1 = G1.at[:, :nb].set(jnp.where(actM, jnp.triu(vr), G1[:, :nb]))
+        # trailing columns: C <- (I - V T^H V^H) C
+        Ct = jnp.where(actM & actN, G1, 0).at[:, :nb].set(0)
+        W = C(V).T @ Ct
+        G1 = G1 - jnp.where(actN, V @ (C(Tk).T @ W), 0).at[:, :nb].set(0)
+        UVroll = jnp.roll(jnp.where(actM, V, 0), lo, axis=0)
+        UV = lax.dynamic_update_slice(UV, UVroll, (0, lo))
+        UT = UT.at[k].set(Tk)
 
-        # right LQ on row block A[lo:lo+w, lo+w:] (keeps the upper band)
-        if lo + w < n:
-            hw = min(nb, m - lo)
-            row = G[lo : lo + hw, lo + w :]
-            vrL, tausL = _geqrf_kernel(C(row).T)
-            VL = materialize_v(vrL, offset=0)  # (n-lo-w, hw)
-            TkL = larft(VL, tausL)
-            G = G.at[lo : lo + hw, lo + w :].set(C(jnp.triu(vrL)).T)
-            # apply from the right to rows below: C <- C (I - VL TkL^H VL^H)^H
-            if lo + hw < m:
-                Cb = G[lo + hw :, lo + w :]
-                Wb = Cb @ VL
-                G = G.at[lo + hw :, lo + w :].set(Cb - (Wb @ TkL) @ C(VL).T)
-            VV = VV.at[lo + w :, lo : lo + VL.shape[1]].set(VL)
-            VTs.append(jnp.zeros((nb, nb), G.dtype).at[:hw, :hw].set(TkL))
+        # ---- right LQ on the row block, frame shifted one block right
+        G2 = jnp.roll(G1, (0, -nb), (0, 1))  # now rows 0.., cols are lo+nb..
+        actN2 = (rowsN < (n - lo - nb))[None, :]
+        rowblk = jnp.where(actN2, G2[:nb, :], 0)
+        rowblk = jnp.where((jnp.arange(nb) < hM)[:, None], rowblk, 0)
+        P2 = C(rowblk).T  # (Np, nb)
+        vrL, tausL = _geqrf_panel(P2)
+        VL = materialize_v(vrL, offset=0)
+        TkL = larft(VL, tausL)
+        new_row = C(jnp.triu(vrL)).T
+        G2 = G2.at[:nb, :].set(jnp.where(actN2, new_row, G2[:nb, :]))
+        # rows below: C <- C (I - VL TkL^H VL^H)^H
+        rbelow = (rowsM >= nb)[:, None] & (rowsM < hM)[:, None]
+        Cb = jnp.where(rbelow & actN2, G2, 0)
+        Wb = Cb @ VL
+        G2 = G2 - jnp.where(rbelow, (Wb @ TkL) @ C(VL).T, 0)
+        VVroll = jnp.roll(jnp.where(actN2.T, VL, 0), lo + nb, axis=0)
+        VV = lax.dynamic_update_slice(VV, VVroll, (0, lo))
+        VT = VT.at[k].set(TkL)
 
-    UT = jnp.stack(UTs) if UTs else jnp.zeros((0, nb, nb), G.dtype)
-    VT = jnp.stack(VTs) if VTs else jnp.zeros((0, nb, nb), G.dtype)
+        Gp = jnp.roll(G2, (lo, lo + nb), (0, 1))
+        return Gp, UV, VV, UT, VT
+
+    Gp, UVp, VVp, UT, VT = lax.fori_loop(
+        0, kt, step, (Gp, UV0, VV0, UT0, VT0)
+    )
+    G = Gp[:m, :n]
+    UV = UVp[:m, :n]
+    VV = VVp[:n, :n]
     band = TriangularBandMatrix(
         tiles_from_global(G, lay), lay, grid=A.grid, kd=nb, uplo=Uplo.Upper
     )
@@ -101,36 +129,107 @@ def ge2tb(
     )
 
 
+def _jw_band_storage(Bsq: jnp.ndarray, b: int):
+    """Diagonal-major band storage of the perfect-shuffle Jordan-Wielandt
+    embedding C = P [[0, B], [B^H, 0]] P^T of an upper-band B (b
+    superdiagonals): C is Hermitian banded with bandwidth 2b+1, entries
+    C[2j+1, 2i] = conj(B[i, i + (d-1)/2]) on the odd subdiagonals of the
+    even columns (Golub-Kahan; eigenvalues come in +-sigma pairs and
+    eigenvectors shuffle to (u; v)/sqrt(2))."""
+    n = Bsq.shape[0]
+    bw = 2 * b + 1
+    n2 = 2 * n
+    n_pad = n2 + 4 * bw + 8
+    W = jnp.zeros((2 * bw + 1, n_pad), Bsq.dtype)
+    for t in range(b + 1):
+        dd = 2 * t + 1
+        diag_t = jnp.conj(jnp.diagonal(Bsq, t))  # (n - t,)
+        cols = 2 * jnp.arange(n - t)
+        W = W.at[dd, cols].set(diag_t)
+    return W, bw, n2
+
+
+def _band_svd_jw(Gband: jnp.ndarray, b: int, vectors: bool):
+    """SVD of an upper-triangular band matrix through the shuffled
+    Jordan-Wielandt embedding + the hb2st wavefront bulge chase: the
+    TPU-native stage 2 (replaces reference tb2bd + bdsqr, src/tb2bd.cc,
+    src/bdsqr.cc).  Returns (s desc, U, Vh) with U/Vh None unless
+    requested."""
+    from ..ops import bulge as bulge_mod
+    from .eig import steqr
+
+    n = Gband.shape[1]
+    Bsq = Gband[:n, :n]
+    W, bw, n2 = _jw_band_storage(Bsq, b)
+    d, e, u, VS, TAUS = bulge_mod.hb2st(W, n2, bw)
+    if not vectors:
+        w = bulge_mod.tridiag_eigvals_bisect(d, e)
+        return w[::-1][:n], None, None
+    w, ZT = steqr(d, e, vectors=True)
+    Zjw = bulge_mod.unmtr_hb2st(
+        VS, TAUS, (u[:, None] * ZT).astype(Bsq.dtype), n2, bw
+    )
+    top = jnp.argsort(-w)[:n]
+    s = w[top]
+    Zsel = Zjw[:, top] * np.sqrt(2.0)
+    U = Zsel[0::2, :]
+    V = Zsel[1::2, :]
+    return s, U, jnp.conj(V).T if jnp.issubdtype(Bsq.dtype, jnp.complexfloating) else V.T
+
+
+@accurate_matmul
 def tb2bd(band: TriangularBandMatrix):
-    """Band -> bidiagonal (reference: src/tb2bd.cc bulge chasing).  The
-    gathered vendor SVD consumes the band directly (see bdsqr), so this
-    returns the band's (d, e) after a dense bidiagonalization on the
-    gathered band — kept as an API-parity staging point."""
+    """Band -> bidiagonal (reference: src/tb2bd.cc bulge chasing).
+
+    slate_tpu's band stage goes band -> shuffled Jordan-Wielandt ->
+    tridiagonal directly (_band_svd_jw), so this API-parity wrapper
+    returns the computed singular values as the bidiagonal's diagonal
+    (e = 0), plus the band-stage vectors."""
     G = band.to_global()
-    # One-device Householder bidiagonalization of the (narrow-band) matrix
     m, n = G.shape
     k = min(m, n)
-    U, s, Vh = svd_accurate(G)
-    # represent as exact bidiagonal (diagonal) — svd of band is the vendor
-    # stage here
+    b = getattr(band, "kd", n)
+    if m >= n and n > 4 * (2 * b + 1) and b >= 1:
+        s, U, Vh = _band_svd_jw(G, b, vectors=True)
+    else:
+        U, s, Vh = svd_accurate(G)
     d = s
-    e = jnp.zeros((max(k - 1, 0),), s.dtype)
+    e = jnp.zeros((max(k - 1, 0),), jnp.real(G[:1, :1]).dtype)
     return d, e, U, Vh
 
 
+@accurate_matmul
 def bdsqr(d: jnp.ndarray, e: jnp.ndarray, vectors: bool = False):
-    """Singular values of a bidiagonal matrix (reference: src/bdsqr.cc QR
-    iteration), via the vendor SVD of the assembled bidiagonal."""
+    """Singular values of a real bidiagonal matrix (reference:
+    src/bdsqr.cc QR iteration): the Golub-Kahan tridiagonal
+    tridiag(0; [d1, e1, d2, e2, ...]) has eigenvalues +-sigma, solved by
+    the parallel Sturm bisection (values) or the polished dense
+    tridiagonal eigensolver (vectors).  The [d1, e1, ...] off-diagonal
+    corresponds to the (v1, u1, v2, u2, ...) shuffle, so eigenvectors
+    split as v = z[0::2], u = z[1::2]."""
+    from ..ops import bulge as bulge_mod
+
     n = d.shape[0]
-    B = jnp.zeros((n, n), d.dtype).at[jnp.arange(n), jnp.arange(n)].set(d)
+    if n == 0:
+        return d, None, None
+    off = jnp.zeros((2 * n - 1,), jnp.real(d[:1]).dtype)
+    off = off.at[0::2].set(jnp.real(d))
     if n > 1:
-        B = B.at[jnp.arange(n - 1), jnp.arange(1, n)].set(e)
-    if vectors:
-        U, s, Vh = svd_accurate(B)
-        return s, U, Vh
-    return svd_accurate(B, compute_uv=False), None, None
+        off = off.at[1::2].set(jnp.real(e))
+    dz = jnp.zeros((2 * n,), off.dtype)
+    if not vectors:
+        w = bulge_mod.tridiag_eigvals_bisect(dz, off)
+        return w[::-1][:n], None, None
+    from .eig import steqr
+
+    w, Z = steqr(dz, off, vectors=True)
+    top = jnp.argsort(-w)[:n]
+    s = w[top]
+    Zsel = Z[:, top] * np.sqrt(2.0)
+    return s, Zsel[1::2, :], Zsel[0::2, :].T
 
 
+@accurate_matmul
 def svd(
     A: Matrix,
     opts: Optional[Options] = None,
@@ -182,16 +281,31 @@ def svd(
 
     band, UVm, UT, VVm, VT = ge2tb(A, opts)
     Gband = band.to_global()
+    b = lay.nb
+    k = min(m, n)
+    # stage 2: the JW bulge-chase when the band is genuinely narrow
+    use_jw = (n <= m) and (n > 4 * (2 * b + 1)) and b >= 1
     if not vectors:
+        if use_jw:
+            s = _band_svd_jw(Gband, b, vectors=False)[0]
+            return s, None, None
         s = svd_accurate(Gband, compute_uv=False)
-        return s[: min(m, n)], None, None
-    Ub, s, Vhb = svd_accurate(Gband)
+        return s[:k], None, None
+    if use_jw:
+        s, Ub, Vhb = _band_svd_jw(Gband, b, vectors=True)
+        if m > n:
+            Ub = jnp.concatenate(
+                [Ub, jnp.zeros((m - n, n), A.dtype)], axis=0
+            )
+    else:
+        Ub, s, Vhb = svd_accurate(Gband)
     # back-transform (unmbr_ge2tb): U = Q_U Ub, V^H = Vhb Q_V^H
     U = unmbr_ge2tb_left(UVm, UT, Ub, A)
     Vh = unmbr_ge2tb_right(VVm, VT, Vhb, A)
     return s[: min(m, n)], U, Vh
 
 
+@accurate_matmul
 def unmbr_ge2tb_left(UVm: Matrix, UT: TriangularFactors, C2, A: Matrix) -> Matrix:
     """Apply the left (QR-side) ge2tb reflectors: C <- Q_U C
     (reference: src/unmbr_ge2tb.cc)."""
@@ -215,6 +329,7 @@ def unmbr_ge2tb_left(UVm: Matrix, UT: TriangularFactors, C2, A: Matrix) -> Matri
     return Matrix.from_global(out.astype(A.dtype), lay.mb, lay.nb, grid=A.grid)
 
 
+@accurate_matmul
 def unmbr_ge2tb_right(VVm: Matrix, VT: TriangularFactors, C2, A: Matrix) -> Matrix:
     """Apply the right (LQ-side) reflectors: C <- C Q_V^H."""
     lay = A.layout

@@ -1,0 +1,275 @@
+"""Stage-2 bulge chasing: band -> tridiagonal, and tridiagonal
+eigenvalues by bisection.
+
+TPU-native re-design of the reference's hb2st wavefront (reference:
+src/hb2st.cc:44-187 — task types per (sweep, step), static thread
+scheduling over a lock-free atomic ProgressVector; the kernels are the
+PLASMA-style Householder chase).  The reference's fine-grained
+thread+atomics pipeline becomes a *superstep wavefront*: task (sweep s,
+chase step j) runs at superstep t = 3s + j, so every superstep executes a
+diagonal of independent tasks whose working windows are provably disjoint
+(3 supersteps of sweep spacing puts consecutive windows 3b-1 columns
+apart while a task only writes a 2b-wide row/column strip).  One
+lax.fori_loop over supersteps, a vmapped window kernel per step — no
+locks, no atomics, static shapes throughout.
+
+The tridiagonal eigenvalues use bisection with vectorized Sturm counts
+(all n eigenvalues bisected simultaneously; one scan over the matrix per
+iteration) — the TPU replacement for LAPACK sterf's sequential QL/QR
+iteration (reference: src/sterf.cc).
+
+Band storage here is lower-diagonal-major: W[d, c] = A[c+d, c] for
+d = 0..2b (2b diagonals hold the transient bulges).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .householder import _larfg
+
+
+def band_to_storage(G: jnp.ndarray, b: int, n_pad: int) -> jnp.ndarray:
+    """Pack a (n, n) Hermitian band matrix (lower data) into (2b+1, n_pad)
+    diagonal-major storage."""
+    n = G.shape[0]
+    W = jnp.zeros((2 * b + 1, n_pad), G.dtype)
+    for d in range(min(b, n - 1) + 1):
+        W = W.at[d, : n - d].set(jnp.diagonal(G, -d))
+    return W
+
+
+@partial(jax.jit, static_argnames=("n", "b"))
+def hb2st(
+    W: jnp.ndarray, n: int, b: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reduce a Hermitian band matrix (bandwidth b) to real symmetric
+    tridiagonal by Householder bulge chasing.
+
+    W: (2b+1, n_pad) diagonal-major lower band storage, n_pad >= n + 4b+8.
+    Returns (d, e, phase, VS, TAUS): real tridiagonal diagonal/
+    subdiagonal, the unit diagonal phase u making it real (complex
+    Hermitian input leaves a complex subdiagonal e_c; the similarity
+    D_u^H T_c D_u with u_{i+1} = u_i e_c[i]/|e_c[i]| realifies it, so
+    eigenvectors back-transform as Z_band = Q (u * Z_real) — LAPACK
+    zhbtrd does the same scaling), and the chase reflectors for
+    unmtr_hb2st — VS[s, j] is the length-b reflector of sweep s, step j
+    (v[0] = 1), acting on rows s + j*b + 1 .. s + (j+1)*b.
+    """
+    dtype = W.dtype
+    real_t = jnp.real(W[:1, :1]).dtype
+    n_pad = W.shape[1]
+    L = 3 * b + 1
+    complex_t = jnp.issubdtype(dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    def realify(d, e_c):
+        """Diagonal phase similarity making the subdiagonal real."""
+        mag = jnp.abs(e_c)
+        if not complex_t:
+            return d, e_c, jnp.ones((n,), dtype)
+        unit = jnp.where(mag == 0, jnp.ones_like(e_c), e_c / jnp.where(mag == 0, 1, mag))
+        u = jnp.concatenate([jnp.ones((1,), dtype), jnp.cumprod(unit)])
+        return d, mag.astype(real_t), u
+
+    if n <= 2 or b <= 1:
+        d = jnp.real(W[0, :n])
+        e_c = W[1, : n - 1] if n > 1 else jnp.zeros((0,), dtype)
+        d, e, u = realify(d, e_c)
+        return d, e, u, jnp.zeros((1, 1, max(b, 1)), dtype), jnp.zeros((1, 1), dtype)
+
+    n_sweeps = n - 2
+    Jmax = (n - 3) // b + 1  # max chase step index over all sweeps
+    NSLOT = Jmax // 3 + 2
+    T_total = 3 * (n_sweeps - 1) + Jmax + 1
+
+    # static index helpers for densify/bandify
+    rr = jnp.arange(L)[:, None]
+    cc = jnp.arange(L)[None, :]
+    dmat = rr - cc
+    lower_m = (dmat >= 0) & (dmat <= 2 * b)
+    upper_m = (dmat < 0) & (-dmat <= 2 * b)
+    idx_d = jnp.clip(jnp.abs(dmat), 0, 2 * b)
+    idx_c = jnp.where(dmat >= 0, cc, rr)
+    dd = jnp.arange(2 * b + 1)[:, None]
+    cc2 = jnp.arange(L)[None, :]
+    in_win = dd + cc2 <= L - 1
+
+    def densify(strip):
+        vals = strip[idx_d, idx_c]
+        return jnp.where(lower_m, vals, jnp.where(upper_m, conj(vals), 0))
+
+    def bandify(DW, strip):
+        vals = DW[jnp.clip(cc2 + dd, 0, L - 1), cc2]
+        return jnp.where(in_win, vals, strip)
+
+    def chase_window(DW, r0):
+        """Eliminate window-column 0 rows r0+1..r0+b-1 and apply the
+        two-sided update (the PLASMA hb2st type-1/2/3 kernels fused:
+        window-relative r0 is 1 for the sweep head, b for chase steps)."""
+        x = lax.dynamic_slice(DW, (r0, 0), (b, 1))[:, 0]
+        alpha = x[0]
+        xnorm_sq = jnp.sum(jnp.abs(x[1:]) ** 2).astype(real_t)
+        beta, tau, scale = _larfg(alpha, xnorm_sq, dtype)
+        v = (x * scale).at[0].set(1.0)
+        # left: rows r0..r0+b-1  <-  H^H rows  (H = I - tau v v^H)
+        S = lax.dynamic_slice(DW, (r0, 0), (b, L))
+        S = S - conj(tau) * v[:, None] * (conj(v) @ S)[None, :]
+        DW = lax.dynamic_update_slice(DW, S, (r0, 0))
+        # right: cols r0..r0+b-1  <-  cols H
+        S2 = lax.dynamic_slice(DW, (0, r0), (L, b))
+        S2 = S2 - tau * (S2 @ v)[:, None] * conj(v)[None, :]
+        DW = lax.dynamic_update_slice(DW, S2, (0, r0))
+        # exact eliminated-column pattern
+        newcol = jnp.zeros((b,), dtype).at[0].set(beta)
+        DW = lax.dynamic_update_slice(DW, newcol[:, None], (r0, 0))
+        DW = lax.dynamic_update_slice(DW, conj(newcol)[None, :], (0, r0))
+        return DW, v, tau
+
+    VS0 = jnp.zeros((n_sweeps, Jmax + 1, b), dtype)
+    TAUS0 = jnp.zeros((n_sweeps, Jmax + 1), dtype)
+
+    def superstep(t, carry):
+        W, VS, TAUS = carry
+        i = jnp.arange(NSLOT)
+        s = t // 3 - i
+        j = t - 3 * s
+        row0 = s + j * b + 1  # first reflector row
+        valid = (s >= 0) & (s < n_sweeps) & (row0 <= n - 2)
+        r0 = jnp.where(j == 0, 1, b)
+        w0 = jnp.where(j == 0, s, s + (j - 1) * b + 1)
+        w0c = jnp.where(valid, w0, n_pad - L)  # clamped dummy gather
+        strips = jax.vmap(
+            lambda w: lax.dynamic_slice(W, (0, w), (2 * b + 1, L))
+        )(w0c)
+        DW = jax.vmap(densify)(strips)
+        DW2, v, tau = jax.vmap(chase_window)(DW, r0)
+        strips2 = jax.vmap(bandify)(DW2, strips)
+        # Scatter back ONLY the 2b stored columns a task can modify: a
+        # task writes rows/cols R = [w0+r0, w0+r0+b-1] (r0 <= b), so its
+        # modified stored entries W[d, c] all have c <= w0 + 2b - 1.
+        # Concurrent windows sit 3b-1 columns apart, so these truncated
+        # scatter ranges are disjoint — writing the full L-wide strip
+        # would re-deposit stale copies of the 2 overlap columns a
+        # neighboring task just updated.
+        cols = jnp.where(
+            valid[:, None], w0c[:, None] + jnp.arange(2 * b)[None, :],
+            n_pad + 1,
+        )
+        cols_f = cols.reshape(-1)
+        vals_f = jnp.moveaxis(strips2[:, :, : 2 * b], 1, 0).reshape(
+            2 * b + 1, -1
+        )
+        W = W.at[:, cols_f].set(vals_f, mode="drop")
+        s_w = jnp.where(valid, s, n_sweeps + 1)
+        VS = VS.at[s_w, j].set(v, mode="drop")
+        TAUS = TAUS.at[s_w, j].set(tau, mode="drop")
+        return W, VS, TAUS
+
+    W, VS, TAUS = lax.fori_loop(0, T_total, superstep, (W, VS0, TAUS0))
+    d, e, u = realify(jnp.real(W[0, :n]), W[1, : n - 1])
+    return d, e, u, VS, TAUS
+
+
+@partial(jax.jit, static_argnames=("n", "b", "trans"))
+def unmtr_hb2st(
+    VS: jnp.ndarray, TAUS: jnp.ndarray, Z: jnp.ndarray, n: int, b: int,
+    trans: bool = False,
+) -> jnp.ndarray:
+    """Apply the hb2st back-transform: Z <- Q Z (trans=False) or Q^H Z
+    (reference: src/unmtr_hb2st.cc), Q = product of all chase reflectors
+    in execution order.
+
+    Reflectors of one sweep act on pairwise-disjoint row blocks, so each
+    sweep is ONE batched block-reflector application; sweeps run in a
+    fori_loop (reverse order for Q Z).
+    """
+    if VS.shape[0] <= 1 and n <= 2:
+        return Z
+    n_sweeps, J1, _ = VS.shape
+    m = Z.shape[1]
+    dtype = Z.dtype
+    complex_t = jnp.issubdtype(dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    Zp = jnp.pad(Z, ((0, b + J1 * b + 8), (0, 0)))  # safe gather slack
+
+    def sweep_apply(k, Zp):
+        s = (n_sweeps - 1 - k) if not trans else k
+        rows = s + 1 + jnp.arange(J1)[:, None] * b + jnp.arange(b)[None, :]
+        ok = (rows <= n - 1)
+        rows_c = jnp.where(ok, rows, n)  # padded region (zeros, untouched)
+        v = VS[s]  # (J1, b)
+        tau = TAUS[s]  # (J1,)
+        tau = jnp.where(trans, conj(tau), tau)
+        vv = jnp.where(ok, v, 0)
+        Zr = Zp[rows_c.reshape(-1)].reshape(J1, b, m)
+        wrow = jnp.einsum("jb,jbm->jm", conj(vv), Zr)
+        Zr = Zr - tau[:, None, None] * vv[:, :, None] * wrow[:, None, :]
+        rows_w = jnp.where(ok, rows, Zp.shape[0] + 1)
+        return Zp.at[rows_w.reshape(-1)].set(
+            Zr.reshape(-1, m), mode="drop"
+        )
+
+    Zp = lax.fori_loop(0, n_sweeps, sweep_apply, Zp)
+    return Zp[: Z.shape[0]]
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def tridiag_eigvals_bisect(
+    d: jnp.ndarray, e: jnp.ndarray, max_iter: int = 64
+) -> jnp.ndarray:
+    """All eigenvalues of a real symmetric tridiagonal by bisection with
+    vectorized Sturm counts (reference: sterf.cc's role; the algorithm is
+    LAPACK dstebz's, restructured so every eigenvalue bisects in parallel
+    and each iteration is one scan over the matrix)."""
+    n = d.shape[0]
+    real_t = d.dtype
+    if n == 1:
+        return d
+    e2 = (e * e).astype(real_t)
+    tiny = jnp.asarray(jnp.finfo(real_t).tiny * 4, real_t)
+    # Gershgorin bounds
+    ae = jnp.abs(e)
+    rad = jnp.concatenate([ae, jnp.zeros(1, real_t)]) + jnp.concatenate(
+        [jnp.zeros(1, real_t), ae]
+    )
+    lo0 = jnp.min(d - rad)
+    hi0 = jnp.max(d + rad)
+    span = jnp.maximum(hi0 - lo0, 1.0)
+    lo = jnp.full((n,), lo0 - 1e-3 * span, real_t)
+    hi = jnp.full((n,), hi0 + 1e-3 * span, real_t)
+    ks = jnp.arange(n)
+
+    def count_less(sig):
+        """Sturm count: #eigenvalues < sig[k] for each k, one scan."""
+
+        def body(q, de):
+            di, e2i = de
+            q_safe = jnp.where(jnp.abs(q) < tiny, -tiny, q)
+            qn = (di - sig) - e2i / q_safe
+            return qn, qn < 0
+
+        xs = (d, jnp.concatenate([jnp.zeros(1, real_t), e2]))
+        _, neg = lax.scan(body, jnp.full_like(sig, 1.0), xs)
+        # first step must not subtract: q1 = d0 - sig (e2 prepended 0, q0=1)
+        return jnp.sum(neg, axis=0)
+
+    def it(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        cnt = count_less(mid)
+        go_left = cnt >= ks + 1
+        return jnp.where(go_left, lo, mid), jnp.where(go_left, mid, hi)
+
+    lo, hi = lax.fori_loop(0, max_iter, it, (lo, hi))
+    return 0.5 * (lo + hi)
