@@ -38,6 +38,8 @@ from ..ops import blas2d
 from ..parallel import spmd_blas, spmd_trsm
 from ..parallel.layout import eye_splice, tiles_from_global
 
+from ..internal.precision import accurate_matmul
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -50,6 +52,7 @@ def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
     return out.shard()
 
 
+@accurate_matmul
 def gemm(
     alpha,
     A: Matrix,
@@ -108,6 +111,7 @@ def gemm(
     return _repack_like(out, C)
 
 
+@accurate_matmul
 def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A symmetric (reference: src/symm.cc)."""
@@ -121,6 +125,7 @@ def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
     return _repack_like(out, C)
 
 
+@accurate_matmul
 def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A Hermitian (reference: src/hemm.cc,
@@ -156,6 +161,7 @@ def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None):
     return _repack_like(out, C)
 
 
+@accurate_matmul
 def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha op(A) op(A)^T + beta C (reference: src/syrk.cc)."""
     if A.m != C.m:
@@ -163,6 +169,7 @@ def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
     return _herk_like(alpha, A, beta, C, conj=False)
 
 
+@accurate_matmul
 def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha op(A) op(A)^H + beta C (reference: src/herk.cc)."""
     if A.m != C.m:
@@ -170,6 +177,7 @@ def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
     return _herk_like(alpha, A, beta, C, conj=True)
 
 
+@accurate_matmul
 def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha (A B^T + B A^T) + beta C (reference: src/syr2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
@@ -177,6 +185,7 @@ def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
     return _herk_like(alpha, A, beta, C, conj=False, rank2=True, B=B)
 
 
+@accurate_matmul
 def her2k(alpha, A: Matrix, B: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha A B^H + conj(alpha) B A^H + beta C (reference: src/her2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
@@ -193,6 +202,7 @@ def _resolve_tri(A: TriangularMatrix):
     ), op
 
 
+@accurate_matmul
 def trmm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
     """B = alpha op(A) B or alpha B op(A) (reference: src/trmm.cc)."""
     A2 = A._with(op=Op.NoTrans).to_global()

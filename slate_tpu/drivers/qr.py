@@ -33,6 +33,8 @@ from ..parallel.layout import TileLayout, eye_splice, tiles_from_global
 from ..types import TriangularFactors
 from . import blas3, chol
 
+from ..internal.precision import accurate_matmul
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -49,6 +51,7 @@ def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
     return Gp.at[idx, idx].add(splice)
 
 
+@accurate_matmul
 def geqrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularFactors]:
@@ -96,6 +99,7 @@ def _vt_panels(fac: Matrix):
         yield k, Vk
 
 
+@accurate_matmul
 def unmqr(
     side: Side,
     op: Op,
@@ -131,6 +135,7 @@ def unmqr(
     return C._with(data=tiles_from_global(C2.astype(C.dtype), C.layout)).shard()
 
 
+@accurate_matmul
 def ungqr(
     fac: Matrix, T: TriangularFactors, opts: Optional[Options] = None
 ) -> Matrix:
@@ -147,6 +152,7 @@ def ungqr(
     return unmqr(Side.Left, Op.NoTrans, fac, T, eye, opts)
 
 
+@accurate_matmul
 def gelqf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularFactors]:
@@ -162,6 +168,7 @@ def gelqf(
     return A._with(data=fac.data, layout=fac.layout), T
 
 
+@accurate_matmul
 def unmlq(
     side: Side,
     op: Op,
@@ -178,6 +185,7 @@ def unmlq(
     return unmqr(side, flip[op], facH, T, C, opts)
 
 
+@accurate_matmul
 def cholqr(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray]:
@@ -199,6 +207,7 @@ def cholqr(
     return Q, Rtri, info
 
 
+@accurate_matmul
 def gels(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:

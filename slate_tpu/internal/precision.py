@@ -1,0 +1,55 @@
+"""Matmul precision policy (SURVEY §7 hard-part (5) for f32).
+
+On TPU the default f32 matmul is a single bf16x bf16 MXU pass (~8e-3
+relative unit roundoff) — fine for ML, but LAPACK-parity residual bounds
+(error <= tol * eps_f32) require true f32 accumulation, which XLA
+provides via precision=HIGHEST (multi-pass).  The reference never faces
+this: cuBLAS SGEMM is full f32 by default.
+
+``accurate_matmul`` wraps a driver so every jnp matmul/einsum traced
+inside it uses HIGHEST precision whenever a 32-bit float operand is
+involved; f64/c128 paths are unaffected (TPU f64 emulation is already
+exact-width).  Opt out per-process with SLATE_TPU_FAST_F32=1 to trade
+accuracy for the single-pass MXU rate (the TF32-style mode GPUs opt
+*into*).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+_F32 = (jnp.dtype("float32"), jnp.dtype("complex64"))
+
+
+def _has32(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        return jnp.dtype(dt) in _F32
+    except TypeError:
+        return False
+
+
+def fast_f32() -> bool:
+    return os.environ.get("SLATE_TPU_FAST_F32", "0") not in ("", "0")
+
+
+def accurate_matmul(fn):
+    """Decorator: run the driver under default_matmul_precision('highest')
+    when any argument (or matrix argument's data) is f32/c64."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kw):
+        if not fast_f32() and any(
+            _has32(a) for a in list(args) + list(kw.values())
+        ):
+            with jax.default_matmul_precision("highest"):
+                return fn(*args, **kw)
+        return fn(*args, **kw)
+
+    return wrapper

@@ -233,8 +233,9 @@ def eigh_accurate(
     A: jnp.ndarray, vectors: bool = True
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Vendor eigh + Jacobi polish when the backend's eigh is inexact
-    (TPU f64); plain vendor eigh/eigvalsh elsewhere."""
-    if jax.default_backend() == "cpu" or jnp.finfo(jnp.real(A).dtype).bits <= 32:
+    (the TPU QDWH eigensolver stops short of working precision in both
+    f32 and f64); plain vendor eigh/eigvalsh on CPU."""
+    if jax.default_backend() == "cpu":
         if vectors:
             return jnp.linalg.eigh(A)
         return jnp.linalg.eigvalsh(A), None
@@ -253,9 +254,30 @@ def svd_accurate(A: jnp.ndarray, compute_uv: bool = True):
     accurate; only the vectors need polishing).
     """
     if not compute_uv:
+        # vendor singular *values* are accurate in f64 (measured ~1e-13
+        # rel); f32 values fall short, but upcasting the values-only call
+        # is far cheaper than computing polished vectors
+        if jax.default_backend() == "cpu":
+            return jnp.linalg.svd(A, compute_uv=False)
+        if jnp.finfo(jnp.real(A).dtype).bits <= 32:
+            up = (
+                jnp.complex128
+                if jnp.issubdtype(A.dtype, jnp.complexfloating)
+                else jnp.float64
+            )
+            return jnp.linalg.svd(A.astype(up), compute_uv=False).astype(
+                jnp.finfo(jnp.real(A).dtype).dtype
+            )
         return jnp.linalg.svd(A, compute_uv=False)
-    if jax.default_backend() == "cpu" or jnp.finfo(jnp.real(A).dtype).bits <= 32:
+    if jax.default_backend() == "cpu":
         return jnp.linalg.svd(A, full_matrices=False)
+    if jnp.finfo(jnp.real(A).dtype).bits <= 32:
+        # the TPU backend's f32 SVD-with-vectors aborts its compiler
+        # (f64 compiles and is polished to full precision): upcast,
+        # solve, downcast — exceeds f32 accuracy requirements anyway
+        up = jnp.complex128 if jnp.issubdtype(A.dtype, jnp.complexfloating) else jnp.float64
+        U, s, Vh = svd_accurate(A.astype(up), compute_uv=True)
+        return U.astype(A.dtype), s.astype(jnp.real(A).dtype), Vh.astype(A.dtype)
     m, n = A.shape
     if m > n:
         Q, R = lax.linalg.qr(A, full_matrices=False)

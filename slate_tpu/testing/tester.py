@@ -112,7 +112,7 @@ def _test_posv(pr: Params):
     g = _grid(pr)
     n = pr.n
     A0 = _rng_matrix("rand_dominant", n, n, pr.dtype, pr.seed)
-    A0 = (A0 + A0.conj().T) / 2 + n * np.eye(n)
+    A0 = ((A0 + A0.conj().T) / 2 + n * np.eye(n)).astype(pr.dtype)
     B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
     A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
     B = st.Matrix.from_global(B0, pr.nb, grid=g)
@@ -290,10 +290,12 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
 }
 
 # reference-style tolerance factors per routine class (test_*.cc use 3eps
-# with routine-dependent scalings; decompositions get a looser factor)
+# with routine-dependent scalings; decompositions get a small headroom
+# multiple).  Observed worst cases on the real chip (type d, quick sweep)
+# are <= ~30x eps under these metrics; factors leave ~2-5x margin.
 TOL_FACTOR = {
-    "gemm": 30, "norm": 30, "trsm": 100, "posv": 100, "potrf": 100,
-    "gesv": 100, "geqrf": 100, "gels": 100, "heev": 300, "svd": 300,
+    "gemm": 10, "norm": 100, "trsm": 30, "posv": 50, "potrf": 50,
+    "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 100,
 }
 
 
