@@ -69,6 +69,16 @@ def _lu_dense(A2: jnp.ndarray, nb: int = 32) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return LU[:n, :n], perm[:n]
 
 
+def _udiag_info(LU: Matrix, lay) -> jnp.ndarray:
+    """info code: exact zero / non-finite on U's diagonal."""
+    G = LU.to_global()
+    dmin = min(lay.m, lay.n)
+    udiag = jnp.diagonal(G)[:dmin]
+    return jnp.where(
+        jnp.any(udiag == 0) | ~jnp.all(jnp.isfinite(udiag)), 1, 0
+    ).astype(jnp.int32)
+
+
 def getrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Pivots, jnp.ndarray]:
@@ -80,6 +90,17 @@ def getrf(
     """
     slate_assert(A.op == Op.NoTrans, "getrf expects a non-transposed view")
     lay = A.layout
+    method = get_option(opts, Option.MethodLU, MethodLU.Auto)
+    if isinstance(method, str):
+        method = MethodLU.from_string(method)
+    if method in (MethodLU.CALU, MethodLU.BEAM):
+        # tournament pivoting (reference: getrf_tntpiv.cc; BEAM maps to
+        # the tournament too — both trade the per-column pivot search for
+        # a communication-free reduction, the fit for static schedules)
+        Gp = _padded_global(A)
+        lu2d, perm = lu_kernels.blocked_getrf_tntpiv(Gp, lay.nb)
+        LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
+        return LU, Pivots(perm), _udiag_info(LU, lay)
     use_spmd = _is_distributed(A) and get_option(opts, Option.UseShardMap)
     if use_spmd and lay.mb == lay.nb:
         T = eye_splice(lay, A.data)
@@ -95,12 +116,7 @@ def getrf(
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
         m_valid = lay.m
 
-    # info: exact zero on U's diagonal within the valid range
-    G = LU.to_global()
-    dmin = min(lay.m, lay.n)
-    udiag = jnp.diagonal(G)[:dmin]
-    info = jnp.where(jnp.any(udiag == 0) | ~jnp.all(jnp.isfinite(udiag)), 1, 0)
-    return LU, Pivots(perm), info.astype(jnp.int32)
+    return LU, Pivots(perm), _udiag_info(LU, lay)
 
 
 def getrf_nopiv(
@@ -284,6 +300,8 @@ def _apply_butterfly(X: jnp.ndarray, diags: jnp.ndarray, transpose: bool) -> jnp
     Applied blockwise at each recursion level (reference gerbt.cc kernel
     structure).
     """
+    from ..ops.pallas.kernels import butterfly_level
+
     d, n = diags.shape
     Y = X
     levels = range(d) if transpose else range(d - 1, -1, -1)
@@ -295,16 +313,12 @@ def _apply_butterfly(X: jnp.ndarray, diags: jnp.ndarray, transpose: bool) -> jnp
         D = diags[ell]
         Yr = Y.reshape(blocks, 2 * h, -1)
         Dr = D[: blocks * 2 * h].reshape(blocks, 2 * h)
-        D1, D2 = Dr[:, :h, None], Dr[:, h:, None]
-        x1, x2 = Yr[:, :h], Yr[:, h:]
-        s = np.sqrt(0.5).astype(np.float64)
-        if transpose:
-            top = D1 * x1 + D2 * x2
-            bot = D1 * x1 - D2 * x2
-        else:
-            top = D1 * (x1 + x2)
-            bot = D2 * (x1 - x2)
-        Y = (s * jnp.concatenate([top, bot], axis=1)).reshape(n, -1)
+        # per recursion block: the device butterfly pair kernel
+        # (ops/pallas: one VMEM pass; jnp twin elsewhere)
+        out = jax.vmap(
+            lambda x, dr: butterfly_level(x, dr[:h], dr[h:], transpose)
+        )(Yr, Dr)
+        Y = out.reshape(n, -1)
     return Y
 
 
@@ -442,29 +456,24 @@ def gesv_mixed(
     )
 
 
-def gesv_mixed_gmres(
-    A: Matrix, B: Matrix, opts: Optional[Options] = None
-) -> Tuple[Matrix, jnp.ndarray, int]:
-    """Mixed-precision solve with GMRES(30)-based refinement, LU
-    preconditioner in low precision (reference: src/gesv_mixed_gmres.cc:
-    restart 30, fallback on divergence).  Single-RHS GMRES applied per
-    column."""
-    restart = 30
-    A2 = A.to_global()
-    B2 = B.to_global()
-    lo_t = np.complex64 if A.is_complex else np.float32
-    lu_lo, _, perm = lax.linalg.lu(A2.astype(lo_t))
+def gmres_ir_solve(
+    A2: jnp.ndarray,
+    B2: jnp.ndarray,
+    precond,
+    fallback_solve,
+    anorm,
+    opts: Optional[Options] = None,
+    restart: int = 30,
+) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Shared GMRES(restart)-based iterative refinement core used by
+    gesv_mixed_gmres and posv_mixed_gmres (reference:
+    src/gesv_mixed_gmres.cc:110-165 — right-preconditioned GMRES per
+    column, residual acceptance test, full-precision fallback).
 
-    def precond(R):
-        Rp = R.astype(lo_t)[perm]
-        Y = lax.linalg.triangular_solve(
-            lu_lo, Rp, left_side=True, lower=True, unit_diagonal=True
-        )
-        Z = lax.linalg.triangular_solve(lu_lo, Y, left_side=True, lower=False)
-        return Z.astype(B2.dtype)
-
+    Returns (X, info, iters); iters < 0 means the fallback ran."""
     work_eps = float(jnp.finfo(B2.dtype).eps)
-    tol = float(get_option(opts, Option.Tolerance, np.sqrt(A.n) * work_eps))
+    n = A2.shape[0]
+    tol = float(get_option(opts, Option.Tolerance, np.sqrt(n) * work_eps))
 
     def gmres_col(b):
         x0 = precond(b[:, None])[:, 0]
@@ -472,7 +481,6 @@ def gesv_mixed_gmres(
         beta = jnp.linalg.norm(r0)
 
         # right-preconditioned GMRES(restart) — one cycle
-        n = b.shape[0]
         V = jnp.zeros((restart + 1, n), B2.dtype)
         H = jnp.zeros((restart + 1, restart), B2.dtype)
         V = V.at[0].set(r0 / jnp.where(beta == 0, 1, beta))
@@ -501,17 +509,48 @@ def gesv_mixed_gmres(
     X = jax.vmap(gmres_col, in_axes=1, out_axes=1)(B2)
     # refinement verification + fallback
     R = B2 - A2 @ X
-    anorm = _norm(Norm.Inf, A)
-    ok = bool(jnp.abs(R).max() <= 10 * tol * float(anorm) * float(jnp.abs(X).max()) + 1e-300)
+    ok = bool(
+        jnp.abs(R).max()
+        <= 10 * tol * float(anorm) * float(jnp.abs(X).max()) + 1e-300
+    )
     iters = restart
     if not ok and bool(get_option(opts, Option.UseFallbackSolver, True)):
+        X = fallback_solve(B2)
+        iters = -restart
+    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
+    return X, info, iters
+
+
+def gesv_mixed_gmres(
+    A: Matrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision solve with GMRES(30)-based refinement, LU
+    preconditioner in low precision (reference: src/gesv_mixed_gmres.cc:
+    restart 30, fallback on divergence).  Single-RHS GMRES applied per
+    column."""
+    A2 = A.to_global()
+    B2 = B.to_global()
+    lo_t = np.complex64 if A.is_complex else np.float32
+    lu_lo, _, perm = lax.linalg.lu(A2.astype(lo_t))
+
+    def precond(R):
+        Rp = R.astype(lo_t)[perm]
+        Y = lax.linalg.triangular_solve(
+            lu_lo, Rp, left_side=True, lower=True, unit_diagonal=True
+        )
+        Z = lax.linalg.triangular_solve(lu_lo, Y, left_side=True, lower=False)
+        return Z.astype(B2.dtype)
+
+    def fallback_solve(B2):
         lu_w, perm_w = _lu_dense(A2)
         Y = lax.linalg.triangular_solve(
             lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True
         )
-        X = lax.linalg.triangular_solve(lu_w, Y, left_side=True, lower=False)
-        iters = -restart
-    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
+        return lax.linalg.triangular_solve(lu_w, Y, left_side=True, lower=False)
+
+    X, info, iters = gmres_ir_solve(
+        A2, B2, precond, fallback_solve, _norm(Norm.Inf, A), opts
+    )
     return (
         B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard(),
         info,
@@ -523,19 +562,84 @@ def gecondest(
     LU: Matrix, pivots: Pivots, anorm, norm_type: Norm = Norm.One, opts=None
 ):
     """Reciprocal condition estimate from LU (reference: src/gecondest.cc
-    via the Hager/Higham estimator internal_norm1est.cc; explicit-inverse
-    norm on TPU — see pocondest rationale)."""
-    Ainv = getri(LU, pivots, opts)
-    ainv_norm = _norm(norm_type, Ainv)
-    rcond = 1.0 / (jnp.asarray(anorm) * ainv_norm)
+    via the Hager/Higham 1-norm estimator, internal_norm1est.cc:1-511):
+    O(n^2) factor solves instead of an explicit inverse."""
+    from ..internal.norm1est import norm1est
+
+    G = LU.to_global()
+    n = G.shape[0]
+    perm = jnp.clip(pivots.perm[:n], 0, n - 1)
+    inv_perm = jnp.zeros((n,), perm.dtype).at[perm].set(
+        jnp.arange(n, dtype=perm.dtype)
+    )
+
+    def solve(R):  # A^-1 R  (A = P^T L U)
+        Y = lax.linalg.triangular_solve(
+            G, R[perm], left_side=True, lower=True, unit_diagonal=True
+        )
+        return lax.linalg.triangular_solve(G, Y, left_side=True, lower=False)
+
+    conj_a = LU.is_complex
+
+    def solve_h(R):  # A^-H R
+        Y = lax.linalg.triangular_solve(
+            G, R, left_side=True, lower=False, transpose_a=True,
+            conjugate_a=conj_a,
+        )
+        Z = lax.linalg.triangular_solve(
+            G, Y, left_side=True, lower=True, unit_diagonal=True,
+            transpose_a=True, conjugate_a=conj_a,
+        )
+        return Z[inv_perm]
+
+    if norm_type == Norm.Inf:
+        # ||A^-1||_inf = ||A^-H||_1
+        est = norm1est(solve_h, solve, n, LU.dtype)
+    else:
+        est = norm1est(solve, solve_h, n, LU.dtype)
+    rcond = 1.0 / (jnp.asarray(anorm) * est)
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
 
 
 def trcondest(T: TriangularMatrix, norm_type: Norm = Norm.One, opts=None):
-    """Triangular condition estimate (reference: src/trcondest.cc)."""
-    from .chol import trtri
+    """Triangular condition estimate (reference: src/trcondest.cc via
+    internal_norm1est.cc) — Hager/Higham on T^-1 with O(n^2) solves."""
+    from ..internal.norm1est import norm1est
 
     anorm = _norm(norm_type, T)
-    Tinv = trtri(T, opts)
-    rcond = 1.0 / (jnp.asarray(anorm) * _norm(norm_type, Tinv))
+    G = T._with(op=Op.NoTrans).to_global()
+    n = G.shape[0]
+    st_lower = T.uplo == Uplo.Lower
+    unit = T.diag == Diag.Unit
+    cplx = T.is_complex
+
+    def tri(R, *, trans, conj):
+        if conj and not trans:  # solve conj(G) X = R
+            return jnp.conj(
+                lax.linalg.triangular_solve(
+                    G, jnp.conj(R), left_side=True, lower=st_lower,
+                    unit_diagonal=unit,
+                )
+            )
+        return lax.linalg.triangular_solve(
+            G, R, left_side=True, lower=st_lower, unit_diagonal=unit,
+            transpose_a=trans, conjugate_a=conj and cplx,
+        )
+
+    # op(T) X = R and op(T)^H X = R, expressed in storage (trans, conj)
+    vt = T.op != Op.NoTrans
+    vc = T.op == Op.ConjTrans
+
+    def solve(R):
+        return tri(R, trans=vt, conj=vc)
+
+    def solve_h(R):
+        return tri(R, trans=not vt, conj=cplx and not vc)
+
+    if norm_type == Norm.Inf:
+        # ||T^-1||_inf = ||T^-H||_1
+        est = norm1est(solve_h, solve, n, T.dtype)
+    else:
+        est = norm1est(solve, solve_h, n, T.dtype)
+    rcond = 1.0 / (jnp.asarray(anorm) * est)
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)

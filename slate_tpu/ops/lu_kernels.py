@@ -23,18 +23,22 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def panel_lu(panel: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Unblocked partial-pivot LU of an (M, nb) panel.
+def panel_lu(
+    panel: jnp.ndarray, pivot: bool = True
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Unblocked LU of an (M, nb) panel, partial pivoting by default.
 
     Returns (lu, perm) with lu holding unit-lower L below the diagonal and
     U on/above, and perm the forward permutation: lu rows correspond to
     panel[perm].  Matches lax.linalg.lu's (lu, _, permutation) contract.
     Zero pivot columns produce zero L columns (flagged by the caller's
-    info check), not NaNs.
+    info check), not NaNs.  pivot=False runs the no-exchange elimination
+    (used after tournament pivoting has already ordered the rows).
     """
     M, nb = panel.shape
     rows = jnp.arange(M)
@@ -42,8 +46,11 @@ def panel_lu(panel: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     def body(j, carry):
         a, perm = carry
         col = a[:, j]
-        mag = jnp.where(rows >= j, jnp.abs(col), -jnp.inf)
-        piv = jnp.argmax(mag)
+        if pivot:
+            mag = jnp.where(rows >= j, jnp.abs(col), -jnp.inf)
+            piv = jnp.argmax(mag)
+        else:
+            piv = j
         # swap rows j <-> piv (gather-free: two dynamic row updates)
         rj = a[j]
         rp = a[piv]
@@ -51,9 +58,9 @@ def panel_lu(panel: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         pj = perm[j]
         pp = perm[piv]
         perm = perm.at[j].set(pp).at[piv].set(pj)
-        pivot = a[j, j]
-        safe = jnp.where(pivot == 0, jnp.ones_like(pivot), pivot)
-        l = jnp.where((rows > j) & (pivot != 0), a[:, j] / safe, jnp.zeros(M, a.dtype))
+        pv = a[j, j]
+        safe = jnp.where(pv == 0, jnp.ones_like(pv), pv)
+        l = jnp.where((rows > j) & (pv != 0), a[:, j] / safe, jnp.zeros(M, a.dtype))
         a = a.at[:, j].set(jnp.where(rows > j, l, a[:, j]))
         urow = jnp.where(jnp.arange(nb) > j, a[j], jnp.zeros(nb, a.dtype))
         return a - jnp.outer(l, urow), perm
@@ -113,6 +120,119 @@ def blocked_getrf(
 
     perm0 = jnp.arange(Mp, dtype=jnp.int32)
     return lax.fori_loop(0, kt, step, (Gp, perm0))
+
+
+def tournament_pivots(
+    panel: jnp.ndarray, nb: int, chunk: int
+) -> jnp.ndarray:
+    """Tournament (CALU) pivot selection on an (M, nb) panel (reference:
+    src/getrf_tntpiv.cc:1-498, internal_getrf_tntpiv.cc): every `chunk`
+    rows elect nb candidate pivot rows with a local partial-pivot LU, and
+    winners advance up a binary reduction tree — one communication-free
+    pass per level, the LU variant built for static schedules.
+
+    Returns the nb winning row indices (into panel), in pivot order.
+    """
+    M, nbp = panel.shape
+    assert nbp == nb and chunk >= nb and M % chunk == 0
+    K = M // chunk
+    chunks = panel.reshape(K, chunk, nb)
+    base = jnp.arange(K)[:, None] * chunk
+
+    def elect(ch):
+        _, perm = panel_lu(ch)
+        return ch[perm[:nb]], perm[:nb]
+
+    cands, local_idx = jax.vmap(elect)(chunks)  # (K, nb, nb), (K, nb)
+    idxs = base + local_idx
+
+    while K > 1:
+        if K % 2 == 1:  # odd: last bracket gets a zero-rows bye
+            cands = jnp.concatenate(
+                [cands, jnp.zeros((1, nb, nb), cands.dtype)]
+            )
+            idxs = jnp.concatenate([idxs, jnp.full((1, nb), M, idxs.dtype)])
+            K += 1
+        merged = cands.reshape(K // 2, 2 * nb, nb)
+        midx = idxs.reshape(K // 2, 2 * nb)
+
+        def play(ch, ix):
+            _, perm = panel_lu(ch)
+            return ch[perm[:nb]], ix[perm[:nb]]
+
+        cands, idxs = jax.vmap(play)(merged, midx)
+        K //= 2
+    return idxs[0]
+
+
+def blocked_getrf_tntpiv(
+    Gp: jnp.ndarray, nb: int, chunk: int = 0
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked LU with tournament pivoting (reference: getrf_tntpiv.cc,
+    MethodLU.CALU).  Same right-looking structure as blocked_getrf; the
+    panel's pivot rows come from the communication-free tournament, after
+    which the panel eliminates without further exchanges.
+    """
+    Mp, Np = Gp.shape
+    kt = min(Mp, Np) // nb
+    chunk = chunk or max(4 * nb, nb)
+    # pad rows so every (rolled) panel splits into whole chunks
+    Mc = -(-Mp // chunk) * chunk
+    Gw = jnp.pad(Gp, ((0, Mc - Mp), (0, 0)))
+    rows = jnp.arange(Mc)
+    cols = jnp.arange(Np)
+
+    def step(k, carry):
+        G, perm = carry
+        col = lax.dynamic_slice(G, (0, k * nb), (Mc, nb))
+        colr = jnp.roll(col, -k * nb, axis=0)
+        active_len = Mp - k * nb
+        colr = jnp.where((rows < active_len)[:, None], colr, jnp.zeros_like(colr))
+        # -- tournament pivot selection over the active panel ----------
+        win = tournament_pivots(colr, nb, chunk)  # rows in active frame
+        # step permutation: winners to the top (in order), others keep
+        # their relative order behind them
+        is_win = jnp.zeros((Mc,), jnp.int32).at[win].set(1, mode="drop")
+        win_pos = jnp.zeros((Mc,), jnp.int32).at[win].set(
+            jnp.arange(nb, dtype=jnp.int32), mode="drop"
+        )
+        rest_rank = jnp.cumsum(1 - is_win) - 1
+        key = jnp.where(is_win == 1, win_pos, nb + rest_rank)
+        step_perm_act = jnp.argsort(key)  # active-frame permutation
+        mapped = jnp.where(
+            rows - k * nb >= 0,
+            step_perm_act[jnp.clip(rows - k * nb, 0, Mc - 1)] + k * nb,
+            rows,
+        )
+        step_perm = jnp.where(mapped < Mc, mapped, mapped - Mc)
+        G = G[step_perm]
+        perm = perm[step_perm]
+        # -- panel factor, no further pivoting -------------------------
+        col2 = lax.dynamic_slice(G, (0, k * nb), (Mc, nb))
+        colr2 = jnp.roll(col2, -k * nb, axis=0)
+        colr2 = jnp.where(
+            (rows < active_len)[:, None], colr2, jnp.zeros_like(colr2)
+        )
+        lu_pan, _ = panel_lu(colr2, pivot=False)
+        lu_nat = jnp.roll(lu_pan, k * nb, axis=0)
+        col_cur = lax.dynamic_slice(G, (0, k * nb), (Mc, nb))
+        col_new = jnp.where((rows >= k * nb)[:, None], lu_nat, col_cur)
+        G = lax.dynamic_update_slice(G, col_new, (0, k * nb))
+        # -- U row + trailing update (as blocked_getrf) ----------------
+        Lkk = jnp.tril(lu_pan[:nb], -1) + jnp.eye(nb, dtype=G.dtype)
+        row = lax.dynamic_slice(G, (k * nb, 0), (nb, Np))
+        rs = lax.linalg.triangular_solve(
+            Lkk, row, left_side=True, lower=True, unit_diagonal=True
+        )
+        row_new = jnp.where((cols >= (k + 1) * nb)[None, :], rs, row)
+        G = lax.dynamic_update_slice(G, row_new, (k * nb, 0))
+        Lpan = jnp.where((rows >= (k + 1) * nb)[:, None], col_new, 0)
+        Urow = jnp.where((cols >= (k + 1) * nb)[None, :], row_new, 0)
+        return G - Lpan @ Urow, perm
+
+    perm0 = jnp.arange(Mc, dtype=jnp.int32)
+    G, perm = lax.fori_loop(0, kt, step, (Gw, perm0))
+    return G[:Mp], perm[:Mp]
 
 
 def lu_supported(dtype) -> bool:

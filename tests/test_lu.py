@@ -192,3 +192,75 @@ def test_trcondest(rng):
     rcond = float(lu.trcondest(T))
     ref = 1.0 / (np.linalg.norm(T0, 1) * np.linalg.norm(np.linalg.inv(T0), 1))
     np.testing.assert_allclose(rcond, ref, rtol=0.3)
+
+
+def test_gecondest_norm1est(rng):
+    """Hager/Higham estimate within the usual factor of the exact rcond."""
+    from slate_tpu.drivers import lu as lu_mod
+
+    n = 48
+    M0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    LU, piv, _ = lu_mod.getrf(Matrix.from_global(M0, 16))
+    anorm = np.linalg.norm(M0, 1)
+    rcond = float(lu_mod.gecondest(LU, piv, anorm))
+    ref = 1.0 / (anorm * np.linalg.norm(np.linalg.inv(M0), 1))
+    assert ref <= rcond <= 3.0 * ref, (rcond, ref)
+
+
+def test_trcondest_transposed_view(rng):
+    from slate_tpu.drivers import lu as lu_mod
+    from slate_tpu.matrix.base import conj_transpose
+    from slate_tpu.matrix.matrix import TriangularMatrix
+    from slate_tpu.enums import Uplo
+
+    n = 40
+    T0 = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    T = TriangularMatrix.from_global(T0, 16, uplo=Uplo.Lower)
+    r = float(lu_mod.trcondest(conj_transpose(T)))
+    ref = 1.0 / (np.linalg.norm(T0.T, 1) * np.linalg.norm(np.linalg.inv(T0.T), 1))
+    assert ref <= r * 1.001 and r <= 3.0 * ref, (r, ref)
+
+
+def test_gesv_calu(rng):
+    """Tournament-pivoting LU (reference: getrf_tntpiv.cc, MethodLU.CALU)."""
+    from slate_tpu.enums import MethodLU, Option
+
+    n, nb = 100, 16
+    M0 = rng.standard_normal((n, n))
+    B0 = rng.standard_normal((n, 4))
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(M0, nb), Matrix.from_global(B0, nb),
+        {Option.MethodLU: MethodLU.CALU},
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(M0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=100), err
+    # tournament pivoting keeps multipliers modest
+    assert np.abs(np.tril(np.asarray(LU.to_global()), -1)).max() < 4.0
+
+
+def test_gesv_calu_distributed(rng, grid22):
+    from slate_tpu.enums import MethodLU, Option
+
+    n, nb = 96, 16
+    M0 = rng.standard_normal((n, n)) + np.eye(n)
+    B0 = rng.standard_normal((n, 4))
+    X, LU, piv, info = lu.gesv(
+        Matrix.from_global(M0, nb, grid=grid22),
+        Matrix.from_global(B0, nb, grid=grid22),
+        {Option.MethodLU: MethodLU.CALU},
+    )
+    assert int(info) == 0
+    err = checks.solve_residual(M0, np.asarray(X.to_global()), B0)
+    assert checks.passed(err, np.float64, factor=100), err
+
+
+def test_tournament_pivots_selects_largest(rng):
+    from slate_tpu.ops.lu_kernels import tournament_pivots
+
+    M, nb = 128, 8
+    panel = rng.standard_normal((M, nb)) * 0.1
+    panel[77, 0] = 100.0  # dominant first-column entry must win slot 0
+    win = np.asarray(tournament_pivots(panel, nb, 32))
+    assert win[0] == 77
+    assert len(set(win.tolist())) == nb  # distinct rows
