@@ -66,7 +66,8 @@ from .drivers.aux import (
     scale_row_col, set, set_lambdas,
 )
 from .drivers.chol import (
-    pocondest, posv, posv_mixed, potrf, potri, potrs, trtri, trtrm,
+    pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri, potrs,
+    trtri, trtrm,
 )
 from .drivers.lu import (
     gecondest, gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv,

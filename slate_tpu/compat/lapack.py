@@ -189,14 +189,16 @@ def gesvd(jobu, jobvt, A: np.ndarray):
     from ..drivers import svd as svd_mod
     from ..matrix.matrix import Matrix
 
-    vectors = jobu.lower().startswith(("a", "s")) or jobvt.lower().startswith(("a", "s"))
+    want_u = jobu.lower().startswith(("a", "s"))
+    want_vt = jobvt.lower().startswith(("a", "s"))
     s, U, Vh = svd_mod.svd(
-        Matrix.from_global(np.asarray(A), _nb(min(A.shape))), vectors=vectors
+        Matrix.from_global(np.asarray(A), _nb(min(A.shape))),
+        vectors=want_u or want_vt,
     )
     return (
         np.asarray(s),
-        np.asarray(U.to_global()) if U is not None else None,
-        np.asarray(Vh.to_global()) if Vh is not None else None,
+        np.asarray(U.to_global()) if (want_u and U is not None) else None,
+        np.asarray(Vh.to_global()) if (want_vt and Vh is not None) else None,
     )
 
 

@@ -173,6 +173,13 @@ def pgemm(transa, transb, m, n, k, alpha, a, desca, b, descb, beta, c, descc):
     from ..matrix.base import conj_transpose, transpose
     from ..matrix.matrix import Matrix
 
+    opa0 = transa.lower()[0]
+    am, ak = (desca.m, desca.n) if opa0 == "n" else (desca.n, desca.m)
+    bk, bn = (descb.m, descb.n) if transb.lower()[0] == "n" else (descb.n, descb.m)
+    slate_assert(
+        (m, n, k) == (descc.m, descc.n, ak) and (am, bk, bn) == (m, k, n),
+        "pgemm dims must match the descriptors (submatrix ops unsupported)",
+    )
     A = from_scalapack(desca, a)
     B = from_scalapack(descb, b)
     C = from_scalapack(descc, c)
@@ -200,6 +207,7 @@ def ppotrf(uplo, n, a, desca) -> int:
     from ..drivers import chol
     from ..matrix.matrix import HermitianMatrix
 
+    slate_assert(n == desca.m == desca.n, "ppotrf n must match the descriptor")
     A = from_scalapack(desca, a)
     up = _UPLO[uplo.lower()[0]]
     Am = HermitianMatrix.from_global(A, _nb_env(desca.nb), uplo=up)
@@ -218,6 +226,7 @@ def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
     from ..drivers import lu
     from ..matrix.matrix import Matrix
 
+    slate_assert((m, n) == (desca.m, desca.n), "pgetrf dims must match the descriptor")
     A = from_scalapack(desca, a)
     Am = Matrix.from_global(A, desca.mb, desca.nb)
     LU, piv, info = lu.getrf(Am)
@@ -235,6 +244,10 @@ def pgesv(n, nrhs, a, desca, b, descb) -> int:
     from ..drivers import lu
     from ..matrix.matrix import Matrix
 
+    slate_assert(
+        n == desca.m == desca.n and (n, nrhs) == (descb.m, descb.n),
+        "pgesv dims must match the descriptors",
+    )
     A = from_scalapack(desca, a)
     B = from_scalapack(descb, b)
     Am = Matrix.from_global(A, desca.mb, desca.nb)
@@ -249,6 +262,10 @@ def pposv(uplo, n, nrhs, a, desca, b, descb) -> int:
     from ..drivers import chol
     from ..matrix.matrix import HermitianMatrix, Matrix
 
+    slate_assert(
+        n == desca.m == desca.n and (n, nrhs) == (descb.m, descb.n),
+        "pposv dims must match the descriptors",
+    )
     A = from_scalapack(desca, a)
     B = from_scalapack(descb, b)
     up = _UPLO[uplo.lower()[0]]
@@ -269,6 +286,7 @@ def pgeqrf(m, n, a, desca):
     from ..drivers import qr
     from ..matrix.matrix import Matrix
 
+    slate_assert((m, n) == (desca.m, desca.n), "pgeqrf dims must match the descriptor")
     A = from_scalapack(desca, a)
     Am = Matrix.from_global(A, desca.mb, desca.nb)
     fac, T = qr.geqrf(Am)
@@ -281,6 +299,10 @@ def ptrsm(side, uplo, transa, diag, m, n, alpha, a, desca, b, descb) -> int:
     from ..matrix.base import conj_transpose, transpose
     from ..matrix.matrix import Matrix, TriangularMatrix
 
+    slate_assert(
+        (m, n) == (descb.m, descb.n) and desca.m == desca.n,
+        "ptrsm dims must match the descriptors",
+    )
     A = from_scalapack(desca, a)
     B = from_scalapack(descb, b)
     up = _UPLO[uplo.lower()[0]]
@@ -302,6 +324,7 @@ def plange(norm, m, n, a, desca) -> float:
     from ..drivers import aux
     from ..matrix.matrix import Matrix
 
+    slate_assert((m, n) == (desca.m, desca.n), "plange dims must match the descriptor")
     A = from_scalapack(desca, a)
     Am = Matrix.from_global(A, desca.mb, desca.nb)
     nt = {"m": Norm.Max, "1": Norm.One, "o": Norm.One, "i": Norm.Inf,

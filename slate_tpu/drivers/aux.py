@@ -111,13 +111,16 @@ def norm(
     internal::genorm/synorm/henorm/trnorm with MPI allreduce; here one
     masked XLA reduction, psum'd automatically when sharded)."""
     Ar = A.resolved()
+    # Pallas tile kernels only for single-chip arrays; sharded arrays
+    # stay on the GSPMD jnp path so the reductions lower to psum/pmax.
+    pallas_ok = A.grid is None or A.grid.size == 1
     if isinstance(A, HermitianMatrix):
         return _norms.henorm(norm_type, Ar.data, Ar.layout, Ar.uplo)
     if isinstance(A, SymmetricMatrix):
         return _norms.synorm(norm_type, Ar.data, Ar.layout, Ar.uplo)
     if isinstance(A, BaseTrapezoidMatrix) and A.uplo != Uplo.General:
         return _norms.trnorm(norm_type, Ar.data, Ar.layout, Ar.uplo, Ar.diag)
-    return _norms.genorm(norm_type, Ar.data, Ar.layout, scope)
+    return _norms.genorm(norm_type, Ar.data, Ar.layout, scope, pallas_ok=pallas_ok)
 
 
 def colNorms(norm_type: Norm, A: BaseMatrix, opts=None):

@@ -212,10 +212,64 @@ def pocondest(
 ):
     """Reciprocal condition estimate from the Cholesky factor (reference:
     src/pocondest.cc via Hager/Higham 1-norm estimation,
-    internal_norm1est.cc).  Uses the explicit-inverse 1-norm on TPU (the
-    estimator's sequential re-solves serialize badly; the inverse is one
-    triangular solve pair, MXU-friendly)."""
-    Ainv = potri(L, opts)
-    ainv_norm = _norm(Norm.One, Ainv)
-    rcond = 1.0 / (jnp.asarray(anorm) * ainv_norm)
+    internal_norm1est.cc:1-511): O(n^2) factor solves per probe instead
+    of the O(n^3) explicit inverse; A^-1 is self-adjoint so one solve
+    closure serves both directions."""
+    from ..internal.norm1est import norm1est
+
+    G = L._with(op=Op.NoTrans).to_global()
+    n = G.shape[0]
+    lower = L.uplo == Uplo.Lower
+    cplx = L.is_complex
+
+    def solve(R):
+        Y = lax.linalg.triangular_solve(
+            G, R, left_side=True, lower=lower, transpose_a=not lower,
+            conjugate_a=cplx and not lower,
+        )
+        return lax.linalg.triangular_solve(
+            G, Y, left_side=True, lower=lower, transpose_a=lower,
+            conjugate_a=cplx and lower,
+        )
+
+    est = norm1est(solve, solve, n, L.dtype)
+    rcond = 1.0 / (jnp.asarray(anorm) * est)
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
+
+
+def posv_mixed_gmres(
+    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision SPD solve with GMRES(30) refinement, f32 Cholesky
+    preconditioner (reference: src/posv_mixed_gmres.cc — the SPD variant
+    of gesv_mixed_gmres; shares the GMRES-IR core with the LU variant)."""
+    from .lu import gmres_ir_solve
+
+    lo_t = np.complex64 if A.is_complex else np.float32
+    A_full = A.full_global()
+    B2 = B.to_global()
+    L_lo = lax.linalg.cholesky(A_full.astype(lo_t))
+
+    def precond(R):
+        Y = lax.linalg.triangular_solve(
+            L_lo, R.astype(lo_t), left_side=True, lower=True
+        )
+        Z = lax.linalg.triangular_solve(
+            L_lo, Y, left_side=True, lower=True, transpose_a=True,
+            conjugate_a=A.is_complex,
+        )
+        return Z.astype(B2.dtype)
+
+    def fallback_solve(B2):
+        Lw = lax.linalg.cholesky(A_full)
+        Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)
+        return lax.linalg.triangular_solve(
+            Lw, Y, left_side=True, lower=True, transpose_a=True,
+            conjugate_a=A.is_complex,
+        )
+
+    X, info, iters = gmres_ir_solve(
+        A_full, B2, precond, fallback_solve, _norm(Norm.Inf, A), opts
+    )
+    Xm = B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard()
+    return Xm, info, iters

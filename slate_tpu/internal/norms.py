@@ -35,16 +35,36 @@ def _masked(A, mask, fill=0):
     return jnp.where(mask, A, jnp.asarray(fill, A.dtype))
 
 
-def _col_sums(absA, layout: TileLayout):
+def _tile_stats(absA, kind: str, pallas_ok: bool):
+    """Per-tile norm statistics over the (P, Q, mb, nb) array via the
+    Pallas tile-kernel layer (reference: device_genorm.cu's one-pass
+    per-block reductions) when the array lives on one TPU chip; plain jnp
+    reductions otherwise (multi-device arrays stay on the GSPMD path)."""
+    from ..ops.pallas import kernels as pk
+
+    P, Q, mb, nb = absA.shape
+    stack = absA.reshape(P * Q, mb, nb)
+    if pallas_ok and pk.on_tpu():
+        out = pk.tile_norms(stack, kind)
+    else:
+        out = pk.tile_norms_reference(stack, kind)
+    if kind in ("max", "fro_sumsq"):
+        return out.reshape(P, Q)
+    if kind == "one":
+        return out.reshape(P, Q, nb)
+    return out.reshape(P, Q, mb)
+
+
+def _col_sums(absA, layout: TileLayout, pallas_ok: bool = False):
     """Per-global-column sums -> (n,) vector. Tile cols scatter back to
     natural order via the static permutation."""
-    sums = absA.sum(axis=(0, 2))  # (Q, nb)
+    sums = _tile_stats(absA, "one", pallas_ok).sum(axis=0)  # (Q, nb)
     nat = sums[layout.col_scatter]  # natural tile order
     return nat.reshape(-1)[: layout.n]
 
 
-def _row_sums(absA, layout: TileLayout):
-    sums = absA.sum(axis=(1, 3))  # (P, mb)
+def _row_sums(absA, layout: TileLayout, pallas_ok: bool = False):
+    sums = _tile_stats(absA, "inf", pallas_ok).sum(axis=1)  # (P, mb)
     nat = sums[layout.row_scatter]
     return nat.reshape(-1)[: layout.m]
 
@@ -55,33 +75,37 @@ def genorm(
     layout: TileLayout,
     scope: NormScope = NormScope.Matrix,
     mask: Optional[jnp.ndarray] = None,
+    pallas_ok: bool = False,
 ):
     """General matrix norm (reference: slate::norm -> internal::genorm,
-    src/internal/internal_genorm.cc; NormScope enums.hh:514)."""
+    src/internal/internal_genorm.cc; NormScope enums.hh:514).  With
+    pallas_ok (single-chip TPU arrays) the per-tile statistics run in the
+    Pallas tile-kernel layer."""
     mask = layout.element_mask() if mask is None else mask
     absA = _masked(_abs(T), mask)
     if scope == NormScope.Columns:
         if norm != Norm.One:
             raise SlateError("column-scope norm supports Norm.One (colNorms)")
-        return _col_sums(absA, layout)
+        return _col_sums(absA, layout, pallas_ok)
     if scope == NormScope.Rows:
         if norm != Norm.Inf:
             raise SlateError("row-scope norm supports Norm.Inf")
-        return _row_sums(absA, layout)
+        return _row_sums(absA, layout, pallas_ok)
 
     if norm == Norm.Max:
-        return absA.max()
+        return _tile_stats(absA, "max", pallas_ok).max()
     if norm == Norm.One:
-        return _col_sums(absA, layout).max()
+        return _col_sums(absA, layout, pallas_ok).max()
     if norm == Norm.Inf:
-        return _row_sums(absA, layout).max()
+        return _row_sums(absA, layout, pallas_ok).max()
     if norm == Norm.Fro:
         # scaled ssq for overflow safety (LAPACK lassq semantics)
-        amax = absA.max()
+        amax = _tile_stats(absA, "max", pallas_ok).max()
         safe = jnp.where(amax == 0, 1, amax)
         scaled = absA / safe
+        ssq = _tile_stats(scaled, "fro_sumsq", pallas_ok).sum()
         return jnp.where(
-            amax == 0, jnp.asarray(0, safe.dtype), safe * jnp.sqrt((scaled * scaled).sum())
+            amax == 0, jnp.asarray(0, safe.dtype), safe * jnp.sqrt(ssq)
         )
     raise SlateError(f"unsupported norm {norm}")
 

@@ -64,7 +64,7 @@ def test_gemm_ops(rng, opA, opB):
 
 
 @pytest.mark.parametrize("method", [MethodGemm.C, MethodGemm.A])
-@pytest.mark.parametrize("mnk", [(96, 96, 96), (80, 48, 64)])
+@pytest.mark.parametrize("mnk", [(96, 96, 96), (80, 48, 64), (90, 54, 70)])
 def test_gemm_distributed(rng, grid22, method, mnk):
     m, n, k = mnk
     dtype = np.float64

@@ -40,7 +40,7 @@ def test_potrf_upper(rng):
     assert checks.passed(err, np.float64, factor=30), err
 
 
-@pytest.mark.parametrize("n,nb", [(64, 16), (96, 16), (72, 8)])
+@pytest.mark.parametrize("n,nb", [(64, 16), (96, 16), (72, 8), (90, 16), (53, 8)])
 def test_potrf_distributed(rng, grid22, n, nb):
     A0 = _spd(rng, n)
     A = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
@@ -147,4 +147,18 @@ def test_pocondest(rng):
     L, _ = chol.potrf(A)
     rcond = float(chol.pocondest(L, anorm))
     ref = 1.0 / (np.linalg.norm(A0, 1) * np.linalg.norm(np.linalg.inv(A0), 1))
-    np.testing.assert_allclose(rcond, ref, rtol=0.3)
+    # Hager/Higham estimates a lower bound on ||A^-1||_1, so rcond is an
+    # upper bound on the true rcond, reliably within a small factor
+    assert ref * 0.999 <= rcond <= 3.0 * ref, (rcond, ref)
+
+
+def test_posv_mixed_gmres(rng):
+    n, nrhs = 48, 4
+    A0 = _spd(rng, n)
+    B0 = rng.standard_normal((n, nrhs))
+    A = HermitianMatrix.from_global(A0, 16, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, 16)
+    X, info, iters = chol.posv_mixed_gmres(A, B)
+    assert int(info) == 0
+    err = np.abs(np.asarray(X.to_global()) - np.linalg.solve(A0, B0)).max()
+    assert err < 1e-12, (err, iters)

@@ -33,7 +33,7 @@ def test_geqrf_single(rng, dtype, m, n, nb):
     assert checks.passed(err, dtype, factor=30), err
 
 
-@pytest.mark.parametrize("m,n,nb", [(96, 96, 16), (96, 64, 16), (64, 64, 8)])
+@pytest.mark.parametrize("m,n,nb", [(96, 96, 16), (96, 64, 16), (64, 64, 8), (90, 70, 16), (75, 75, 8)])
 def test_geqrf_distributed(rng, grid22, m, n, nb):
     A0 = _mk(rng, m, n)
     A = Matrix.from_global(A0, nb, grid=grid22)
