@@ -1,75 +1,187 @@
 #!/usr/bin/env python
-"""Headline benchmark: single-chip large gemm through the slate_tpu driver.
+"""Headline benchmark sweep over the driver stack on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Baseline: the reference's only published figure is dgemm at 0.70 TFLOP/s
-per GPU (4 ranks, GPU-aware MPI; reference docs/usage.md:40-42, see
-BASELINE.md).  vs_baseline = our GFLOP/s per chip / 700.
+Headline metric: sgemm GFLOP/s per chip in the single-pass MXU mode
+(SLATE_TPU_FAST_F32, the mode BENCH_r01 measured).  Baseline: the
+reference's only published figure, dgemm 0.70 TFLOP/s per GPU (reference
+docs/usage.md:40-42; see BASELINE.md).  vs_baseline = GFLOP/s / 700.
 
-Runs on whatever accelerator jax exposes (the axon TPU chip under the
-driver; CPU elsewhere).  f32: the TPU MXU's native precision class — the
-reference's f64 runs on GPUs with native f64 units, the TPU analogue is
-f32 (see SURVEY §7 hard-part (5)).
+"extra" carries the north-star routine entries (BASELINE.json asks for
+gemm/potrf/getrf/geqrf/heev): dgemm + f64 factorizations + the two-stage
+heev values path, each with GFLOP/s and seconds.  f32 accurate-mode gemm
+(the product default after the precision policy) is reported alongside
+the fast mode.  See BENCH_NOTES.md for methodology and regression notes.
 """
 
 import json
+import os
 import time
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 
-def main():
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    n = 8192 if on_tpu else 512
-    nb = 1024 if on_tpu else 128
-    dtype = jnp.float32
+def _bench(step_fn, warm_args, trials):
+    """Best-of wall time with host readback as the barrier."""
+    float(step_fn(*warm_args, 0.0))  # compile + warmup
+    best = float("inf")
+    for trial in range(trials):
+        t0 = time.perf_counter()
+        s = float(step_fn(*warm_args, 1.0 + trial))
+        best = min(best, time.perf_counter() - t0)
+        assert np.isfinite(s)
+    return best
 
+
+def bench_gemm(jax, jnp, n, nb, dtype, K, trials):
     from slate_tpu.drivers import blas3
     from slate_tpu.matrix.matrix import Matrix
 
     key = jax.random.PRNGKey(0)
     ka, kb = jax.random.split(key)
-    A2 = jax.random.normal(ka, (n, n), dtype)
-    B2 = jax.random.normal(kb, (n, n), dtype) * (1.0 / n)
-
-    A = Matrix.from_global(A2, nb)
-    B = Matrix.from_global(B2, nb)
-
-    # Chain K dependent gemms inside ONE jit call: per-call dispatch over
-    # the device tunnel is ~100ms, so the timed region must amortize it,
-    # and chaining defeats any result caching of repeated identical calls.
-    K = 8 if on_tpu else 3
+    A = Matrix.from_global(jax.random.normal(ka, (n, n), dtype), nb)
+    B = Matrix.from_global(jax.random.normal(kb, (n, n), dtype) * (1.0 / n), nb)
 
     @jax.jit
     def step(A, B, t):
-        # t varies per trial so no layer of the stack can serve a cached
-        # result for a repeated identical invocation
+        # t varies per trial so no layer can serve a cached result; the
+        # K-chain amortizes per-dispatch tunnel latency (~100ms)
         C = A._with(data=A.data + t)
         for _ in range(K):
             C = blas3.gemm(1.0, C, B, 0.0, C)
-        return C.data.sum()  # scalar readback forces real execution
+        return C.data.sum()
 
-    float(step(A, B, 0.0))  # compile + warmup
+    best = _bench(step, (A, B), trials)
+    return 2.0 * n**3 * K / best / 1e9, best / K
 
-    best = float("inf")
-    for trial in range(5 if on_tpu else 2):
-        t0 = time.perf_counter()
-        s = float(step(A, B, 1.0 + trial))  # host readback = hard barrier
-        best = min(best, time.perf_counter() - t0)
-    assert np.isfinite(s)
 
-    gflops = 2.0 * n * n * n * K / best / 1e9
+def bench_potrf(jax, jnp, n, nb, trials):
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(1)
+    G = jax.random.normal(key, (n, n), jnp.float64) / np.sqrt(n)
+    S = G @ G.T + 2.0 * jnp.eye(n, dtype=jnp.float64)
+    A = st.HermitianMatrix.from_global(S, nb, uplo=st.Uplo.Lower)
+
+    @jax.jit
+    def step(A, t):
+        L, info = st.potrf(A._with(data=A.data + t * 1e-14))
+        return L.data.sum() + info
+
+    best = _bench(step, (A,), trials)
+    return n**3 / 3.0 / best / 1e9, best
+
+
+def bench_getrf(jax, jnp, n, nb, trials):
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(2)
+    G = jax.random.normal(key, (n, n), jnp.float64)
+    A = st.Matrix.from_global(G + n * jnp.eye(n, dtype=jnp.float64), nb)
+
+    @jax.jit
+    def step(A, t):
+        LU, piv, info = st.getrf(A._with(data=A.data + t * 1e-14))
+        return LU.data.sum() + info
+
+    best = _bench(step, (A,), trials)
+    return 2.0 * n**3 / 3.0 / best / 1e9, best
+
+
+def bench_geqrf(jax, jnp, n, nb, trials):
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(3)
+    A = st.Matrix.from_global(jax.random.normal(key, (n, n), jnp.float64), nb)
+
+    @jax.jit
+    def step(A, t):
+        fac, T = st.geqrf(A._with(data=A.data + t * 1e-14))
+        return fac.data.sum()
+
+    best = _bench(step, (A,), trials)
+    return 4.0 * n**3 / 3.0 / best / 1e9, best
+
+
+def bench_heev_values(jax, jnp, n, nb, trials):
+    """Two-stage heev, eigenvalues only: he2hb + hb2st wavefront +
+    Sturm bisection — no vendor eigensolver anywhere on this path."""
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(4)
+    G = jax.random.normal(key, (n, n), jnp.float64)
+    S = (G + G.T) / 2
+    A = st.HermitianMatrix.from_global(S, nb, uplo=st.Uplo.Lower)
+
+    @jax.jit
+    def step(A, t):
+        w, _ = st.heev(A._with(data=A.data + t * 1e-14), vectors=False)
+        return w.sum()
+
+    best = _bench(step, (A,), trials)
+    return 4.0 * n**3 / 3.0 / best / 1e9, best
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    trials = 5 if on_tpu else 2
+    extra = {}
+
+    # -- headline: fast-f32 sgemm (BENCH_r01's mode) ----------------------
+    os.environ["SLATE_TPU_FAST_F32"] = "1"
+    n = 8192 if on_tpu else 512
+    gf_fast, sec = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+                              jnp.float32, 8 if on_tpu else 2, trials)
+    extra["sgemm_fast_f32"] = {"n": n, "gflops": round(gf_fast, 1)}
+
+    # -- accurate-mode f32 gemm (product default) -------------------------
+    os.environ["SLATE_TPU_FAST_F32"] = "0"
+    gf_acc, _ = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+                           jnp.float32, 4 if on_tpu else 2, trials)
+    extra["sgemm_accurate"] = {"n": n, "gflops": round(gf_acc, 1)}
+
+    # -- dgemm (the north-star dtype) -------------------------------------
+    nd = 4096 if on_tpu else 256
+    gf_d, _ = bench_gemm(jax, jnp, nd, 512 if on_tpu else 128,
+                         jnp.float64, 4 if on_tpu else 2, trials)
+    extra["dgemm"] = {"n": nd, "gflops": round(gf_d, 1)}
+
+    # -- f64 factorizations ------------------------------------------------
+    nf = 4096 if on_tpu else 256
+    gf, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
+    extra["dpotrf"] = {"n": nf, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+    nl = 2048 if on_tpu else 128
+    gf, sec = bench_getrf(jax, jnp, nl, 256 if on_tpu else 32, trials)
+    extra["dgetrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+    gf, sec = bench_geqrf(jax, jnp, nl, 256 if on_tpu else 32, trials)
+    extra["dgeqrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
+    nh = 1024 if on_tpu else 96
+    try:
+        gf, sec = bench_heev_values(jax, jnp, nh, 64 if on_tpu else 8,
+                                    max(2, trials - 3))
+        extra["dheev_values_two_stage"] = {
+            "n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit its line
+        extra["dheev_values_two_stage"] = {"error": str(e)[:120]}
+
     baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
     print(
         json.dumps(
             {
                 "metric": f"sgemm_n{n}_gflops_per_chip",
-                "value": round(gflops, 1),
+                "value": round(gf_fast, 1),
                 "unit": "GFLOP/s",
-                "vs_baseline": round(gflops / baseline_gflops, 3),
+                "vs_baseline": round(gf_fast / baseline_gflops, 3),
+                "extra": extra,
             }
         )
     )

@@ -1,0 +1,18 @@
+"""Shared example setup: path + jax config + residual helper."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def check(name: str, err: float, tol: float = 1e-10) -> None:
+    status = "ok" if err < tol else "FAILED"
+    print(f"{name}: residual {err:.2e} {status}")
+    assert err < tol, name

@@ -1,0 +1,12 @@
+"""ex01: creating matrices (reference: examples/ex01_matrix.cc).
+
+Build matrices from global arrays, inspect tiling, round-trip."""
+from _common import np
+import slate_tpu as st
+
+A0 = np.arange(20.0 * 12).reshape(20, 12)
+A = st.Matrix.from_global(A0, 8)
+print(A)  # 20x12, tiles 8x8
+assert A.mt == 3 and A.nt == 2
+assert np.array_equal(np.asarray(A.to_global()), A0)
+print("ex01 ok")

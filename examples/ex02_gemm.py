@@ -1,0 +1,12 @@
+"""ex02: matrix multiply (reference: examples/ex05_blas.cc gemm)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(0)
+m, n, k, nb = 96, 64, 80, 16
+A = st.Matrix.from_global(rng.standard_normal((m, k)), nb)
+B = st.Matrix.from_global(rng.standard_normal((k, n)), nb)
+C = st.Matrix.from_global(rng.standard_normal((m, n)), nb)
+C2 = st.gemm(2.0, A, B, -1.0, C)
+ref = 2.0 * np.asarray(A.to_global()) @ np.asarray(B.to_global()) - np.asarray(C.to_global())
+check("ex02 gemm", np.abs(np.asarray(C2.to_global()) - ref).max() / np.abs(ref).max())

@@ -1,0 +1,13 @@
+"""ex03: SPD solve (reference: examples/ex06_linear_system_cholesky.cc)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(1)
+n, nrhs, nb = 100, 4, 16
+A0 = rng.standard_normal((n, n)); A0 = A0 @ A0.T + n * np.eye(n)
+B0 = rng.standard_normal((n, nrhs))
+A = st.HermitianMatrix.from_global(A0, nb, uplo=st.Uplo.Lower)
+B = st.Matrix.from_global(B0, nb)
+X, L, info = st.posv(A, B)
+assert int(info) == 0
+check("ex03 posv", np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max())

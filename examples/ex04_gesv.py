@@ -1,0 +1,17 @@
+"""ex04: LU solve + variants (reference: examples/ex07_linear_system_lu.cc)."""
+from _common import check, np
+import slate_tpu as st
+from slate_tpu.enums import MethodLU, Option
+
+rng = np.random.default_rng(2)
+n, nb = 100, 16
+A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+B0 = rng.standard_normal((n, 4))
+for method in (MethodLU.PartialPiv, MethodLU.CALU, MethodLU.NoPiv, MethodLU.RBT):
+    X, LU, piv, info = st.gesv(
+        st.Matrix.from_global(A0, nb), st.Matrix.from_global(B0, nb),
+        {Option.MethodLU: method},
+    )
+    assert int(info) == 0
+    check(f"ex04 gesv[{method.name}]",
+          np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max(), 1e-8)

@@ -1,0 +1,11 @@
+"""ex05: least squares (reference: examples/ex08_linear_system_lls.cc)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(3)
+m, n, nb = 120, 60, 16
+A0 = rng.standard_normal((m, n))
+B0 = rng.standard_normal((m, 2))
+X = st.gels(st.Matrix.from_global(A0, nb), st.Matrix.from_global(B0, nb))
+ref, *_ = np.linalg.lstsq(A0, B0, rcond=None)
+check("ex05 gels", np.abs(np.asarray(X.to_global())[:n] - ref).max() / np.abs(ref).max())

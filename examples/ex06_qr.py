@@ -1,0 +1,12 @@
+"""ex06: QR factorization (reference: examples/ex09_*_qr)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(4)
+m, n, nb = 96, 64, 16
+A0 = rng.standard_normal((m, n))
+fac, T = st.geqrf(st.Matrix.from_global(A0, nb))
+Q = np.asarray(st.ungqr(fac, T).to_global())
+R = np.triu(np.asarray(fac.to_global()))[:n]
+check("ex06 geqrf |A-QR|", np.abs(A0 - Q @ R).max() / np.abs(A0).max())
+check("ex06 geqrf |QtQ-I|", np.abs(Q.T @ Q - np.eye(n)).max())

@@ -1,0 +1,12 @@
+"""ex07: Hermitian eigenvalues (reference: examples/ex12_hermitian_eig.cc)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(5)
+n, nb = 80, 8  # n > 4 nb: two-stage bulge-chase path
+A0 = rng.standard_normal((n, n)); A0 = (A0 + A0.T) / 2
+A = st.HermitianMatrix.from_global(A0, nb, uplo=st.Uplo.Lower)
+w, Z = st.heev(A)
+w, Zg = np.asarray(w), np.asarray(Z.to_global())
+check("ex07 heev values", np.abs(w - np.linalg.eigvalsh(A0)).max() / np.abs(w).max())
+check("ex07 heev residual", np.abs(A0 @ Zg - Zg * w[None, :]).max() / np.abs(A0).max())

@@ -1,0 +1,12 @@
+"""ex08: singular values (reference: examples/ex13_svd.cc)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(6)
+m, n, nb = 100, 60, 4
+A0 = rng.standard_normal((m, n))
+s, U, Vh = st.svd(st.Matrix.from_global(A0, nb), vectors=True)
+s = np.asarray(s)
+check("ex08 svd values", np.abs(s - np.linalg.svd(A0, compute_uv=False)).max() / s.max())
+rec = (np.asarray(U.to_global()) * s[None, :]) @ np.asarray(Vh.to_global())
+check("ex08 svd recon", np.abs(rec - A0).max() / np.abs(A0).max())

@@ -1,0 +1,12 @@
+"""ex10: deterministic matrix generation (reference: matgen/ Philox
+counter RNG — same matrix for any tiling or process count)."""
+from _common import np
+import slate_tpu as st
+from slate_tpu.matgen.generate import generate_2d
+
+A1 = np.asarray(generate_2d("rand", 64, 64, np.float64, seed=42)[0])
+A2 = np.asarray(generate_2d("rand", 64, 64, np.float64, seed=42)[0])
+assert np.array_equal(A1, A2)
+H = np.asarray(generate_2d("hilb", 8, 8, np.float64)[0])
+assert np.isclose(H[2, 3], 1.0 / 6.0)
+print("ex10 matgen ok")

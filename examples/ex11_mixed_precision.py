@@ -1,0 +1,17 @@
+"""ex11: mixed-precision solvers (reference: examples using
+gesv_mixed / posv_mixed; f32 factorization + f64 refinement)."""
+from _common import check, np
+import slate_tpu as st
+
+rng = np.random.default_rng(8)
+n, nb = 64, 16
+A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+B0 = rng.standard_normal((n, 2))
+X, info, iters = st.gesv_mixed(st.Matrix.from_global(A0, nb), st.Matrix.from_global(B0, nb))
+assert int(info) == 0 and iters >= 0
+check("ex11 gesv_mixed", np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max(), 1e-11)
+S0 = A0 @ A0.T + n * np.eye(n)
+X2, info, iters = st.posv_mixed_gmres(
+    st.HermitianMatrix.from_global(S0, nb, uplo=st.Uplo.Lower),
+    st.Matrix.from_global(B0, nb))
+check("ex11 posv_mixed_gmres", np.abs(S0 @ np.asarray(X2.to_global()) - B0).max() / np.abs(B0).max(), 1e-10)

@@ -1,0 +1,15 @@
+"""ex12: ScaLAPACK-layout compatibility (reference: scalapack_api/)."""
+from _common import check, np
+from slate_tpu.compat import scalapack as sca
+
+rng = np.random.default_rng(9)
+n = 64
+grid = sca.BlacsGrid(2, 2)
+desc = sca.descinit(n, n, 16, 16, grid)
+db = sca.descinit(n, 4, 16, 16, grid)
+A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+B0 = rng.standard_normal((n, 4))
+la, lb = sca.to_scalapack(desc, A0), sca.to_scalapack(db, B0)
+info = sca.pdgesv(n, 4, la, desc, lb, db)
+assert info == 0
+check("ex12 pdgesv", np.abs(sca.from_scalapack(db, lb) - np.linalg.solve(A0, B0)).max())

@@ -1,0 +1,15 @@
+"""ex13: norms + condition estimation (reference: examples norm/cond)."""
+from _common import np
+import slate_tpu as st
+from slate_tpu.enums import Norm
+
+rng = np.random.default_rng(10)
+n = 64
+A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+A = st.Matrix.from_global(A0, 16)
+assert np.isclose(float(st.norm(Norm.Fro, A)), np.linalg.norm(A0))
+LU, piv, _ = st.getrf(A)
+rcond = float(st.gecondest(LU, piv, np.linalg.norm(A0, 1)))
+ref = 1.0 / (np.linalg.norm(A0, 1) * np.linalg.norm(np.linalg.inv(A0), 1))
+assert ref * 0.99 <= rcond <= 3 * ref
+print("ex13 norm+condest ok")

@@ -1,0 +1,11 @@
+"""ex14: verb-named simplified API (reference: simplified_api.hh)."""
+from _common import check, np
+import slate_tpu as st
+from slate_tpu import simplified as sl
+
+rng = np.random.default_rng(11)
+n, nb = 64, 16
+A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+B0 = rng.standard_normal((n, 3))
+X = sl.lu_solve(st.Matrix.from_global(A0, nb), st.Matrix.from_global(B0, nb))
+check("ex14 lu_solve", np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max())

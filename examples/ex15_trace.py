@@ -1,0 +1,16 @@
+"""ex15: phase tracing with SVG timeline (reference: --trace, Trace.hh)."""
+import os
+from _common import np
+import slate_tpu as st
+from slate_tpu.aux import trace
+
+trace.on()
+rng = np.random.default_rng(12)
+n = 64
+A0 = rng.standard_normal((n, n)); S = A0 @ A0.T + n * np.eye(n)
+B0 = rng.standard_normal((n, 2))
+st.posv(st.HermitianMatrix.from_global(S, 16, uplo=st.Uplo.Lower),
+        st.Matrix.from_global(B0, 16))
+path = trace.finish("/tmp/slate_tpu_trace.svg")
+assert os.path.getsize(path) > 100
+print(f"ex15 trace ok: {path}")

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Run every example as a smoke test (reference: examples/run_tests.py)."""
+import pathlib
+import subprocess
+import sys
+
+here = pathlib.Path(__file__).parent
+failures = []
+for ex in sorted(here.glob("ex*.py")):
+    r = subprocess.run([sys.executable, ex.name], cwd=here,
+                       capture_output=True, text=True, timeout=600)
+    status = "ok" if r.returncode == 0 else "FAILED"
+    print(f"{ex.name}: {status}")
+    if r.returncode != 0:
+        print(r.stdout[-2000:], r.stderr[-2000:])
+        failures.append(ex.name)
+sys.exit(1 if failures else 0)

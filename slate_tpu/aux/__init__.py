@@ -1,0 +1,6 @@
+"""Auxiliary subsystems (reference: src/auxiliary/ — Trace, Debug).
+
+- aux.trace: RAII phase tracing + SVG timeline + jax.profiler hook.
+"""
+
+from . import trace  # noqa: F401

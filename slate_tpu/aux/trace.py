@@ -1,0 +1,177 @@
+"""Execution tracing with SVG timeline output (reference:
+include/slate/internal/Trace.hh:24-110 — RAII trace::Block pushing
+Event{name, start, stop, thread}; src/auxiliary/Trace.cc:330-370 —
+per-rank gather + SVG timeline with a color legend, one row per thread).
+
+TPU mapping: the reference traces OpenMP tasks on host threads; here the
+interesting rows are *driver phases* on the host timeline (each jit
+dispatch, including its compile on first call) plus optional XLA device
+profiling.  Zero overhead when disabled (one bool check), like the
+reference's static `Trace::on_`.
+
+    from slate_tpu.aux import trace
+    trace.on()
+    with trace.Block("potrf"):
+        L, info = st.potrf(A)
+    trace.finish("trace.svg")          # writes the SVG timeline
+
+    with trace.xla_profile("/tmp/prof"):   # jax.profiler device trace
+        ...
+
+Drivers annotated with @trace.traced("name") record automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import List, Optional
+
+_enabled = False
+_events: List["Event"] = []
+_lock = threading.Lock()
+_t0: Optional[float] = None
+
+
+@dataclass
+class Event:
+    name: str
+    start: float
+    stop: float
+    thread: int
+
+
+def on() -> None:
+    """Enable tracing (reference: Trace::on, Trace.hh:41)."""
+    global _enabled, _t0
+    _enabled = True
+    if _t0 is None:
+        _t0 = time.perf_counter()
+
+
+def off() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_on() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    global _events, _t0
+    with _lock:
+        _events = []
+        _t0 = None
+
+
+class Block:
+    """RAII trace block (reference: trace::Block, Trace.hh:24-38)."""
+
+    __slots__ = ("name", "_start")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = 0.0
+
+    def __enter__(self):
+        if _enabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            stop = time.perf_counter()
+            ev = Event(self.name, self._start, stop, threading.get_ident())
+            with _lock:
+                _events.append(ev)
+        return False
+
+
+def traced(name: str):
+    """Decorator: trace a driver call when tracing is on (the reference
+    annotates impl:: functions the same way, e.g. gemmC.cc:48)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            if not _enabled:
+                return fn(*args, **kw)
+            with Block(name):
+                return fn(*args, **kw)
+
+        return wrapper
+
+    return deco
+
+
+@contextmanager
+def xla_profile(log_dir: str):
+    """Device-level XLA trace via jax.profiler (view with TensorBoard /
+    xprof) — the TPU analogue of the reference's per-GPU rows."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+_PALETTE = [
+    "#4878CF", "#D65F5F", "#6ACC65", "#B47CC7", "#C4AD66", "#77BEDB",
+    "#EE854A", "#8C613C", "#DC7EC0", "#797979",
+]
+
+
+def finish(path: str = "trace.svg", width: int = 1200) -> str:
+    """Write the recorded events as an SVG timeline (reference:
+    Trace::finish, Trace.cc:330-370: one row per thread, legend below).
+    Returns the path; clears nothing (call clear() to reset)."""
+    with _lock:
+        events = list(_events)
+    if not events:
+        open(path, "w").write("<svg xmlns='http://www.w3.org/2000/svg'/>")
+        return path
+    t_min = min(e.start for e in events)
+    t_max = max(e.stop for e in events)
+    span = max(t_max - t_min, 1e-9)
+    threads = sorted({e.thread for e in events})
+    names = sorted({e.name for e in events})
+    color = {n: _PALETTE[i % len(_PALETTE)] for i, n in enumerate(names)}
+    row_h, pad = 28, 6
+    legend_h = 20 * ((len(names) + 3) // 4) + 10
+    height = len(threads) * (row_h + pad) + 40 + legend_h
+    out = [
+        f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
+        f"height='{height}' font-family='monospace' font-size='11'>",
+        f"<text x='4' y='14'>slate_tpu trace — {span:.3f}s, "
+        f"{len(events)} events</text>",
+    ]
+    for row, th in enumerate(threads):
+        y = 24 + row * (row_h + pad)
+        out.append(
+            f"<text x='4' y='{y + row_h / 2 + 4}' fill='#555'>t{row}</text>"
+        )
+        for e in (ev for ev in events if ev.thread == th):
+            x = 40 + (e.start - t_min) / span * (width - 50)
+            w = max((e.stop - e.start) / span * (width - 50), 1.0)
+            out.append(
+                f"<rect x='{x:.1f}' y='{y}' width='{w:.1f}' height='{row_h}'"
+                f" fill='{color[e.name]}' stroke='#333' stroke-width='0.5'>"
+                f"<title>{e.name}: {e.stop - e.start:.4f}s</title></rect>"
+            )
+    ly = 24 + len(threads) * (row_h + pad) + 10
+    for i, n in enumerate(names):
+        lx = 40 + (i % 4) * (width // 4)
+        lyy = ly + (i // 4) * 20
+        out.append(
+            f"<rect x='{lx}' y='{lyy}' width='12' height='12' fill='{color[n]}'/>"
+            f"<text x='{lx + 16}' y='{lyy + 10}'>{n}</text>"
+        )
+    out.append("</svg>")
+    open(path, "w").write("\n".join(out))
+    return path

@@ -40,6 +40,8 @@ from ..parallel.layout import eye_splice, tiles_from_global
 
 from ..internal.precision import accurate_matmul
 
+from ..aux.trace import traced
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -53,6 +55,7 @@ def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
 
 
 @accurate_matmul
+@traced("gemm")
 def gemm(
     alpha,
     A: Matrix,
@@ -221,6 +224,7 @@ def _trsm_spmd_ok(A: TriangularMatrix, B: Matrix) -> bool:
     )
 
 
+@traced("trsm")
 def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
     """Solve op(A) X = alpha B (or right) (reference: src/trsm.cc ->
     trsmA/trsmB work pipelines, src/work/work_trsm.cc).

@@ -27,6 +27,8 @@ from ..parallel.layout import eye_splice, tiles_from_global
 from . import blas3
 from .aux import norm as _norm
 
+from ..aux.trace import traced
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -37,6 +39,7 @@ def _hermitian_full_tiles(A: HermitianMatrix) -> jnp.ndarray:
     return tiles_from_global(A.full_global().astype(A.dtype), A.layout)
 
 
+@traced("potrf")
 def potrf(
     A: HermitianMatrix, opts: Optional[Options] = None
 ) -> Tuple[TriangularMatrix, jnp.ndarray]:
@@ -76,6 +79,7 @@ def potrf(
     return L, info
 
 
+@traced("potrs")
 def potrs(
     L: TriangularMatrix, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:
@@ -90,6 +94,7 @@ def potrs(
     return X
 
 
+@traced("posv")
 def posv(
     A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray]:

@@ -37,6 +37,7 @@ from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 from . import blas3
 
+from ..aux.trace import traced
 from ..internal.precision import accurate_matmul
 
 
@@ -171,6 +172,7 @@ def unmtr_he2hb(
     return C_mat._with(data=tiles_from_global(C2.astype(C_mat.dtype), C_mat.layout))
 
 
+
 def _gathered_band_eig(
     band_2d: jnp.ndarray, vectors: bool
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
@@ -187,6 +189,7 @@ def _gathered_band_eig(
 
 
 @accurate_matmul
+@traced("heev")
 def heev(
     A: HermitianMatrix,
     opts: Optional[Options] = None,

@@ -31,6 +31,8 @@ from ..types import Pivots
 from . import blas3
 from .aux import norm as _norm
 
+from ..aux.trace import traced
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -79,6 +81,7 @@ def _udiag_info(LU: Matrix, lay) -> jnp.ndarray:
     ).astype(jnp.int32)
 
 
+@traced("getrf")
 def getrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Pivots, jnp.ndarray]:
@@ -193,6 +196,7 @@ def _nopiv_block(a: jnp.ndarray) -> jnp.ndarray:
     return lax.fori_loop(0, nb, body, a)
 
 
+@traced("getrs")
 def getrs(
     LU: Matrix,
     pivots: Optional[Pivots],
@@ -248,6 +252,7 @@ def getrs_nopiv(LU: Matrix, B: Matrix, opts=None) -> Matrix:
     return getrs(LU, None, B, opts)
 
 
+@traced("gesv")
 def gesv(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Matrix, Pivots, jnp.ndarray]:

@@ -35,6 +35,8 @@ from . import blas3, chol
 
 from ..internal.precision import accurate_matmul
 
+from ..aux.trace import traced
+
 
 def _is_distributed(M: BaseMatrix) -> bool:
     return M.grid is not None and M.grid.size > 1
@@ -52,6 +54,7 @@ def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
 
 
 @accurate_matmul
+@traced("geqrf")
 def geqrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularFactors]:
@@ -208,6 +211,7 @@ def cholqr(
 
 
 @accurate_matmul
+@traced("gels")
 def gels(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:

@@ -26,6 +26,7 @@ from ..ops.jacobi import svd_accurate
 from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 
+from ..aux.trace import traced
 from ..internal.precision import accurate_matmul
 
 
@@ -129,6 +130,7 @@ def ge2tb(
     )
 
 
+
 def _jw_band_storage(Bsq: jnp.ndarray, b: int):
     """Diagonal-major band storage of the perfect-shuffle Jordan-Wielandt
     embedding C = P [[0, B], [B^H, 0]] P^T of an upper-band B (b
@@ -230,6 +232,7 @@ def bdsqr(d: jnp.ndarray, e: jnp.ndarray, vectors: bool = False):
 
 
 @accurate_matmul
+@traced("svd")
 def svd(
     A: Matrix,
     opts: Optional[Options] = None,

@@ -276,6 +276,384 @@ def _simple(fn):
     return fn
 
 
+
+
+def _spd_np(pr, n, shift=None):
+    A0 = _rng_matrix("rand_dominant", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.conj().T) / 2 + (shift or n) * np.eye(n)).astype(pr.dtype)
+    return A0
+
+
+def _test_symm(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.T) / 2).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, pr.k, pr.dtype, pr.seed + 1)
+    C0 = _rng_matrix("rand", n, pr.k, pr.dtype, pr.seed + 2)
+    A = st.SymmetricMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    C = st.Matrix.from_global(C0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    out = st.symm(st.Side.Left, 1.5, A, B, -0.5, C)
+    got = np.asarray(out.to_global())
+    dt = time.perf_counter() - t0
+    ref = 1.5 * A0 @ B0 - 0.5 * C0
+    scale = max(np.abs(ref).max(), 1.0)
+    return dt, 2e-9 * n * n * pr.k / dt, np.abs(got - ref).max() / scale / n
+
+
+def _test_hemm(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.conj().T) / 2).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, pr.k, pr.dtype, pr.seed + 1)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    C = st.Matrix.from_global(np.zeros_like(B0), pr.nb, grid=g)
+    t0 = time.perf_counter()
+    out = st.hemm(st.Side.Left, 1.0, A, B, 0.0, C)
+    got = np.asarray(out.to_global())
+    dt = time.perf_counter() - t0
+    ref = A0 @ B0
+    return dt, 2e-9 * n * n * pr.k / dt, np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_herk(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n, k = pr.n, pr.k
+    A0 = _rng_matrix("rand", n, k, pr.dtype, pr.seed)
+    C0 = _spd_np(pr, n, shift=1)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    C = st.HermitianMatrix.from_global(C0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    out = st.herk(1.0, A, 0.5, C)
+    got = np.asarray(out.full_global())
+    dt = time.perf_counter() - t0
+    ref = A0 @ A0.conj().T + 0.5 * C0
+    return dt, 1e-9 * n * n * k / dt, np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_syrk(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n, k = pr.n, pr.k
+    A0 = _rng_matrix("rand", n, k, pr.dtype, pr.seed)
+    M = _rng_matrix("rand", n, n, pr.dtype, pr.seed + 1)
+    C0 = ((M + M.T) / 2).astype(pr.dtype)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    C = st.SymmetricMatrix.from_global(C0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    out = st.syrk(1.0, A, 1.0, C)
+    got = np.asarray(out.full_global())
+    dt = time.perf_counter() - t0
+    ref = A0 @ A0.T + C0
+    return dt, 1e-9 * n * n * k / dt, np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_her2k(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n, k = pr.n, pr.k
+    A0 = _rng_matrix("rand", n, k, pr.dtype, pr.seed)
+    B0 = _rng_matrix("rand", n, k, pr.dtype, pr.seed + 1)
+    C0 = _spd_np(pr, n, shift=1)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    C = st.HermitianMatrix.from_global(C0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    out = st.her2k(1.0, A, B, 1.0, C)
+    got = np.asarray(out.full_global())
+    dt = time.perf_counter() - t0
+    ref = A0 @ B0.conj().T + B0 @ A0.conj().T + C0
+    return dt, 2e-9 * n * n * k / dt, np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_trmm(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    T0 = (np.tril(_rng_matrix("rand", n, n, pr.dtype, pr.seed)) + n * np.eye(n)).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, pr.k, pr.dtype, pr.seed + 1)
+    T = st.TriangularMatrix.from_global(T0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_global(B0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    out = st.trmm(st.Side.Left, 1.0, T, B)
+    got = np.asarray(out.to_global())
+    dt = time.perf_counter() - t0
+    ref = T0 @ B0
+    return dt, 1e-9 * n * n * pr.k / dt, np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_getri(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = (_rng_matrix("rand", n, n, pr.dtype, pr.seed) + n * np.eye(n)).astype(pr.dtype)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    LU, piv, info = st.getrf(A)
+    Ainv = st.getri(LU, piv)
+    got = np.asarray(Ainv.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    err = np.abs(got @ A0 - np.eye(n)).max() / n
+    return dt, 2e-9 * n ** 3 / dt, err
+
+
+def _test_potri(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _spd_np(pr, n)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    L, info = st.potrf(A)
+    Ainv = st.potri(L)
+    got = np.asarray(Ainv.full_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    err = np.abs(got @ A0 - np.eye(n)).max() / n
+    return dt, 1e-9 * n ** 3 / dt, err
+
+
+def _test_trtri(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    T0 = (np.tril(_rng_matrix("rand", n, n, pr.dtype, pr.seed)) + n * np.eye(n)).astype(pr.dtype)
+    T = st.TriangularMatrix.from_global(T0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    Tinv = st.trtri(T)
+    got = np.tril(np.asarray(Tinv.to_global()))
+    dt = time.perf_counter() - t0
+    err = np.abs(got @ T0 - np.eye(n)).max() / n
+    return dt, 0.33e-9 * n ** 3 / dt, err
+
+
+def _test_gelqf(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    m, n = min(pr.m, pr.n), max(pr.m, pr.n)
+    A0 = _rng_matrix("rand", m, n, pr.dtype, pr.seed)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    fac, T = st.gelqf(A)
+    Lf = np.tril(np.asarray(fac.to_global()))[:, :m]
+    dt = time.perf_counter() - t0
+    # L L^H must match A A^H (Q orthonormal)
+    ref = A0 @ A0.conj().T
+    got = Lf @ Lf.conj().T
+    err = np.abs(got - ref).max() / max(np.abs(ref).max(), 1.0) / m
+    return dt, 2e-9 * m * m * n / dt, err
+
+
+def _test_cholqr(pr: Params):
+    import slate_tpu as st
+    from .checks import factor_residual, ortho_residual
+
+    g = _grid(pr)
+    m, n = max(pr.m, pr.n), min(pr.m, pr.n)
+    A0 = _rng_matrix("rand", m, n, pr.dtype, pr.seed)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    Q, R, info = st.cholqr(A)
+    Qg = np.asarray(Q.to_global())
+    Rg = np.triu(np.asarray(R.to_global()))[:n, :n]
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    err = max(factor_residual(A0, Qg, Rg), ortho_residual(Qg))
+    return dt, 2e-9 * m * n * n / dt, err
+
+
+def _test_hegv(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.conj().T) / 2).astype(pr.dtype)
+    B0 = _spd_np(pr, n)
+    A = st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    B = st.HermitianMatrix.from_global(B0, pr.nb, grid=g, uplo=st.Uplo.Lower)
+    t0 = time.perf_counter()
+    w, X, info = st.hegv(1, A, B, vectors=False)
+    w = np.asarray(w)
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    L = np.linalg.cholesky(B0)
+    C = np.linalg.solve(L, np.linalg.solve(L, A0.conj().T).conj().T)
+    ref = np.linalg.eigvalsh((C + C.conj().T) / 2)
+    return dt, 0.0, np.abs(w - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_gesv_mixed(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = (_rng_matrix("rand", n, n, pr.dtype, pr.seed) + n * np.eye(n)).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, info, iters = st.gesv_mixed(
+        st.Matrix.from_global(A0, pr.nb, grid=g),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    return dt, 0.67e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_posv_mixed(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _spd_np(pr, n)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, info, iters = st.posv_mixed(
+        st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    return dt, 0.33e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_gesv_rbt(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+    from ..enums import MethodLU, Option
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = (_rng_matrix("rand", n, n, pr.dtype, pr.seed) + n * np.eye(n)).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, LU, piv, info = st.gesv(
+        st.Matrix.from_global(A0, pr.nb, grid=g),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+        {Option.MethodLU: MethodLU.RBT},
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 0.67e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_gesv_calu(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+    from ..enums import MethodLU, Option
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, LU, piv, info = st.gesv(
+        st.Matrix.from_global(A0, pr.nb, grid=g),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+        {Option.MethodLU: MethodLU.CALU},
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 0.67e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_hesv(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _rng_matrix("rand", n, n, pr.dtype, pr.seed)
+    A0 = ((A0 + A0.conj().T) / 2 + 0.5 * n * np.eye(n)).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, fac, d_blk, info = st.hesv(
+        st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    if int(info) != 0:
+        return dt, 0.0, float("inf")
+    return dt, 0.33e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_condest(pr: Params):
+    import slate_tpu as st
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = (_rng_matrix("rand", n, n, pr.dtype, pr.seed) + n * np.eye(n)).astype(pr.dtype)
+    A = st.Matrix.from_global(A0, pr.nb, grid=g)
+    t0 = time.perf_counter()
+    LU, piv, _ = st.getrf(A)
+    rcond = float(st.gecondest(LU, piv, np.abs(A0).sum(axis=0).max()))
+    dt = time.perf_counter() - t0
+    ref = 1.0 / (np.linalg.norm(A0, 1) * np.linalg.norm(np.linalg.inv(A0), 1))
+    ok = ref * 0.99 <= rcond <= 3.0 * ref
+    return dt, 0.0, 0.0 if ok else float("inf")
+
+
+def _test_sterf(pr: Params):
+    import slate_tpu as st
+
+    n = pr.n
+    rng = np.random.default_rng(pr.seed)
+    d = rng.standard_normal(n).astype(np.float64)
+    e = rng.standard_normal(n - 1).astype(np.float64)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    t0 = time.perf_counter()
+    w = np.asarray(st.sterf(d, e))
+    dt = time.perf_counter() - t0
+    ref = np.linalg.eigvalsh(T)
+    return dt, 0.0, np.abs(w - ref).max() / max(np.abs(ref).max(), 1.0) / n
+
+
+def _test_steqr(pr: Params):
+    import slate_tpu as st
+
+    n = pr.n
+    rng = np.random.default_rng(pr.seed)
+    d = rng.standard_normal(n).astype(np.float64)
+    e = rng.standard_normal(n - 1).astype(np.float64)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    t0 = time.perf_counter()
+    w, Z = st.steqr(d, e, vectors=True)
+    w, Z = np.asarray(w), np.asarray(Z)
+    dt = time.perf_counter() - t0
+    err = np.abs(w - np.linalg.eigvalsh(T)).max() / max(np.abs(w).max(), 1.0) / n
+    res = np.abs(T @ Z - Z * w[None, :]).max() / max(np.abs(w).max(), 1.0) / n
+    return dt, 0.0, max(err, res)
+
+
 ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "gemm": _test_gemm,
     "posv": _test_posv,
@@ -287,6 +665,26 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "svd": _test_svd,
     "norm": _test_norm,
     "trsm": _test_trsm,
+    "symm": _test_symm,
+    "hemm": _test_hemm,
+    "herk": _test_herk,
+    "syrk": _test_syrk,
+    "her2k": _test_her2k,
+    "trmm": _test_trmm,
+    "getri": _test_getri,
+    "potri": _test_potri,
+    "trtri": _test_trtri,
+    "gelqf": _test_gelqf,
+    "cholqr": _test_cholqr,
+    "hegv": _test_hegv,
+    "gesv_mixed": _test_gesv_mixed,
+    "posv_mixed": _test_posv_mixed,
+    "gesv_rbt": _test_gesv_rbt,
+    "gesv_calu": _test_gesv_calu,
+    "hesv": _test_hesv,
+    "condest": _test_condest,
+    "steqr": _test_steqr,
+    "sterf": _test_sterf,
 }
 
 # reference-style tolerance factors per routine class (test_*.cc use 3eps
@@ -296,6 +694,11 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
 TOL_FACTOR = {
     "gemm": 10, "norm": 100, "trsm": 30, "posv": 50, "potrf": 50,
     "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 100,
+    "symm": 10, "hemm": 10, "herk": 30, "syrk": 30, "her2k": 30,
+    "trmm": 30, "getri": 500, "potri": 500, "trtri": 100, "gelqf": 100,
+    "cholqr": 500, "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
+    "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 5000, "condest": 1,
+    "steqr": 50, "sterf": 50,
 }
 
 
