@@ -118,6 +118,9 @@ def gemm(
 def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A symmetric (reference: src/symm.cc)."""
+    out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
+    if out is not None:
+        return out
     Af = A.full_global()
     B2, C2 = B.to_global(), C.to_global()
     out = (
@@ -132,7 +135,11 @@ def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
 def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A Hermitian (reference: src/hemm.cc,
-    method A/C variants collapse to one fused XLA product here)."""
+    method A/C variants collapse to one fused XLA product here;
+    distributed: SUMMA over the mirrored tile array)."""
+    out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
+    if out is not None:
+        return out
     Af = A.full_global()
     B2, C2 = B.to_global(), C.to_global()
     out = (
@@ -143,8 +150,94 @@ def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
     return _repack_like(out, C)
 
 
-def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None):
+def _hemm_spmd(side, alpha, A, B, beta, C, opts):
+    """Distributed hemm/symm: mirror the stored triangle into full tiles
+    and run the SUMMA pipeline (reference: hemmA's broadcast/reduce DAG,
+    src/hemmA.cc)."""
+    if not (_is_distributed(C) and get_option(opts, Option.UseShardMap)):
+        return None
+    if C.op != Op.NoTrans:
+        return None
+    Br = B.resolved()
+    layA, layB, layC = A.layout, Br.layout, C.layout
+    # conformability on the RESOLVED operand layouts (cf. _trsm_spmd_ok)
+    if side == Side.Left:
+        ok = layB.mb == layA.nb and layB.nb == layC.nb and layA.mb == layC.mb
+    else:
+        ok = layB.nb == layA.mb and layB.mb == layC.mb and layA.nb == layC.nb
+    if not (
+        ok
+        and layA.mb == layA.nb
+        and (layA.p, layA.q) == (layC.p, layC.q) == (layB.p, layB.q)
+    ):
+        return None
+    Af = tiles_from_global(A.full_global().astype(A.dtype), layA)
+    Cr = C
+    if side == Side.Left:
+        data = spmd_blas.summa_gemm(
+            C.grid, alpha, Af, layA, Br.data, Br.layout, beta, Cr.data, layC
+        )
+    else:
+        data = spmd_blas.summa_gemm(
+            C.grid, alpha, Br.data, Br.layout, Af, layA, beta, Cr.data, layC
+        )
+    return C._with(data=data)
+
+
+def _herk_like_spmd(alpha, A, beta, C, conj: bool, rank2=False, B=None):
+    """Distributed rank-k update over the mesh: the SUMMA pipeline on
+    full tiles, writing back only C's stored triangle (the reference's
+    internal::herk is a masked batched gemm the same way,
+    internal_herk.cc).  Returns None if tile shapes don't conform."""
+    from ..matrix.base import conj_transpose as _ct, transpose as _tr
+
+    if C.op != Op.NoTrans:
+        return None
+    Ar = A.resolved()
+    Ah = (_ct(A) if conj else _tr(A)).resolved()
+    lay, layC = Ar.layout, C.layout
+    if not (
+        lay.mb == layC.mb
+        and lay.mb == layC.nb
+        and (lay.p, lay.q) == (layC.p, layC.q)
+        and (Ah.layout.p, Ah.layout.q) == (layC.p, layC.q)
+    ):
+        return None
+    if rank2:
+        layB = B.resolved().layout
+        if not (
+            layB.mb == lay.mb
+            and layB.nb == lay.nb
+            and (layB.p, layB.q) == (layC.p, layC.q)
+        ):
+            return None
+    Tfull = tiles_from_global(C.full_global().astype(C.dtype), layC)
+    if rank2:
+        # C = alpha A op(B) + alpha2 B op(A) + beta C
+        Br = B.resolved()
+        Bh = (_ct(B) if conj else _tr(B)).resolved()
+        a2 = jnp.conj(alpha) if (conj and C.is_complex) else alpha
+        out = spmd_blas.summa_gemm(
+            C.grid, alpha, Ar.data, Ar.layout, Bh.data, Bh.layout,
+            beta, Tfull, layC,
+        )
+        out = spmd_blas.summa_gemm(
+            C.grid, a2, Br.data, Br.layout, Ah.data, Ah.layout, 1.0, out, layC
+        )
+    else:
+        out = spmd_blas.summa_gemm(
+            C.grid, alpha, Ar.data, Ar.layout, Ah.data, Ah.layout,
+            beta, Tfull, layC,
+        )
+    return C._with(data=out)
+
+
+def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None, opts=None):
     slate_assert(C.m == C.n, "herk/syrk C must be square")
+    if _is_distributed(C) and get_option(opts, Option.UseShardMap):
+        spmd = _herk_like_spmd(alpha, A, beta, C, conj, rank2, B)
+        if spmd is not None:
+            return spmd
     k_dim = A.n
     A2 = A.to_global()
     C2 = C.full_global()
@@ -169,7 +262,7 @@ def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha op(A) op(A)^T + beta C (reference: src/syrk.cc)."""
     if A.m != C.m:
         raise DimensionError(f"syrk dims: A {A.m}x{A.n}, C {C.m}x{C.n}")
-    return _herk_like(alpha, A, beta, C, conj=False)
+    return _herk_like(alpha, A, beta, C, conj=False, opts=opts)
 
 
 @accurate_matmul
@@ -177,7 +270,7 @@ def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha op(A) op(A)^H + beta C (reference: src/herk.cc)."""
     if A.m != C.m:
         raise DimensionError(f"herk dims: A {A.m}x{A.n}, C {C.m}x{C.n}")
-    return _herk_like(alpha, A, beta, C, conj=True)
+    return _herk_like(alpha, A, beta, C, conj=True, opts=opts)
 
 
 @accurate_matmul
@@ -185,7 +278,7 @@ def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha (A B^T + B A^T) + beta C (reference: src/syr2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
         raise DimensionError("syr2k dims")
-    return _herk_like(alpha, A, beta, C, conj=False, rank2=True, B=B)
+    return _herk_like(alpha, A, beta, C, conj=False, rank2=True, B=B, opts=opts)
 
 
 @accurate_matmul
@@ -193,7 +286,7 @@ def her2k(alpha, A: Matrix, B: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha A B^H + conj(alpha) B A^H + beta C (reference: src/her2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
         raise DimensionError("her2k dims")
-    return _herk_like(alpha, A, beta, C, conj=True, rank2=True, B=B)
+    return _herk_like(alpha, A, beta, C, conj=True, rank2=True, B=B, opts=opts)
 
 
 def _resolve_tri(A: TriangularMatrix):

@@ -252,19 +252,27 @@ def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
 def steqr(
     d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    """Tridiagonal eigensolver with vectors (reference: src/steqr.cc
-    implicit QR)."""
+    """Tridiagonal eigensolver (reference: src/steqr.cc implicit QR).
+
+    Values-only runs the parallel Sturm bisection; with vectors, the
+    dense assembly + the Jacobi-polished vendor eigensolver (the
+    quality-equivalent of LAPACK steqr on the gathered tridiagonal)."""
+    if not vectors:
+        return sterf(d, e), None
     Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
-    return _gathered_band_eig(Tm, vectors)
+    return _gathered_band_eig(Tm, vectors=True)
 
 
 def stedc(
     d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Tridiagonal divide & conquer (reference: src/stedc.cc +
-    stedc_deflate/merge/secular/solve/sort/z_vector).  The XLA eigensolver
-    is itself a D&C; the reference's explicit deflation pipeline is a
-    planned native replacement."""
+    stedc_deflate/merge/secular/solve/sort/z_vector, ~2.5 kLoC).
+
+    slate_tpu does not reproduce the explicit deflation pipeline: on TPU
+    the values stage is the bisection (embarrassingly parallel, no
+    merge tree needed) and the vectors stage is the polished dense
+    eigensolve — same results, hardware-appropriate algorithms."""
     return steqr(d, e, vectors)
 
 

@@ -345,6 +345,35 @@ def generate_2d(
     return G, Sigma
 
 
+def generate_tiles(
+    kind: str, layout, dtype, seed: int = 42
+) -> Optional[jnp.ndarray]:
+    """Device-side generation of the (P, Q, mb, nb) storage-order tile
+    array for the plain rand kinds: every element draws from the Philox
+    counter RNG keyed by its *global* (i, j), so the result is invariant
+    to tiling and process count (reference: matgen/random.cc:43-100) —
+    and under a sharded mesh each device generates only its local tiles,
+    with no host round-trip.  Returns None for kinds that need global
+    structure (spectra, special matrices, dominant/zerocol suffixes);
+    callers fall back to the host path."""
+    from . import philox
+
+    base, dist, sigma_max, dominant, zero_col = parse_kind(kind)
+    if base not in _RAND_KINDS or dominant or zero_col is not None:
+        return None
+    dtype = jnp.dtype(dtype)
+    gr = jnp.asarray(layout.global_rows_np.astype(np.int64))  # (P, mb)
+    gc = jnp.asarray(layout.global_cols_np.astype(np.int64))  # (Q, nb)
+    i = jnp.broadcast_to(
+        gr[:, None, :, None], (layout.P, layout.Q, layout.mb, layout.nb)
+    )
+    j = jnp.broadcast_to(gc[None, :, None, :], i.shape)
+    T = philox.random_jnp(_RAND_KINDS[base], seed, i, j, dtype)
+    if sigma_max != 1.0:
+        T = T * sigma_max
+    return jnp.where(layout.element_mask(), T, 0)
+
+
 def generate_matrix(
     kind: str,
     A: BaseMatrix,
@@ -353,12 +382,19 @@ def generate_matrix(
     sigma_specified=None,
 ) -> Tuple[BaseMatrix, Optional[jnp.ndarray]]:
     """Fill an existing matrix's shape/layout with `kind` (reference:
-    slate::generate_matrix, include/slate/generate_matrix.hh:29-60)."""
+    slate::generate_matrix, include/slate/generate_matrix.hh:29-60).
+
+    Plain rand kinds generate directly on-device per tile
+    (generate_tiles); structured kinds assemble on the host."""
+    lay = A.resolved().layout
+    T = generate_tiles(kind, lay, A.dtype, seed)
+    if T is not None:
+        return A._with(data=T).shard(), None
     G, Sigma = generate_2d(
         kind, A.m, A.n, A.dtype, seed=seed, cond=cond,
         sigma_specified=sigma_specified,
     )
-    out = A._with(data=tiles_from_global(G, A.resolved().layout))
+    out = A._with(data=tiles_from_global(G, lay))
     return out.shard(), Sigma
 
 

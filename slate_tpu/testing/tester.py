@@ -696,7 +696,9 @@ TOL_FACTOR = {
     "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 100,
     "symm": 10, "hemm": 10, "herk": 30, "syrk": 30, "her2k": 30,
     "trmm": 30, "getri": 500, "potri": 500, "trtri": 100, "gelqf": 100,
-    "cholqr": 500, "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
+    # CholQR error ~ eps * cond(A)^2 by construction
+    "cholqr": 50000,
+    "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
     "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 5000, "condest": 1,
     "steqr": 50, "sterf": 50,
 }

@@ -193,3 +193,49 @@ def test_trsm_unit_diag(rng):
     X = blas3.trsm(Side.Left, 1.0, T, Matrix.from_global(B0, 8))
     ref = np.linalg.solve(T0, B0)
     np.testing.assert_allclose(np.asarray(X.to_global()), ref, rtol=1e-9, atol=1e-9)
+
+
+def test_herk_distributed_spmd(rng, grid22):
+    n, k, nb = 64, 48, 16
+    A0 = rng.standard_normal((n, k))
+    C0 = rng.standard_normal((n, n)); C0 = (C0 + C0.T) / 2
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    C = HermitianMatrix.from_global(C0, nb, grid=grid22, uplo=Uplo.Lower)
+    out = blas3.herk(1.0, A, 0.5, C)
+    np.testing.assert_allclose(
+        np.asarray(out.full_global()), A0 @ A0.T + 0.5 * C0, atol=1e-11
+    )
+
+
+def test_her2k_distributed_complex(rng, grid22):
+    n, k, nb = 48, 32, 16
+    A0 = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))
+    B0 = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))
+    C0 = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    C0 = (C0 + C0.conj().T) / 2
+    alpha = 1.3 - 0.4j
+    out = blas3.her2k(
+        alpha,
+        Matrix.from_global(A0.astype(np.complex128), nb, grid=grid22),
+        Matrix.from_global(B0.astype(np.complex128), nb, grid=grid22),
+        0.5,
+        HermitianMatrix.from_global(
+            C0.astype(np.complex128), nb, grid=grid22, uplo=Uplo.Lower
+        ),
+    )
+    ref = alpha * A0 @ B0.conj().T + np.conj(alpha) * B0 @ A0.conj().T + 0.5 * C0
+    np.testing.assert_allclose(np.asarray(out.full_global()), ref, atol=1e-11)
+
+
+def test_hemm_distributed_spmd(rng, grid22):
+    n, w, nb = 64, 32, 16
+    C0 = rng.standard_normal((n, n)); C0 = (C0 + C0.T) / 2
+    B0 = rng.standard_normal((n, w))
+    out = blas3.hemm(
+        Side.Left, 2.0,
+        HermitianMatrix.from_global(C0, nb, grid=grid22, uplo=Uplo.Lower),
+        Matrix.from_global(B0, nb, grid=grid22),
+        0.0,
+        Matrix.from_global(np.zeros((n, w)), nb, grid=grid22),
+    )
+    np.testing.assert_allclose(np.asarray(out.to_global()), 2.0 * C0 @ B0, atol=1e-11)
