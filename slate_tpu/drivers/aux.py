@@ -130,11 +130,32 @@ def colNorms(norm_type: Norm, A: BaseMatrix, opts=None):
 
 def redistribute(A: BaseMatrix, B: BaseMatrix, opts=None) -> BaseMatrix:
     """Copy A into B's (different) distribution (reference:
-    src/redistribute.cc tile re-send; here one resharded pack)."""
+    src/redistribute.cc — per-tile sends between the two layouts).
+
+    One storage-to-storage gather: every element of B's tile array
+    addresses its source element in A's tile array directly (no padded
+    global intermediate); under sharded inputs GSPMD lowers the gather
+    to the needed collectives — the XLA-native tile re-send."""
     _check_same_shape(A, B)
-    out2d = A.resolved().to_global()
-    Br = B.resolved()
-    return Br._with(data=tiles_from_global(out2d.astype(B.dtype), Br.layout)).shard()
+    Ar, Br = A.resolved(), B.resolved()
+    layA, layB = Ar.layout, Br.layout
+
+    def row_maps(gl, mb_a, srow, mtA):
+        ti = np.minimum(gl // mb_a, mtA - 1)
+        return srow(ti).astype(np.int32), (gl % mb_a).astype(np.int32)
+
+    grB = np.minimum(layB.global_rows_np, layA.m - 1)
+    gcB = np.minimum(layB.global_cols_np, layA.n - 1)
+    RS, RA = row_maps(grB, layA.mb, lambda t: layA.srow(t), layA.mt)
+    CS, CB = row_maps(gcB, layA.nb, lambda t: layA.scol(t), layA.nt)
+    out = Ar.data[
+        jnp.asarray(RS)[:, None, :, None],
+        jnp.asarray(CS)[None, :, None, :],
+        jnp.asarray(RA)[:, None, :, None],
+        jnp.asarray(CB)[None, :, None, :],
+    ]
+    out = jnp.where(layB.element_mask(), out, 0).astype(B.dtype)
+    return Br._with(data=out).shard()
 
 
 def print_matrix(label: str, A: BaseMatrix, opts=None, verbose: int = 4,

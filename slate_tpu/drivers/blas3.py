@@ -118,6 +118,7 @@ def gemm(
 def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A symmetric (reference: src/symm.cc)."""
+    _check_hemm_dims(side, A, B, C)
     out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
     if out is not None:
         return out
@@ -137,6 +138,7 @@ def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
     """C = alpha A B + beta C, A Hermitian (reference: src/hemm.cc,
     method A/C variants collapse to one fused XLA product here;
     distributed: SUMMA over the mirrored tile array)."""
+    _check_hemm_dims(side, A, B, C)
     out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
     if out is not None:
         return out
@@ -150,10 +152,26 @@ def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
     return _repack_like(out, C)
 
 
+def _check_hemm_dims(side, A, B, C):
+    if side == Side.Left:
+        ok = A.n == B.m and A.m == C.m and B.n == C.n
+    else:
+        ok = B.n == A.m and B.m == C.m and A.n == C.n
+    if not ok:
+        raise DimensionError(
+            f"hemm/symm dims: A {A.m}x{A.n}, B {B.m}x{B.n}, C {C.m}x{C.n}"
+        )
+
+
 def _hemm_spmd(side, alpha, A, B, beta, C, opts):
     """Distributed hemm/symm: mirror the stored triangle into full tiles
     and run the SUMMA pipeline (reference: hemmA's broadcast/reduce DAG,
-    src/hemmA.cc)."""
+    src/hemmA.cc).
+
+    The mirror materializes through one global-array round trip; under
+    jit GSPMD lowers it to collectives.  A storage-level tile mirror
+    would avoid it but needs a reshard for p != q grids — noted as a
+    future optimization."""
     if not (_is_distributed(C) and get_option(opts, Option.UseShardMap)):
         return None
     if C.op != Op.NoTrans:
@@ -172,14 +190,13 @@ def _hemm_spmd(side, alpha, A, B, beta, C, opts):
     ):
         return None
     Af = tiles_from_global(A.full_global().astype(A.dtype), layA)
-    Cr = C
     if side == Side.Left:
         data = spmd_blas.summa_gemm(
-            C.grid, alpha, Af, layA, Br.data, Br.layout, beta, Cr.data, layC
+            C.grid, alpha, Af, layA, Br.data, Br.layout, beta, C.data, layC
         )
     else:
         data = spmd_blas.summa_gemm(
-            C.grid, alpha, Br.data, Br.layout, Af, layA, beta, Cr.data, layC
+            C.grid, alpha, Br.data, Br.layout, Af, layA, beta, C.data, layC
         )
     return C._with(data=data)
 

@@ -693,7 +693,7 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
 # are <= ~30x eps under these metrics; factors leave ~2-5x margin.
 TOL_FACTOR = {
     "gemm": 10, "norm": 100, "trsm": 30, "posv": 50, "potrf": 50,
-    "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 100,
+    "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 200,
     "symm": 10, "hemm": 10, "herk": 30, "syrk": 30, "her2k": 30,
     "trmm": 30, "getri": 500, "potri": 500, "trtri": 100, "gelqf": 100,
     # CholQR error ~ eps * cond(A)^2 by construction

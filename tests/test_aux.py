@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 from slate_tpu.drivers import aux
-from slate_tpu.enums import Diag, Norm, NormScope, Uplo
+from slate_tpu.enums import Diag, Norm, NormScope, Side, Uplo
 from slate_tpu.matrix.matrix import (
     HermitianMatrix,
     Matrix,
@@ -193,3 +193,40 @@ def test_sub(rng):
     np.testing.assert_array_equal(
         np.asarray(S.to_global()), A0[16:40, 8:32]
     )
+
+
+@pytest.mark.parametrize("shape,src,dst", [
+    ((50, 37), (16, 16), (8, 8)),     # ragged last tiles both sides
+    ((40, 30), (16, 9), (8, 16)),     # rectangular, different aspect
+])
+def test_redistribute_edge_tilings(rng, grid22, shape, src, dst):
+    m, n = shape
+    A0 = rng.standard_normal((m, n))
+    A = Matrix.from_global(A0, src[0], src[1], grid=grid22)
+    B = Matrix.from_global(np.zeros((m, n)), dst[0], dst[1])
+    out = aux.redistribute(A, B)
+    np.testing.assert_array_equal(np.asarray(out.to_global()), A0)
+
+
+def test_redistribute_transposed_source(rng, grid22):
+    from slate_tpu.matrix.base import transpose
+
+    m, n = 37, 50
+    M0 = rng.standard_normal((n, m))
+    At = transpose(Matrix.from_global(M0, 16, grid=grid22))  # m x n view
+    B = Matrix.from_global(np.zeros((m, n)), 8, grid=grid22)
+    out = aux.redistribute(At, B)
+    np.testing.assert_array_equal(np.asarray(out.to_global()), M0.T)
+
+
+def test_hemm_dimension_mismatch_raises(rng, grid22):
+    from slate_tpu.drivers import blas3
+    from slate_tpu.exceptions import DimensionError
+    from slate_tpu.matrix.matrix import HermitianMatrix
+
+    A0 = rng.standard_normal((33, 33)); A0 = (A0 + A0.T) / 2
+    A = HermitianMatrix.from_global(A0, 16, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(rng.standard_normal((40, 4)), 16, grid=grid22)
+    C = Matrix.from_global(np.zeros((33, 4)), 16, grid=grid22)
+    with pytest.raises(DimensionError):
+        blas3.hemm(Side.Left, 1.0, A, B, 0.0, C)
