@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..ops import chol_kernels
 from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
@@ -72,7 +73,10 @@ def spmd_potrf_lower(
             # -- 2. redundant diagonal factor + panel trsm ----------------
             slot_k = (k % p) * mtl + k // p
             Akk = lax.dynamic_index_in_dim(pan_full, slot_k, 0, keepdims=False)
-            Lkk = lax.linalg.cholesky(Akk)
+            # backend-dispatched tile factor: native strip kernel on the
+            # chip (the vendor cholesky lowering runs at ~1-5 GF/s at
+            # tile sizes there), vendor LAPACK on CPU
+            Lkk = chol_kernels.cholesky(Akk, mb)
             # L(i,k) = A(i,k) Lkk^-H  (right solve with lower^H)
             Lcol = lax.linalg.triangular_solve(
                 jnp.broadcast_to(Lkk, pan_full.shape),
