@@ -113,6 +113,27 @@ def bench_geqrf(jax, jnp, n, nb, trials):
     return 4.0 * n**3 / 3.0 / best / 1e9, best
 
 
+def bench_heev_vectors(jax, jnp, n, nb, trials):
+    """Two-stage heev WITH eigenvectors: he2hb + hb2st wavefront +
+    native stedc divide & conquer + both back-transforms — no vendor
+    eigensolver anywhere on the path (the vendor f64 eigh is a compile
+    bomb past n~512 on this toolchain)."""
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(4)
+    G = jax.random.normal(key, (n, n), jnp.float64)
+    S = (G + G.T) / 2
+    A = st.HermitianMatrix.from_global(S, nb, uplo=st.Uplo.Lower)
+
+    @jax.jit
+    def step(A, t):
+        w, Z = st.heev(A._with(data=A.data + t * 1e-14), vectors=True)
+        return w.sum() + Z.data.ravel()[-1]
+
+    best = _bench(step, (A,), trials)
+    return 4.0 * n**3 / 3.0 / best / 1e9, best
+
+
 def bench_heev_values(jax, jnp, n, nb, trials):
     """Two-stage heev, eigenvalues only: he2hb + hb2st wavefront +
     Sturm bisection — no vendor eigensolver anywhere on this path."""
@@ -165,11 +186,12 @@ def main():
     nf = 8192 if on_tpu else 256
     gf, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
     extra["dpotrf"] = {"n": nf, "gflops": round(gf, 1), "seconds": round(sec, 3)}
-    nl = 2048 if on_tpu else 128
-    gf, sec = bench_getrf(jax, jnp, nl, 256 if on_tpu else 32, trials)
+    nl = 8192 if on_tpu else 128
+    gf, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
     extra["dgetrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
-    gf, sec = bench_geqrf(jax, jnp, nl, 256 if on_tpu else 32, trials)
-    extra["dgeqrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+    nq = 4096 if on_tpu else 128
+    gf, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
+    extra["dgeqrf"] = {"n": nq, "gflops": round(gf, 1), "seconds": round(sec, 3)}
 
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
@@ -181,6 +203,17 @@ def main():
         }
     except Exception as e:  # noqa: BLE001 — bench must still emit its line
         extra["dheev_values_two_stage"] = {"error": str(e)[:120]}
+
+    # -- two-stage heev with vectors (+ native stedc D&C) -----------------
+    nv = 1024 if on_tpu else 96
+    try:
+        gf, sec = bench_heev_vectors(jax, jnp, nv, 64 if on_tpu else 8,
+                                     max(2, trials - 3))
+        extra["dheev_vectors_two_stage"] = {
+            "n": nv, "gflops": round(gf, 1), "seconds": round(sec, 3)
+        }
+    except Exception as e:  # noqa: BLE001 — bench must still emit its line
+        extra["dheev_vectors_two_stage"] = {"error": str(e)[:120]}
 
     baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
     print(

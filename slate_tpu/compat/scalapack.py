@@ -220,9 +220,12 @@ def ppotrf(uplo, n, a, desca) -> int:
 
 
 def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
-    """p?getrf: in-place LU; returns (perm, info).  ScaLAPACK's ipiv is a
-    per-panel swap list; slate_tpu records the net forward permutation
-    (types.Pivots), which is what p?getrs consumes here."""
+    """p?getrf: in-place LU; returns (perm, info).  slate_tpu records the
+    net forward permutation (types.Pivots), which is what p?getrs
+    consumes here.  A caller-supplied ipiv buffer is filled with the
+    LAPACK/ScaLAPACK 1-based swap list (row i swapped with ipiv[i]-1),
+    reconstructed from the net permutation, so the buffer stays valid if
+    handed to foreign LAPACK-convention code."""
     from ..drivers import lu
     from ..matrix.matrix import Matrix
 
@@ -233,8 +236,18 @@ def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
     _scatter_back(desca, a, np.asarray(LU.to_global()))
     perm = np.asarray(piv.perm)
     if ipiv is not None:
-        k = min(len(ipiv), desca.m)  # perm covers padded rows; callers
-        ipiv[:k] = perm[:k]  # size ipiv by m, ScaLAPACK-style
+        # net forward perm -> sequential swap list: at step i the row
+        # now at position i (perm[i]) sits at position pos[perm[i]] of
+        # the partially swapped order; record that 1-based position
+        k = min(len(ipiv), len(perm))
+        cur = np.arange(len(perm))
+        pos = np.arange(len(perm))  # original row -> current position
+        for i in range(k):
+            j = int(pos[perm[i]])
+            ipiv[i] = j + 1  # 1-based
+            ri, rj = cur[i], cur[j]
+            cur[i], cur[j] = rj, ri
+            pos[ri], pos[rj] = j, i
     return perm, int(info)
 
 

@@ -169,7 +169,6 @@ def posv_mixed(
     A_full = A.full_global()
     B2 = B.to_global()
     n = A.n
-    eps = float(np.finfo(np.float32 if not A.is_complex else np.float32).eps)
     # target accuracy in working precision
     work_eps = float(jnp.finfo(B2.dtype).eps)
     anorm = _norm(Norm.Inf, A)
@@ -188,19 +187,13 @@ def posv_mixed(
         )
         return Z.astype(B2.dtype)
 
-    X = solve_lo(B2)
-    iters = 0
-    converged = False
-    for it in range(max_it):
-        R = B2 - A_full @ X
-        rnorm = jnp.abs(R).max()
-        xnorm = jnp.abs(X).max()
-        iters = it
-        if bool(rnorm <= tol * float(anorm) * float(xnorm) + 1e-300):
-            converged = True
-            break
-        X = X + solve_lo(R)
-    if not converged and use_fallback:
+    from .lu import ir_refine_while
+
+    X, iters_dev, converged = ir_refine_while(
+        A_full, B2, solve_lo, tol, anorm, max_it
+    )
+    iters = int(iters_dev)
+    if not bool(converged) and use_fallback:
         # full-precision fallback (posv_mixed.cc fallback path)
         Lw = chol_kernels.cholesky(A_full)
         Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)

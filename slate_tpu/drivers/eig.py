@@ -255,25 +255,31 @@ def steqr(
     """Tridiagonal eigensolver (reference: src/steqr.cc implicit QR).
 
     Values-only runs the parallel Sturm bisection; with vectors, the
-    dense assembly + the Jacobi-polished vendor eigensolver (the
-    quality-equivalent of LAPACK steqr on the gathered tridiagonal)."""
+    native divide & conquer (ops/stedc.py) — no vendor eigensolver
+    anywhere on the path (the vendor f64 eigh is a compile bomb past
+    n~512 on this toolchain)."""
     if not vectors:
         return sterf(d, e), None
-    Tm = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
-    return _gathered_band_eig(Tm, vectors=True)
+    return stedc(d, e, vectors=True)
 
 
 def stedc(
     d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Tridiagonal divide & conquer (reference: src/stedc.cc +
-    stedc_deflate/merge/secular/solve/sort/z_vector, ~2.5 kLoC).
+    stedc_deflate/merge/secular/solve/sort/z_vector).
 
-    slate_tpu does not reproduce the explicit deflation pipeline: on TPU
-    the values stage is the bisection (embarrassingly parallel, no
-    merge tree needed) and the vectors stage is the polished dense
-    eigensolve — same results, hardware-appropriate algorithms."""
-    return steqr(d, e, vectors)
+    Native TPU redesign (ops/stedc.py): bottom-up Cuppen merge tree with
+    every level's merges vmapped into one batch, vectorized laed4
+    secular roots, masked static-shape deflation, Gu-Eisenstat Lowner
+    z-vector, and MXU gemms for the back-rotations.  Values-only uses
+    the parallel Sturm bisection (no tree needed)."""
+    if not vectors:
+        return sterf(d, e), None
+    from ..ops.stedc import stedc as _stedc_dc
+
+    w, Q = _stedc_dc(jnp.real(d), jnp.real(e))
+    return w, Q
 
 
 @accurate_matmul

@@ -31,6 +31,16 @@ from . import lu as lu_mod
 from .lu import _apply_butterfly, _butterfly_diags
 
 
+# Breakdown thresholds for the pivot-free pass.  Partial pivoting keeps
+# |L| <= 1; without pivoting a near-singular leading minor shows up as
+# element growth in L or a collapsed D entry.  Either trips the
+# butterfly refactor — exact zeros alone would let a 1e-12 minor slip
+# through to IR with catastrophic growth (reference: src/hetrf.cc,
+# Aasen's stability rationale).
+_GROWTH_LIMIT = 1e6
+_DRATIO_LIMIT = 1e-12
+
+
 def _ldl_nopiv(Af: jnp.ndarray, mb: int, grid, opts):
     """No-pivot LDL^H of a full Hermitian 2D array via getrf_nopiv."""
     Am = Matrix.from_global(Af, mb, grid=grid)
@@ -39,15 +49,24 @@ def _ldl_nopiv(Af: jnp.ndarray, mb: int, grid, opts):
     # A = L U with U = D L^H for Hermitian A  =>  D = diag(U)
     d = jnp.real(jnp.diagonal(G))
     n = Af.shape[0]
+    Ltri = jnp.tril(G, -1)
     L = TriangularMatrix.from_global(
-        jnp.tril(G, -1) + jnp.eye(n, dtype=G.dtype),
+        Ltri + jnp.eye(n, dtype=G.dtype),
         mb,
         mb,
         grid=grid,
         uplo=Uplo.Lower,
     )
-    bad = (d == 0) | ~jnp.isfinite(d)
-    info = jnp.maximum(info, jnp.where(jnp.any(bad), 1, 0)).astype(jnp.int32)
+    growth = jnp.abs(Ltri).max()
+    dmax = jnp.abs(d).max()
+    dmin = jnp.abs(d).min()
+    bad = (
+        jnp.any((d == 0) | ~jnp.isfinite(d))
+        | ~jnp.isfinite(growth)
+        | (growth > _GROWTH_LIMIT)
+        | (dmin < _DRATIO_LIMIT * dmax)
+    )
+    info = jnp.maximum(info, jnp.where(bad, 1, 0)).astype(jnp.int32)
     return L, d, info
 
 

@@ -100,6 +100,17 @@ def getrf(
         # tournament pivoting (reference: getrf_tntpiv.cc; BEAM maps to
         # the tournament too — both trade the per-column pivot search for
         # a communication-free reduction, the fit for static schedules)
+        if _is_distributed(A) and opts and Option.UseShardMap in dict(opts):
+            # warn only on an EXPLICIT UseShardMap request (it defaults
+            # to True, and default-configured runs should stay quiet)
+            import warnings
+
+            warnings.warn(
+                "getrf(MethodLU.CALU) on a distributed matrix gathers to a "
+                "global array (the tournament is not yet a mesh reduction); "
+                "the UseShardMap option is ignored on this path",
+                stacklevel=2,
+            )
         Gp = _padded_global(A)
         lu2d, perm = lu_kernels.blocked_getrf_tntpiv(Gp, lay.nb)
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
@@ -406,6 +417,39 @@ def getri(LU: Matrix, pivots: Pivots, opts: Optional[Options] = None) -> Matrix:
     return getrs(LU, pivots, eye, opts)
 
 
+def ir_refine_while(A2, B2, solve_lo, tol, anorm, max_it):
+    """Device-resident iterative refinement (reference: the IR loop of
+    src/gesv_mixed.cc:90-160, which runs inside the device schedule).
+
+    One lax.while_loop — a single dispatch instead of ~2 per iteration
+    (each of which pays the ~100 ms tunnel latency on this chip); the
+    host reads back only the final (X, iters, converged).  HIGHEST-
+    precision residual matmul (the TPU f64 emulation default
+    accumulates at ~f32 grade, which would stall convergence)."""
+    # real dtype always: a complex anorm would make the <= comparison
+    # below ill-typed for complex systems
+    anorm = jnp.asarray(anorm, jnp.abs(B2).dtype)
+
+    def cond(carry):
+        X, it, done = carry
+        return (~done) & (it < max_it)
+
+    def body(carry):
+        X, it, _ = carry
+        R = B2 - jnp.matmul(A2, X, precision=lax.Precision.HIGHEST)
+        conv = jnp.abs(R).max() <= tol * anorm * jnp.abs(X).max() + 1e-300
+        Xn = jnp.where(conv, X, X + solve_lo(R))
+        # count only actual refinement steps (a run that converges on
+        # the first residual check reports 0, like the host-loop did)
+        return Xn, it + jnp.where(conv, 0, 1), conv
+
+    X0 = solve_lo(B2)
+    X, iters, converged = lax.while_loop(
+        cond, body, (X0, jnp.int32(0), jnp.bool_(False))
+    )
+    return X, iters, converged
+
+
 def gesv_mixed(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, jnp.ndarray, int]:
@@ -433,20 +477,11 @@ def gesv_mixed(
         Z = lax.linalg.triangular_solve(lu_lo, Y, left_side=True, lower=False)
         return Z.astype(B2.dtype)
 
-    X = solve_lo(B2)
-    iters = 0
-    converged = False
-    for it in range(max_it):
-        R = B2 - A2 @ X
-        iters = it
-        if bool(
-            jnp.abs(R).max()
-            <= tol * float(anorm) * float(jnp.abs(X).max()) + 1e-300
-        ):
-            converged = True
-            break
-        X = X + solve_lo(R)
-    if not converged and use_fallback:
+    X, iters_dev, converged = ir_refine_while(
+        A2, B2, solve_lo, tol, anorm, max_it
+    )
+    iters = int(iters_dev)
+    if not bool(converged) and use_fallback:
         lu_w, perm_w = _lu_dense(A2)
         Y = lax.linalg.triangular_solve(
             lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True

@@ -53,3 +53,13 @@ def accurate_matmul(fn):
         return fn(*args, **kw)
 
     return wrapper
+
+
+# HIGHEST-precision matmul: the TPU f64 emulation's default accumulation
+# is ~f32 grade, so every kernel that owes LAPACK-parity accuracy
+# contracts through this helper (single point for the precision policy).
+import functools as _functools
+
+from jax import lax as _lax
+
+hdot = _functools.partial(jnp.matmul, precision=_lax.Precision.HIGHEST)

@@ -21,9 +21,10 @@ out of the ops that ARE fast here:
 * ``blocked_potrf``   — two-level schedule for large n: at most
   ``coarse_panels`` Python-unrolled panels of width NB (exact shrinking
   shapes, so the trailing update is a full-rate gemm), each diagonal
-  block factored by ``chol_fori``, the panel solve done MAGMA-style as
-  an explicit small triangular inverse + gemm so the bulk work rides
-  the MXU instead of the slow vendor trsm path.
+  block factored by recursing into ``_chol_panels``/``chol_unblocked``,
+  the panel solve done MAGMA-style as an explicit small triangular
+  inverse + gemm so the bulk work rides the MXU instead of the slow
+  vendor trsm path.
 
 Everything is static-shape; distinct XLA shapes per n are bounded by
 O(coarse_panels) to keep compile time in check (measured ~25 s per
@@ -35,8 +36,6 @@ non-CPU backends; the CPU backend keeps the vendor (LAPACK) kernel.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -47,7 +46,7 @@ from jax import lax
 # one bf16 pass (internal/precision.py's policy, applied here directly
 # since these kernels are used inside jit where the context manager at
 # call sites may not be active).
-_dot = functools.partial(jnp.matmul, precision=lax.Precision.HIGHEST)
+from ..internal.precision import hdot as _dot
 
 
 def _conj(x):

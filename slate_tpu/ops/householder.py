@@ -18,6 +18,10 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+# HIGHEST-precision matmul: the TPU f64 emulation default accumulates
+# at ~f32 grade (shared convention with ops/chol_kernels.py et al.)
+from ..internal.precision import hdot as _dot
+
 try:  # fast path: XLA's geqrf primitive (private module path in jax 0.9)
     from jax._src.lax.linalg import geqrf as _geqrf_xla
 except Exception:  # pragma: no cover
@@ -26,8 +30,22 @@ except Exception:  # pragma: no cover
 
 def geqrf(a: jnp.ndarray):
     """LAPACK-style QR: returns (a_factored, taus) with V unit-lower below
-    the diagonal and R above.  Uses XLA's geqrf when available, else the
-    blocked Householder implementation below (identical semantics)."""
+    the diagonal and R above.
+
+    CPU keeps the vendor (LAPACK) kernel; on accelerators, large panels
+    run the native three-level schedule (ops/qr_fast.py — the vendor
+    geqrf lowering measures ~27 GF/s f64 on the chip, the same
+    schedule-bound story as cholesky/LU)."""
+    import jax
+
+    m, n = a.shape
+    if jax.default_backend() != "cpu" and m >= n and n >= 1024:
+        from .qr_fast import geqrf_fast
+
+        for nbf in (512, 256, 128):
+            if n % nbf == 0:
+                fac, taus = geqrf_fast(a, nbf)
+                return fac, taus[: min(m, n)]
     if _geqrf_xla is not None:
         return _geqrf_xla(a)
     return geqrf_blocked(a)
@@ -123,7 +141,7 @@ def larft(V: jnp.ndarray, taus: jnp.ndarray) -> jnp.ndarray:
             [taus, jnp.zeros((nb - taus.shape[0],), taus.dtype)]
         )
     complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
-    VhV = (jnp.conj(V).T if complex_t else V.T) @ V
+    VhV = _dot(jnp.conj(V).T if complex_t else V.T, V)
     U = jnp.triu(VhV, 1)
     big = jnp.asarray(1e30, V.dtype)
     d = jnp.where(taus != 0, 1.0 / jnp.where(taus == 0, 1, taus), big)
@@ -151,9 +169,10 @@ def apply_block_reflector(
     V: jnp.ndarray, T: jnp.ndarray, C: jnp.ndarray, trans: bool
 ) -> jnp.ndarray:
     """C <- (I - V T V^H) C (trans=False) or (I - V T^H V^H) C (True)
-    — LAPACK larfb, left side."""
+    — LAPACK larfb, left side.  HIGHEST precision: the TPU default f64
+    emulation accumulates at ~f32 grade."""
     complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
     Vh = jnp.conj(V).T if complex_t else V.T
-    W = Vh @ C  # (nb, n)
+    W = _dot(Vh, C)  # (nb, n)
     Tm = (jnp.conj(T).T if complex_t else T.T) if trans else T
-    return C - V @ (Tm @ W)
+    return C - _dot(V, _dot(Tm, W))

@@ -242,16 +242,29 @@ def lu_supported(dtype) -> bool:
 
     if jax.default_backend() == "cpu":
         return True
-    return jnp.dtype(dtype).itemsize <= 4 or jnp.issubdtype(
-        jnp.dtype(dtype), jnp.complexfloating
-    ) and jnp.dtype(dtype).itemsize <= 8
+    dt = jnp.dtype(dtype)
+    return dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.complex64))
 
 
 def lu_global(Gp: jnp.ndarray, nb: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Vendor LU when supported, native blocked LU otherwise.
+    """Platform-dispatched LU of the padded global array.
 
-    Returns (LU, perm), perm over Gp's (padded) rows.
+    Returns (LU, perm), perm over Gp's (padded) rows.  CPU keeps the
+    vendor (LAPACK) kernel; on accelerators large square arrays run the
+    three-level native schedule (ops/lu_fast.py — the vendor lowering
+    and the single-level blocked_getrf are both schedule-bound at a few
+    % of the chip's gemm rate), with blocked_getrf as the small-size /
+    rectangular fallback.
     """
+    import jax
+
+    m, n = Gp.shape
+    if jax.default_backend() != "cpu" and m == n and n >= 2048:
+        from .lu_fast import blocked_getrf_fast
+
+        for nbf in (512, 256, 128):
+            if n % nbf == 0:
+                return blocked_getrf_fast(Gp, nbf)
     if lu_supported(Gp.dtype):
         lu2d, _, perm = lax.linalg.lu(Gp)
         return lu2d, perm.astype(jnp.int32)

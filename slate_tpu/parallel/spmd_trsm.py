@@ -99,6 +99,10 @@ def spmd_trsm_left(
                 Tkk = lax.psum(
                     jnp.where(own_diag, dcand, jnp.zeros_like(dcand)), ROW_AXIS
                 )
+                if do_conj:
+                    # solve conj(T) X = B (Op.Conj view without transpose)
+                    left_tiles = jnp.conj(left_tiles)
+                    Tkk = jnp.conj(Tkk)
             else:
                 row_loc = lax.dynamic_index_in_dim(tt, k // p, 0, keepdims=False)
                 row_q = lax.all_gather(row_loc, COL_AXIS)  # (q, ntlT, mb, mb)

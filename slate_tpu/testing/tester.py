@@ -687,19 +687,44 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "sterf": _test_sterf,
 }
 
-# reference-style tolerance factors per routine class (test_*.cc use 3eps
-# with routine-dependent scalings; decompositions get a small headroom
-# multiple).  Observed worst cases on the real chip (type d, quick sweep)
-# are <= ~30x eps under these metrics; factors leave ~2-5x margin.
+# Reference-style tolerance factors per routine class.  The reference
+# accepts error <= 3*eps under per-routine scalings (test_gemm.cc:192-207
+# and analogues); our metrics use the same scalings but looser factors
+# because (a) the TPU f64 emulation's effective unit roundoff is ~10x
+# IEEE (BENCH_NOTES), and (b) several redesigns trade constants for
+# schedule-friendliness.  Factors <= 50 are plain headroom over measured
+# worst cases (~30x eps on-chip).  Every factor > 50 carries its bound:
+#
+#   norm (100)     max-reduction over n^2 terms in emulated f64; bound
+#                  ~n*eps against the elementwise reference.
+#   svd (200)      bisection-based singular vectors: residual constant
+#                  ~n^1.5 at small n (measured worst 144x at n=50).
+#   getri/potri    inverse residual bound scales with cond(A); matgen's
+#   (500)          default kinds run cond up to ~1e4 at sweep sizes.
+#   trtri/gelqf    one extra triangular solve / transpose composition
+#   (100)          over the base factorization bound.
+#   cholqr (50000) error ~ eps * cond(A)^2 by construction (documented
+#                  CholQR bound; the reference tester uses the same).
+#   hegv (300)     compounds potrf(B) + hegst congruence + heev: bound
+#                  ~cond(B) * heev bound.
+#   gesv_rbt (5000) no-pivot LU after the butterfly: growth is bounded
+#                  only probabilistically; IR restores backward error
+#                  but the factor-based metric keeps the growth term.
+#   gesv_calu (500) tournament pivoting's growth bound is 2^(H) vs
+#                  partial pivoting's 2^(n-1) worst case; in practice a
+#                  small multiple of partial pivoting's residual.
+#   hesv (500)     pivot-free LDL^H with growth/d-ratio breakdown
+#                  detection + RBT fallback + 2 IR steps (was 5000 with
+#                  exact-zero-only detection; the growth trigger now
+#                  bounds the surviving factors' conditioning).
 TOL_FACTOR = {
     "gemm": 10, "norm": 100, "trsm": 30, "posv": 50, "potrf": 50,
     "gesv": 50, "geqrf": 50, "gels": 50, "heev": 50, "svd": 200,
     "symm": 10, "hemm": 10, "herk": 30, "syrk": 30, "her2k": 30,
     "trmm": 30, "getri": 500, "potri": 500, "trtri": 100, "gelqf": 100,
-    # CholQR error ~ eps * cond(A)^2 by construction
     "cholqr": 50000,
     "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
-    "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 5000, "condest": 1,
+    "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 500, "condest": 1,
     "steqr": 50, "sterf": 50,
 }
 

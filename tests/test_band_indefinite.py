@@ -205,3 +205,22 @@ def test_hesv_zero_minors_complex(rng):
     A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
     X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
     assert np.abs(A0 @ np.asarray(X.to_global()) - B0).max() < 1e-8
+
+
+def test_hesv_near_singular_leading_minor(rng):
+    """A 1e-13-pivot leading minor (not an exact zero) must trip the
+    growth/d-ratio breakdown detection and take the RBT fallback —
+    exact-zero-only detection would hand the catastrophic growth to IR
+    (VERDICT r2 weak point #30)."""
+    import jax.numpy as jnp
+
+    n = 32
+    A0 = rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2 + np.diag(np.abs(rng.standard_normal(n)) + 1)
+    A0[0, 0] = 1e-13  # near-singular 1x1 leading minor
+    B0 = rng.standard_normal((n, 3))
+    A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
+    X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
+    assert hasattr(L, "_rbt"), "near-singular minor must trigger the butterfly"
+    res = np.abs(A0 @ np.asarray(X.to_global()) - B0).max()
+    assert res < 1e-9 * max(np.abs(A0).max(), 1.0)
