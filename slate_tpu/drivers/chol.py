@@ -32,8 +32,7 @@ from .aux import norm as _norm
 from ..aux.trace import traced
 
 
-def _is_distributed(M: BaseMatrix) -> bool:
-    return M.grid is not None and M.grid.size > 1
+from ..matrix.base import is_distributed as _is_distributed
 
 
 def _hermitian_full_tiles(A: HermitianMatrix) -> jnp.ndarray:

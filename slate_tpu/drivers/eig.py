@@ -41,6 +41,9 @@ from ..aux.trace import traced
 from ..internal.precision import accurate_matmul
 
 
+from ..matrix.base import is_distributed as _is_distributed
+
+
 @accurate_matmul
 def he2hb(
     A: HermitianMatrix, opts: Optional[Options] = None
@@ -48,6 +51,11 @@ def he2hb(
     """Reduce Hermitian A to band form with bandwidth nb
     (reference: src/he2hb.cc: per-panel QR over panel ranks + two-sided
     trailing update).
+
+    Distributed lower-Hermitian inputs run the shard_map panel pipeline
+    (parallel/spmd_he2hb.py — panel gather + masked-einsum two-sided
+    trailing update, no full_global(); the reference also restricts
+    he2hb to Uplo::Lower, he2hb.cc:36).
 
     Returns (band, V, T): band Hermitian with kd = nb; V stores the block
     reflectors (panel k in tile column k, rows k+1..), T their compact-WY
@@ -60,6 +68,24 @@ def he2hb(
     lay = A.layout
     nb = lay.nb
     n = A.n
+
+    if (
+        _is_distributed(A)
+        and get_option(opts, Option.UseShardMap)
+        and A.uplo == Uplo.Lower
+        and A.op == Op.NoTrans
+        and lay.mb == lay.nb
+    ):
+        from ..parallel.spmd_he2hb import spmd_he2hb
+
+        band_t, V_t, Tstack = spmd_he2hb(A.grid, A.data, lay)
+        if lay.nt - 1 <= 0:
+            Tstack = jnp.zeros((0, nb, nb), A.dtype)
+        band = HermitianBandMatrix(
+            band_t, lay, grid=A.grid, kd=nb, uplo=Uplo.Lower
+        )
+        return band, Matrix(V_t, lay, grid=A.grid), TriangularFactors(Tstack)
+
     G = A.full_global()
     kt = lay.nt
     complex_t = A.is_complex
@@ -147,6 +173,31 @@ def unmtr_he2hb(
     nb = lay.nb
     n = V.n
     kt = lay.nt
+
+    if (
+        _is_distributed(V)
+        and get_option(opts, Option.UseShardMap)
+        and side == Side.Left
+        and V.op == Op.NoTrans
+        and C_mat.op == Op.NoTrans
+        and lay.mb == lay.nb
+        and C_mat.layout.mb == lay.mb
+    ):
+        from ..parallel.spmd_he2hb import spmd_unmtr_he2hb_left
+
+        if T.T.shape[0] == 0:
+            return C_mat
+        Ct = spmd_unmtr_he2hb_left(
+            V.grid,
+            V.data,
+            T.T,
+            C_mat.data,
+            lay,
+            C_mat.layout,
+            trans=(op != Op.NoTrans),
+        )
+        return C_mat._with(data=Ct)
+
     Vg = V.to_global()
     C2 = C_mat.to_global()
     complex_t = V.is_complex
@@ -228,12 +279,14 @@ def heev(
             TAUS=TAUS, VS=VS, Z=(u[:, None] * ZT).astype(A.dtype), n=n, b=b
         )
     else:
-        w, Z2 = _gathered_band_eig(Gband, vectors)
+        # rebuild the full Hermitian band from the stored triangle (the
+        # spmd he2hb band carries the lower triangle only)
+        w, Z2 = _gathered_band_eig(band.full_global(), vectors)
         if not vectors:
             return w, None
     Zm = Matrix(
         tiles_from_global(Z2.astype(A.dtype), A.layout), A.layout, grid=A.grid
-    )
+    ).shard()
     # back-transform: Z = Q_he2hb Z_band (unmtr_he2hb, heev.cc:193-203)
     Z = unmtr_he2hb(Side.Left, Op.NoTrans, V, T, Zm, opts)
     return w, Z

@@ -38,8 +38,7 @@ from ..internal.precision import accurate_matmul
 from ..aux.trace import traced
 
 
-def _is_distributed(M: BaseMatrix) -> bool:
-    return M.grid is not None and M.grid.size > 1
+from ..matrix.base import is_distributed as _is_distributed
 
 
 def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:

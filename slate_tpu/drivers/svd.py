@@ -30,6 +30,9 @@ from ..aux.trace import traced
 from ..internal.precision import accurate_matmul
 
 
+from ..matrix.base import is_distributed as _is_distributed
+
+
 @accurate_matmul
 def ge2tb(
     A: Matrix, opts: Optional[Options] = None
@@ -37,6 +40,10 @@ def ge2tb(
     """Reduce general A to upper triangular band form (reference:
     src/ge2tb.cc): alternating panel QR from the left (columns) and panel
     LQ from the right (rows), bandwidth nb.
+
+    Distributed inputs run the shard_map panel pipeline
+    (parallel/spmd_ge2tb.py): panel gathers + distributed compact-WY
+    trailing updates, no full-matrix gather anywhere in stage 1.
 
     Returns (band, U_V, U_T, V_V, V_T) with the left/right reflector sets
     for unmbr_ge2tb."""
@@ -47,6 +54,30 @@ def ge2tb(
     lay = A.layout
     nb = lay.nb
     m, n = A.m, A.n
+
+    if (
+        _is_distributed(A)
+        and get_option(opts, Option.UseShardMap)
+        and A.op == Op.NoTrans
+        and lay.mb == lay.nb
+    ):
+        from ..parallel.spmd_ge2tb import spmd_ge2tb
+
+        v_lay = TileLayout(n, n, nb, nb, lay.p, lay.q)
+        band_t, UV_t, UT, VV_t, VT = spmd_ge2tb(
+            A.grid, A.resolved().data, lay, v_lay
+        )
+        band = TriangularBandMatrix(
+            band_t, lay, grid=A.grid, kd=nb, uplo=Uplo.Upper
+        )
+        return (
+            band,
+            Matrix(UV_t, lay, grid=A.grid),
+            TriangularFactors(UT),
+            Matrix(VV_t, v_lay, grid=A.grid),
+            TriangularFactors(VT),
+        )
+
     G = A.to_global()
     kt = min(lay.mt, lay.nt)
     complex_t = A.is_complex
@@ -303,17 +334,43 @@ def svd(
     else:
         Ub, s, Vhb = svd_accurate(Gband)
     # back-transform (unmbr_ge2tb): U = Q_U Ub, V^H = Vhb Q_V^H
-    U = unmbr_ge2tb_left(UVm, UT, Ub, A)
-    Vh = unmbr_ge2tb_right(VVm, VT, Vhb, A)
+    U = unmbr_ge2tb_left(UVm, UT, Ub, A, opts)
+    Vh = unmbr_ge2tb_right(VVm, VT, Vhb, A, opts)
     return s[: min(m, n)], U, Vh
 
 
 @accurate_matmul
-def unmbr_ge2tb_left(UVm: Matrix, UT: TriangularFactors, C2, A: Matrix) -> Matrix:
+def unmbr_ge2tb_left(
+    UVm: Matrix,
+    UT: TriangularFactors,
+    C2,
+    A: Matrix,
+    opts: Optional[Options] = None,
+) -> Matrix:
     """Apply the left (QR-side) ge2tb reflectors: C <- Q_U C
     (reference: src/unmbr_ge2tb.cc)."""
     lay = A.layout
     nb = lay.nb
+
+    if (
+        _is_distributed(UVm)
+        and get_option(opts, Option.UseShardMap)
+        and UVm.op == Op.NoTrans
+        and lay.mb == lay.nb
+        and UT.T.shape[0] > 0
+    ):
+        from ..parallel.spmd_ge2tb import spmd_unmbr_ge2tb_left
+
+        C2a = jnp.asarray(C2).astype(A.dtype)
+        c_lay = TileLayout(
+            C2a.shape[0], C2a.shape[1], lay.mb, lay.nb, lay.p, lay.q
+        )
+        Cm = Matrix(tiles_from_global(C2a, c_lay), c_lay, grid=A.grid).shard()
+        Ct = spmd_unmbr_ge2tb_left(
+            UVm.grid, UVm.data, UT.T, Cm.data, UVm.layout, c_lay
+        )
+        return Cm._with(data=Ct)
+
     UVg = UVm.to_global()
     complex_t = UVm.is_complex
 
@@ -333,10 +390,36 @@ def unmbr_ge2tb_left(UVm: Matrix, UT: TriangularFactors, C2, A: Matrix) -> Matri
 
 
 @accurate_matmul
-def unmbr_ge2tb_right(VVm: Matrix, VT: TriangularFactors, C2, A: Matrix) -> Matrix:
+def unmbr_ge2tb_right(
+    VVm: Matrix,
+    VT: TriangularFactors,
+    C2,
+    A: Matrix,
+    opts: Optional[Options] = None,
+) -> Matrix:
     """Apply the right (LQ-side) reflectors: C <- C Q_V^H."""
     lay = A.layout
     nb = lay.nb
+
+    if (
+        _is_distributed(VVm)
+        and get_option(opts, Option.UseShardMap)
+        and VVm.op == Op.NoTrans
+        and lay.mb == lay.nb
+        and VT.T.shape[0] > 0
+    ):
+        from ..parallel.spmd_ge2tb import spmd_unmbr_ge2tb_right
+
+        C2a = jnp.asarray(C2).astype(A.dtype)
+        c_lay = TileLayout(
+            C2a.shape[0], C2a.shape[1], lay.nb, lay.nb, lay.p, lay.q
+        )
+        Cm = Matrix(tiles_from_global(C2a, c_lay), c_lay, grid=A.grid).shard()
+        Ct = spmd_unmbr_ge2tb_right(
+            VVm.grid, VVm.data, VT.T, Cm.data, VVm.layout, c_lay
+        )
+        return Cm._with(data=Ct)
+
     VVg = VVm.to_global()
     complex_t = VVm.is_complex
 

@@ -247,6 +247,12 @@ class BaseMatrix:
 jax.tree_util.register_pytree_node_class(BaseMatrix)
 
 
+def is_distributed(M: BaseMatrix) -> bool:
+    """True when M lives on a multi-process grid (the spmd-dispatch
+    predicate shared by every driver)."""
+    return M.grid is not None and M.grid.size > 1
+
+
 def transpose(A: BaseMatrix) -> BaseMatrix:
     """O(1) transposed view (reference: slate::transpose, BaseMatrix.hh)."""
     new_op = {Op.NoTrans: Op.Trans, Op.Trans: Op.NoTrans, Op.ConjTrans: Op.NoTrans}[A.op]

@@ -221,3 +221,17 @@ class TriangularBandMatrix(BandMatrix):
 
 class HermitianBandMatrix(TriangularBandMatrix):
     """Hermitian band, one triangle stored (reference: HermitianBandMatrix.hh)."""
+
+    def full_global(self) -> jnp.ndarray:
+        """Materialize the full Hermitian band from the stored triangle
+        (entries outside the referenced triangle are not read — the spmd
+        he2hb pipeline leaves them stale)."""
+        A = self.to_global()
+        if self.uplo == Uplo.Lower:
+            kept = jnp.tril(A)
+            strict = jnp.tril(A, -1)
+        else:
+            kept = jnp.triu(A)
+            strict = jnp.triu(A, 1)
+        mirror = jnp.conj(strict).T if self.is_complex else strict.T
+        return kept + mirror

@@ -1,0 +1,340 @@
+"""Distributed two-stage SVD stage 1: ge2tb over the mesh.
+
+TPU-native re-design of the reference ge2tb driver (reference:
+src/ge2tb.cc — per panel k: internal::geqrf of column panel k +
+compact-WY trailing update from the left, then internal::gelqf of row
+panel k + trailing update from the right; SURVEY §3.5).
+
+Mesh schedule per panel (one lax.fori_loop body, static shapes):
+
+* the QR panel (column block k, rows k*nb..) is rebuilt everywhere by two
+  all_gathers and factored redundantly; the left update
+  C <- (I - V T^H V^H) C is W = V^H C (local einsum + psum over 'p')
+  followed by a local rank-nb correction — the spmd_qr pattern;
+* the LQ panel is the conj-transposed row block k (gathered by the dual
+  pair of all_gathers over 'p' then 'q'); the right update
+  C <- C (I - VL TL^H VL^H)^H is Wb = C VL (psum over 'q') + local
+  correction;
+* R / L^H overwrite their panel on the owner; U/V reflectors are stashed
+  into distributed tile arrays for unmbr_ge2tb.
+
+No full_global(): cross-device traffic is two panel gathers and two
+rank-nb psums per step, O((m+n) nb) over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.householder import geqrf as _geqrf_kernel, larft
+from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
+from ..parallel.layout import TileLayout
+from .spmd_blas import shard_map
+
+
+def _resize_rows(x: jnp.ndarray, rows: int) -> jnp.ndarray:
+    if x.shape[0] == rows:
+        return x
+    if x.shape[0] > rows:
+        return x[:rows]
+    return jnp.pad(x, ((0, rows - x.shape[0]), (0, 0)))
+
+
+def spmd_ge2tb(
+    grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout, v_layout: TileLayout
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reduce general storage tiles to upper-triangular band (kd = nb).
+
+    T: (P, Q, mb, nb) storage tiles with mb == nb.  v_layout is the
+    (n, n) layout of the right-reflector array.  Returns
+    (band_tiles, UV_tiles, UT, VV_tiles, VT): the band lives in the
+    diagonal + first superdiagonal tile blocks; UV stores panel k's left
+    reflectors in tile column k (rows k..), VV the right reflectors in
+    tile column k (rows k+1..); UT/VT are (kt, nb, nb) replicated.
+    """
+    p, q = grid.p, grid.q
+    mb = layout.mb
+    assert mb == layout.nb, "ge2tb requires square tiles"
+    m, n = layout.m, layout.n
+    kt = min(layout.mt, layout.nt)
+    mtl, ntl = layout.mtl, layout.ntl
+    m_pad = layout.P * mb
+    n_pad = layout.Q * mb
+    mtl_v, ntl_v = v_layout.mtl, v_layout.ntl
+    v_pad = v_layout.P * mb
+    row_scatter = jnp.asarray(layout.row_scatter)
+    row_gather = jnp.asarray(layout.row_gather)
+    col_scatter = jnp.asarray(layout.col_scatter)
+    col_gather = jnp.asarray(layout.col_gather)
+    v_row_gather = jnp.asarray(v_layout.row_gather)
+    complex_t = jnp.issubdtype(T.dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    def local(tl):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtl) * p + r
+        gj = jnp.arange(ntl) * q + c
+        gvi = jnp.arange(mtl_v) * p + r
+        er = gi[:, None] * mb + jnp.arange(mb)[None, :]  # (mtl, mb)
+        ec = gj[:, None] * mb + jnp.arange(mb)[None, :]  # (ntl, mb)
+        g_rowsM = jnp.arange(m_pad, dtype=jnp.int32)
+        g_rowsN = jnp.arange(n_pad, dtype=jnp.int32)
+        pcols = jnp.arange(mb)
+
+        def step(k, carry):
+            tl, UV, VV, UT, VT = carry
+            lo = k * mb
+            co = (k + 1) * mb
+
+            # ===== left QR panel: column block k, rows lo.. =============
+            pan_loc = lax.dynamic_slice_in_dim(tl, k // q, 1, axis=1)[:, 0]
+            pan_q = lax.all_gather(pan_loc, COL_AXIS)
+            pan_rows = lax.dynamic_index_in_dim(pan_q, k % q, 0, keepdims=False)
+            pan_full = lax.all_gather(pan_rows, ROW_AXIS).reshape(p * mtl, mb, mb)
+            panel2d = pan_full[row_scatter].reshape(m_pad, mb)
+            hM = m - lo
+            pact = jnp.roll(panel2d, -lo, axis=0)
+            pact = jnp.where((g_rowsM < hM)[:, None], pact, 0)
+            pact = jnp.where((pcols < (n - lo))[None, :], pact, 0)
+            vr, taus = _geqrf_kernel(pact)
+            rows_ = g_rowsM[:, None]
+            V_act = jnp.where(rows_ > pcols[None, :], vr, 0) + jnp.where(
+                rows_ == pcols[None, :], jnp.ones_like(vr), 0
+            )
+            V_act = jnp.where((g_rowsM < hM)[:, None], V_act, 0)
+            V_act = jnp.where((pcols < (n - lo))[None, :], V_act, 0)
+            Tk = larft(V_act, taus)
+            UT = lax.dynamic_update_index_in_dim(UT, Tk.astype(UT.dtype), k, 0)
+
+            # write [R; 0] back on the owner column (tile rows >= k)
+            R2d = jnp.roll(
+                jnp.where((g_rowsM < hM)[:, None], jnp.triu(vr), 0), lo, axis=0
+            )
+            fac_st = R2d.reshape(layout.P, mb, mb)[row_gather]
+            mine = lax.dynamic_slice_in_dim(fac_st, r * mtl, mtl, axis=0)
+            cur_col = lax.dynamic_slice_in_dim(tl, k // q, 1, axis=1)[:, 0]
+            sel = ((gi >= k)[:, None, None]) & (c == k % q)
+            tl = lax.dynamic_update_slice_in_dim(
+                tl, jnp.where(sel, mine, cur_col)[:, None], k // q, axis=1
+            )
+
+            # left trailing update on columns >= co
+            V2d = jnp.roll(V_act, lo, axis=0)
+            V_nat = V2d.reshape(layout.P, mb, mb)
+            V_rows = V_nat[gi]
+            cmask = ((ec >= co) & (ec < n))[None, :, None, :]
+            Cm = jnp.where(cmask, tl, 0)
+            W = jnp.einsum("iav,ijab->vjb", conj(V_rows), Cm)
+            W = lax.psum(W, ROW_AXIS)
+            upd = jnp.einsum("iav,vw,wjb->ijab", V_rows, conj(Tk).T, W)
+            tl = tl - jnp.where(cmask, upd, 0)
+
+            # stash U reflectors (UV tile column k, rows >= k)
+            V_st = V_nat[row_gather]
+            vmine = lax.dynamic_slice_in_dim(V_st, r * mtl, mtl, axis=0)
+            cur_uv = lax.dynamic_slice_in_dim(UV, k // q, 1, axis=1)[:, 0]
+            UV = lax.dynamic_update_slice_in_dim(
+                UV, jnp.where(sel, vmine, cur_uv)[:, None], k // q, axis=1
+            )
+
+            # ===== right LQ panel: row block k, columns co.. ============
+            row_loc = lax.dynamic_slice_in_dim(tl, k // p, 1, axis=0)[0]
+            row_p = lax.all_gather(row_loc, ROW_AXIS)
+            row_cols = lax.dynamic_index_in_dim(row_p, k % p, 0, keepdims=False)
+            row_full = lax.all_gather(row_cols, COL_AXIS).reshape(q * ntl, mb, mb)
+            row2d = (
+                row_full[col_scatter].transpose(1, 0, 2).reshape(mb, n_pad)
+            )
+            P2 = conj(row2d).T  # (n_pad, mb): rows are global columns
+            hN = n - co
+            P2 = jnp.roll(P2, -co, axis=0)
+            P2 = jnp.where((g_rowsN < hN)[:, None], P2, 0)
+            P2 = jnp.where((pcols < (m - lo))[None, :], P2, 0)
+            vrL, tausL = _geqrf_kernel(P2)
+            rowsN_ = g_rowsN[:, None]
+            VL_act = jnp.where(rowsN_ > pcols[None, :], vrL, 0) + jnp.where(
+                rowsN_ == pcols[None, :], jnp.ones_like(vrL), 0
+            )
+            VL_act = jnp.where((g_rowsN < hN)[:, None], VL_act, 0)
+            VL_act = jnp.where((pcols < (m - lo))[None, :], VL_act, 0)
+            TkL = larft(VL_act, tausL)
+            VT = lax.dynamic_update_index_in_dim(VT, TkL.astype(VT.dtype), k, 0)
+
+            # write L^H = conj(triu(vrL))^T back on the owner row
+            # (tile cols >= k+1)
+            RL2d = jnp.roll(
+                jnp.where((g_rowsN < hN)[:, None], jnp.triu(vrL), 0), co, axis=0
+            )
+            RL_tiles = conj(jnp.swapaxes(RL2d.reshape(layout.Q, mb, mb), 1, 2))
+            RL_st = RL_tiles[col_gather]
+            rmine = lax.dynamic_slice_in_dim(RL_st, c * ntl, ntl, axis=0)
+            cur_row = lax.dynamic_slice_in_dim(tl, k // p, 1, axis=0)[0]
+            rsel = ((gj > k)[:, None, None]) & (r == k % p)
+            tl = lax.dynamic_update_slice_in_dim(
+                tl, jnp.where(rsel, rmine, cur_row)[None], k // p, axis=0
+            )
+
+            # right trailing update on rows >= co
+            VL2d = jnp.roll(VL_act, co, axis=0)
+            VL_nat = VL2d.reshape(layout.Q, mb, mb)
+            VL_cols = VL_nat[gj]
+            rmask = ((er >= co) & (er < m))[:, None, :, None]
+            Cb = jnp.where(rmask, tl, 0)
+            Wb = jnp.einsum("ijab,jbv->iav", Cb, VL_cols)
+            Wb = lax.psum(Wb, COL_AXIS)
+            updR = jnp.einsum("iav,vw,jbw->ijab", Wb, TkL, conj(VL_cols))
+            tl = tl - jnp.where(rmask, updR, 0)
+
+            # stash V reflectors (VV tile column k, rows >= k+1) in the
+            # (n, n) v_layout
+            VL2d_v = _resize_rows(VL2d, v_pad)
+            VL_stv = VL2d_v.reshape(v_layout.P, mb, mb)[v_row_gather]
+            vvmine = lax.dynamic_slice_in_dim(VL_stv, r * mtl_v, mtl_v, axis=0)
+            cur_vv = lax.dynamic_slice_in_dim(VV, k // q, 1, axis=1)[:, 0]
+            vsel = ((gvi > k)[:, None, None]) & (c == k % q)
+            VV = lax.dynamic_update_slice_in_dim(
+                VV, jnp.where(vsel, vvmine, cur_vv)[:, None], k // q, axis=1
+            )
+            return tl, UV, VV, UT, VT
+
+        UV0 = jnp.zeros_like(tl)
+        VV0 = jnp.zeros((mtl_v, ntl_v, mb, mb), tl.dtype)
+        UT0 = jnp.zeros((kt, mb, mb), tl.dtype)
+        VT0 = jnp.zeros((kt, mb, mb), tl.dtype)
+        tl, UV, VV, UT, VT = lax.fori_loop(
+            0, kt, step, (tl, UV0, VV0, UT0, VT0)
+        )
+        return tl, UV, UT, VV, VT
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local,
+        mesh=grid.mesh,
+        in_specs=(spec,),
+        out_specs=(spec, spec, P(), spec, P()),
+    )
+    return fn(T)
+
+
+def spmd_unmbr_ge2tb_left(
+    grid: ProcessGrid,
+    UV_tiles: jnp.ndarray,
+    UT: jnp.ndarray,
+    C_tiles: jnp.ndarray,
+    v_layout: TileLayout,
+    c_layout: TileLayout,
+) -> jnp.ndarray:
+    """C <- Q_U C with Q_U = H_0 ... H_{kt-1} from spmd_ge2tb (reference:
+    src/unmbr_ge2tb.cc, left side): panels applied in descending order,
+    each via panel-gather + distributed compact-WY apply."""
+    p, q = grid.p, grid.q
+    mb = v_layout.mb
+    kt = UT.shape[0]
+    mtl = v_layout.mtl
+    m_pad = v_layout.P * mb
+    n = v_layout.m
+    row_scatter = jnp.asarray(v_layout.row_scatter)
+    complex_t = jnp.issubdtype(C_tiles.dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    def local(vt, Ts, ct):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtl) * p + r
+        g_rows = jnp.arange(m_pad, dtype=jnp.int32)
+
+        def step(i, ct):
+            k = kt - 1 - i
+            lo = k * mb
+            pan_loc = lax.dynamic_slice_in_dim(vt, k // q, 1, axis=1)[:, 0]
+            pan_q = lax.all_gather(pan_loc, COL_AXIS)
+            pan_rows = lax.dynamic_index_in_dim(pan_q, k % q, 0, keepdims=False)
+            pan_full = lax.all_gather(pan_rows, ROW_AXIS).reshape(p * mtl, mb, mb)
+            V2d = pan_full[row_scatter].reshape(m_pad, mb)
+            V2d = jnp.where(
+                (g_rows >= lo)[:, None] & (g_rows < v_layout.m)[:, None], V2d, 0
+            )
+            V_rows = V2d.reshape(v_layout.P, mb, mb)[gi]
+            Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
+            W = jnp.einsum("iav,ijab->vjb", conj(V_rows), ct)
+            W = lax.psum(W, ROW_AXIS)
+            upd = jnp.einsum("iav,vw,wjb->ijab", V_rows, Tk, W)
+            return ct - upd
+
+        return lax.fori_loop(0, kt, step, ct)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local, mesh=grid.mesh, in_specs=(spec, P(), spec), out_specs=spec
+    )
+    return fn(UV_tiles, UT, C_tiles)
+
+
+def spmd_unmbr_ge2tb_right(
+    grid: ProcessGrid,
+    VV_tiles: jnp.ndarray,
+    VT: jnp.ndarray,
+    C_tiles: jnp.ndarray,
+    v_layout: TileLayout,
+    c_layout: TileLayout,
+) -> jnp.ndarray:
+    """C <- C Q_V^H with Q_V from spmd_ge2tb's right reflectors: per panel
+    k (descending) C <- C (I - V_k T_k^H V_k^H), the dual of the left
+    apply with the contraction over the column axis."""
+    p, q = grid.p, grid.q
+    mb = v_layout.mb
+    kt = VT.shape[0]
+    mtl_v = v_layout.mtl
+    ntl_c = c_layout.ntl
+    v_pad = v_layout.P * mb
+    nc_pad = c_layout.Q * mb
+    n = v_layout.m
+    row_scatter = jnp.asarray(v_layout.row_scatter)
+    complex_t = jnp.issubdtype(C_tiles.dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    def local(vt, Ts, ct):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gj = jnp.arange(ntl_c) * q + c
+        g_rows = jnp.arange(v_pad, dtype=jnp.int32)
+
+        def step(i, ct):
+            k = kt - 1 - i
+            co = (k + 1) * mb
+            pan_loc = lax.dynamic_slice_in_dim(vt, k // q, 1, axis=1)[:, 0]
+            pan_q = lax.all_gather(pan_loc, COL_AXIS)
+            pan_rows = lax.dynamic_index_in_dim(pan_q, k % q, 0, keepdims=False)
+            pan_full = lax.all_gather(pan_rows, ROW_AXIS).reshape(
+                p * mtl_v, mb, mb
+            )
+            V2d = pan_full[row_scatter].reshape(v_pad, mb)
+            V2d = jnp.where(
+                (g_rows >= co)[:, None] & (g_rows < n)[:, None], V2d, 0
+            )
+            V2d_c = _resize_rows(V2d, nc_pad)
+            VL_cols = V2d_c.reshape(c_layout.Q, mb, mb)[gj]
+            Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
+            Wb = jnp.einsum("ijab,jbv->iav", ct, VL_cols)
+            Wb = lax.psum(Wb, COL_AXIS)
+            upd = jnp.einsum("iav,vw,jbw->ijab", Wb, conj(Tk).T, conj(VL_cols))
+            return ct - upd
+
+        return lax.fori_loop(0, kt, step, ct)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local, mesh=grid.mesh, in_specs=(spec, P(), spec), out_specs=spec
+    )
+    return fn(VV_tiles, VT, C_tiles)

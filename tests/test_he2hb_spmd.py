@@ -1,0 +1,204 @@
+"""Distributed two-stage reduction tests (reference: test/test_heev.cc,
+test_svd.cc distributed runs).
+
+These exercise parallel/spmd_he2hb.py and parallel/spmd_ge2tb.py — the
+shard_map stage-1 panel pipelines — directly and through the drivers,
+and assert the drivers route distributed inputs through them with NO
+full-matrix gather anywhere in stage 1 (the reference distributes
+he2hb/ge2tb the same way: src/he2hb.cc:98-185, src/ge2tb.cc).
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import eig, svd as svd_mod
+from slate_tpu.enums import Op, Side, Uplo
+from slate_tpu.matrix.base import BaseMatrix
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix
+from slate_tpu.parallel import spmd_ge2tb, spmd_he2hb
+from slate_tpu.testing import checks
+
+
+def _herm(rng, n, dtype=np.float64):
+    A = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        A = A + 1j * rng.standard_normal((n, n))
+    return (A + A.conj().T) / 2
+
+
+def _no_gather(monkeypatch):
+    """Patch every gather route to raise; returns a restore-free context
+    (monkeypatch undoes it)."""
+
+    def boom(self, *a, **kw):  # pragma: no cover - failure path
+        raise AssertionError("full-matrix gather in a gather-free path")
+
+    monkeypatch.setattr(BaseMatrix, "to_global", boom)
+    monkeypatch.setattr(HermitianMatrix, "full_global", boom, raising=True)
+
+
+@pytest.mark.parametrize("n,nb", [(64, 16), (50, 16)])
+def test_he2hb_spmd_band_spectrum(rng, grid22, n, nb):
+    """The distributed band is banded and orthogonally similar to A.
+
+    (Elementwise band parity with the gathered path does not hold: the
+    two paths use different — equally valid — reflector sign
+    conventions, so the bands differ by a signed diagonal similarity.)"""
+    A0 = _herm(rng, n)
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    band_d, Vd, Td = eig.he2hb(Ad)
+    Gd = np.asarray(band_d.to_global())
+    low_d = np.tril(Gd)
+    # band-ness of the stored triangle
+    out_of_band = np.tri(n, n, -nb - 1) > 0
+    assert np.abs(low_d[out_of_band]).max() < 1e-12
+    B = low_d + np.tril(low_d, -1).T
+    np.testing.assert_allclose(
+        np.linalg.eigvalsh(B), np.linalg.eigvalsh(A0), atol=1e-12 * n
+    )
+
+
+def test_he2hb_spmd_gather_free(rng, grid22, monkeypatch):
+    n, nb = 64, 16
+    A0 = _herm(rng, n)
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+
+    calls = {"n": 0}
+    orig = spmd_he2hb.spmd_he2hb
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(spmd_he2hb, "spmd_he2hb", counting)
+    _no_gather(monkeypatch)
+    band, V, T = eig.he2hb(Ad)
+    assert calls["n"] == 1, "distributed he2hb must run the shard_map pipeline"
+    assert band.data.shape == Ad.data.shape
+
+
+def test_he2hb_spmd_reconstructs(rng, grid22):
+    """Q B Q^H == A: apply the distributed back-transform to the band."""
+    n, nb = 64, 16
+    A0 = _herm(rng, n)
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    band, V, T = eig.he2hb(Ad)
+    G = np.asarray(band.to_global())
+    B = np.tril(G) * (np.tri(n, n, 0) - np.tri(n, n, -nb - 1) > 0)
+    B = B + np.tril(B, -1).T
+    Bm = Matrix.from_global(B, nb, grid=grid22)
+    QB = eig.unmtr_he2hb(Side.Left, Op.NoTrans, V, T, Bm)
+    QBm = Matrix.from_global(np.asarray(QB.to_global()).T, nb, grid=grid22)
+    QBQ = eig.unmtr_he2hb(Side.Left, Op.NoTrans, V, T, QBm)
+    rec = np.asarray(QBQ.to_global()).T
+    err = np.abs(rec - A0).max() / (np.abs(A0).max() * n)
+    assert err < 1e-13, err
+
+
+def test_unmtr_he2hb_spmd_matches_gathered(rng, grid22):
+    """The distributed apply matches the gathered apply of the SAME V/T."""
+    n, nb = 64, 16
+    A0 = _herm(rng, n)
+    C0 = rng.standard_normal((n, n))
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    band_d, Vd, Td = eig.he2hb(Ad)
+    V1 = Matrix.from_global(np.asarray(Vd.to_global()), nb)
+    Cd = Matrix.from_global(C0, nb, grid=grid22)
+    C1 = Matrix.from_global(C0, nb)
+    for op in (Op.NoTrans, Op.ConjTrans):
+        out_d = eig.unmtr_he2hb(Side.Left, op, Vd, Td, Cd)
+        out_1 = eig.unmtr_he2hb(Side.Left, op, V1, Td, C1)
+        np.testing.assert_allclose(
+            np.asarray(out_d.to_global()),
+            np.asarray(out_1.to_global()),
+            atol=1e-10,
+        )
+
+
+@pytest.mark.parametrize("gridname", ["grid22", "grid42"])
+def test_heev_spmd_vectors_residual(rng, gridname, request):
+    grid = request.getfixturevalue(gridname)
+    n, nb = 64, 16
+    A0 = _herm(rng, n)
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid, uplo=Uplo.Lower)
+    w, Z = eig.heev(Ad)
+    Zg = np.asarray(Z.to_global())
+    err = np.abs(A0 @ Zg - Zg * np.asarray(w)[None, :]).max() / (
+        np.abs(A0).max() * n
+    )
+    assert err < 1e-12, err
+    orth = np.abs(Zg.T @ Zg - np.eye(n)).max()
+    assert orth < 1e-12 * n, orth
+
+
+def test_heev_spmd_complex(rng, grid22):
+    n, nb = 48, 16
+    A0 = _herm(rng, n, np.complex128)
+    Ad = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    w, Z = eig.heev(Ad)
+    np.testing.assert_allclose(
+        np.asarray(w), np.linalg.eigvalsh(A0), atol=1e-11 * n
+    )
+    Zg = np.asarray(Z.to_global())
+    err = np.abs(A0 @ Zg - Zg * np.asarray(w)[None, :]).max()
+    assert err < 1e-10 * n, err
+
+
+# ---------------------------------------------------------------------------
+# ge2tb
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,n,nb", [(64, 64, 16), (80, 64, 16), (70, 50, 16)])
+def test_ge2tb_spmd_band_values(rng, grid22, m, n, nb):
+    """The distributed band is orthogonally equivalent to A: its singular
+    values match."""
+    A0 = rng.standard_normal((m, n))
+    Ad = Matrix.from_global(A0, nb, grid=grid22)
+    band, UV, UT, VV, VT = svd_mod.ge2tb(Ad)
+    G = np.asarray(band.to_global())
+    # band-ness: only the diagonal + nb superdiagonals are populated
+    i, j = np.meshgrid(range(m), range(n), indexing="ij")
+    out_of_band = (j < i) | (j > i + nb)
+    assert np.abs(G[out_of_band]).max() < 1e-12
+    np.testing.assert_allclose(
+        np.linalg.svd(G, compute_uv=False),
+        np.linalg.svd(A0, compute_uv=False),
+        atol=1e-10 * max(m, n),
+    )
+
+
+def test_ge2tb_spmd_gather_free(rng, grid22, monkeypatch):
+    m, n, nb = 64, 64, 16
+    A0 = rng.standard_normal((m, n))
+    Ad = Matrix.from_global(A0, nb, grid=grid22)
+
+    calls = {"n": 0}
+    orig = spmd_ge2tb.spmd_ge2tb
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(spmd_ge2tb, "spmd_ge2tb", counting)
+    _no_gather(monkeypatch)
+    band, UV, UT, VV, VT = svd_mod.ge2tb(Ad)
+    assert calls["n"] == 1, "distributed ge2tb must run the shard_map pipeline"
+
+
+@pytest.mark.parametrize("gridname", ["grid22", "grid42"])
+def test_svd_spmd_vectors_residual(rng, gridname, request):
+    grid = request.getfixturevalue(gridname)
+    m, n, nb = 80, 64, 16
+    A0 = rng.standard_normal((m, n))
+    Ad = Matrix.from_global(A0, nb, grid=grid)
+    s, U, Vh = svd_mod.svd(Ad, vectors=True)
+    s = np.asarray(s)
+    np.testing.assert_allclose(
+        s, np.linalg.svd(A0, compute_uv=False), atol=1e-10 * m
+    )
+    Ug = np.asarray(U.to_global())[:, :n]
+    Vhg = np.asarray(Vh.to_global())
+    rec = Ug * s[None, :] @ Vhg
+    err = np.abs(rec - A0).max() / (np.abs(A0).max() * max(m, n))
+    assert err < 1e-12, err
