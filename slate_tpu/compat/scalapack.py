@@ -236,18 +236,23 @@ def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
     _scatter_back(desca, a, np.asarray(LU.to_global()))
     perm = np.asarray(piv.perm)
     if ipiv is not None:
-        # net forward perm -> sequential swap list: at step i the row
-        # now at position i (perm[i]) sits at position pos[perm[i]] of
-        # the partially swapped order; record that 1-based position
+        # net forward perm -> sequential swap list (LAPACK convention:
+        # step i swaps rows i and ipiv[i]-1).  Under these swaps rows
+        # only ever move forward, and a row is evicted from position p
+        # exactly at step p (to the recorded target ipiv[p]), so the
+        # current position of row perm[i] is found by chasing recorded
+        # targets from its home — O(m) total work (each chase hop
+        # consumes one recorded eviction), no O(m) array bookkeeping
+        # per step.
         k = min(len(ipiv), len(perm))
-        cur = np.arange(len(perm))
-        pos = np.arange(len(perm))  # original row -> current position
+        pl = perm.tolist()
+        out = [0] * k
         for i in range(k):
-            j = int(pos[perm[i]])
-            ipiv[i] = j + 1  # 1-based
-            ri, rj = cur[i], cur[j]
-            cur[i], cur[j] = rj, ri
-            pos[ri], pos[rj] = j, i
+            p = pl[i]
+            while p < i:
+                p = out[p]
+            out[i] = p
+        ipiv[:k] = np.asarray(out, dtype=ipiv.dtype) + 1  # 1-based
     return perm, int(info)
 
 

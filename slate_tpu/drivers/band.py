@@ -72,6 +72,12 @@ def _hermitian_band_full(A: HermitianBandMatrix) -> jnp.ndarray:
     return Gk + jnp.conj(Gk).T - diag if A.is_complex else Gk + Gk.T - diag
 
 
+def _band_narrow(kd: int, n: int) -> bool:
+    """Use the O(n kd^2) windowed kernels when the band is genuinely
+    narrow; wide bands lose nothing to the dense schedule."""
+    return kd < n // 4
+
+
 def tbsm(
     side: Side,
     alpha,
@@ -81,7 +87,54 @@ def tbsm(
     opts=None,
 ) -> Matrix:
     """Triangular band solve, optionally applying pivots first
-    (reference: src/tbsm.cc + tbsmPivots.cc)."""
+    (reference: src/tbsm.cc + tbsmPivots.cc).
+
+    Narrow bands run the windowed O(n kd nrhs) substitution
+    (ops/band_kernels.py::band_trsm_lower); effective-upper and
+    right-side cases reduce to it by index reversal / transposition
+    (J U J is lower-band).  Distributed inputs keep the dense SPMD
+    pipeline (no new gathers on the mesh path)."""
+    from ..matrix.base import is_distributed
+
+    kd = A.kd
+    n = A.n
+    eff_lower = (A.uplo == Uplo.Lower) != (A.op != Op.NoTrans)
+    if (
+        not is_distributed(B)
+        and _band_narrow(kd, n)
+        and A.m == A.n
+    ):
+        from ..ops import band_kernels
+
+        B2 = B.to_global()
+        if pivots is not None and pivots.perm.shape[0] > 0:
+            Bp = jnp.pad(B2, ((0, pivots.perm.shape[0] - B2.shape[0]), (0, 0)))
+            B2 = pivots.apply(Bp)[: B.m]
+        T2 = A._with(op=Op.NoTrans).to_global()
+        if A.op == Op.ConjTrans and A.is_complex:
+            E = jnp.conj(T2).T
+        elif A.op != Op.NoTrans:
+            E = T2.T
+        else:
+            E = T2
+        unit = A.diag == Diag.Unit
+        if side == Side.Right:
+            # X op(T) = B  <=>  op(T)^T X^T = B^T
+            E = E.T
+            B2 = B2.T
+            eff_lower = not eff_lower
+        if eff_lower:
+            X = band_kernels.band_trsm_lower(E, B2, kd, unit_diag=unit)
+        else:
+            # J U J is lower band: solve the reversed system
+            X = band_kernels.band_trsm_lower(
+                E[::-1, ::-1], B2[::-1], kd, unit_diag=unit
+            )[::-1]
+        if side == Side.Right:
+            X = X.T
+        out = (alpha * X).astype(B.dtype)
+        return B._with(data=tiles_from_global(out, B.layout))
+
     B2 = B.to_global()
     if pivots is not None and pivots.perm.shape[0] > 0:
         Bp = jnp.pad(B2, ((0, pivots.perm.shape[0] - B2.shape[0]), (0, 0)))
@@ -99,7 +152,36 @@ def gbtrf(
 ) -> Tuple[BandMatrix, Pivots, jnp.ndarray]:
     """Band LU with partial pivoting (reference: src/gbtrf.cc).  Dense-
     stored band: pivot fill-in (up to kl extra superdiagonals) lands in
-    the zero tiles above the band."""
+    the zero tiles above the band.
+
+    Narrow bands run the windowed O(n (kl+w)(kl+ku+w)) kernel
+    (ops/band_kernels.py::band_getrf — the gbtrf.cc in-band panel loop);
+    distributed or wide-band inputs keep the dense getrf schedule."""
+    from ..matrix.base import is_distributed
+
+    if (
+        not is_distributed(A)
+        and A.m == A.n
+        and _band_narrow(A.kl + A.ku, A.n)
+        and A.op == Op.NoTrans
+    ):
+        from ..ops import band_kernels
+
+        G = A.to_global()
+        lu2d, lperms, perm, w = band_kernels.band_getrf(G, A.kl, A.ku)
+        LUb = BandMatrix(
+            tiles_from_global(lu2d.astype(A.dtype), A.layout),
+            A.layout,
+            grid=A.grid,
+            kl=A.kl,
+            ku=min(A.ku + A.kl, A.n - 1),
+        )
+        d = jnp.abs(jnp.diagonal(lu2d))
+        info = jnp.where(
+            jnp.all(jnp.isfinite(lu2d)) & jnp.all(d > 0), 0, 1
+        ).astype(jnp.int32)
+        return LUb, Pivots(perm, band_lperms=lperms, band_w=w), info
+
     Am = Matrix(A.data, A.layout, grid=A.grid)
     LU, piv, info = lu.getrf(Am, opts)
     out = BandMatrix(
@@ -109,7 +191,33 @@ def gbtrf(
 
 
 def gbtrs(LU: BandMatrix, pivots: Pivots, B: Matrix, opts=None) -> Matrix:
-    """(reference: src/gbtrs.cc)"""
+    """(reference: src/gbtrs.cc).
+
+    A windowed-gbtrf factorization (pivots carry band_lperms) MUST be
+    solved by the interleaved-pivot band solve (band_getrs) — the net
+    perm alone does not reproduce it, so this route is taken regardless
+    of B's distribution (a distributed B gathers, recorded as a
+    fallback); fully-swapped dense factorizations go through getrs."""
+    from ..matrix.base import is_distributed
+
+    if pivots is not None and pivots.band_lperms is not None:
+        from ..internal import fallbacks
+        from ..ops import band_kernels
+
+        if is_distributed(B):
+            fallbacks.record(
+                "gbtrs", opts, "windowed band solve gathers distributed B"
+            )
+
+        kl = LU.kl
+        ku_orig = LU.ku - kl  # gbtrf stored ku = original ku + kl
+        G = LU._with(op=Op.NoTrans).to_global()
+        B2 = B.to_global()
+        X = band_kernels.band_getrs(
+            G, pivots.band_lperms, pivots.band_w, kl, ku_orig, B2
+        )
+        return B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
+
     return lu.getrs(Matrix(LU.data, LU.layout, grid=LU.grid), pivots, B, opts)
 
 
@@ -125,7 +233,33 @@ def gbsv(
 def pbtrf(
     A: HermitianBandMatrix, opts: Optional[Options] = None
 ) -> Tuple[TriangularBandMatrix, jnp.ndarray]:
-    """Band Cholesky (reference: src/pbtrf.cc); no fill-in beyond kd."""
+    """Band Cholesky (reference: src/pbtrf.cc); no fill-in beyond kd.
+
+    Narrow bands run the windowed O(n kd^2) kernel
+    (ops/band_kernels.py::band_potrf_lower — the pbtrf.cc loop
+    restricted to the band); distributed or wide-band inputs keep the
+    dense potrf schedule."""
+    from ..matrix.base import is_distributed
+
+    if not is_distributed(A) and _band_narrow(A.kd, A.n):
+        from ..ops import band_kernels
+
+        Af = _hermitian_band_full(A)
+        L2 = band_kernels.band_potrf_lower(Af, A.kd)
+        info = jnp.where(jnp.all(jnp.isfinite(L2)), 0, 1).astype(jnp.int32)
+        if A.uplo == Uplo.Upper:
+            U2 = jnp.conj(L2).T if A.is_complex else L2.T
+            Lb = TriangularBandMatrix(
+                tiles_from_global(U2.astype(A.dtype), A.layout),
+                A.layout, grid=A.grid, kd=A.kd, uplo=Uplo.Upper,
+            )
+        else:
+            Lb = TriangularBandMatrix(
+                tiles_from_global(L2.astype(A.dtype), A.layout),
+                A.layout, grid=A.grid, kd=A.kd, uplo=Uplo.Lower,
+            )
+        return Lb, info
+
     Af = _hermitian_band_full(A)
     Ah = HermitianMatrix.from_global(
         Af, A.layout.mb, A.layout.nb, grid=A.grid, uplo=A.uplo
@@ -138,7 +272,25 @@ def pbtrf(
 
 
 def pbtrs(L: TriangularBandMatrix, B: Matrix, opts=None) -> Matrix:
-    """(reference: src/pbtrs.cc)"""
+    """(reference: src/pbtrs.cc): two windowed band solves on narrow
+    bands, dense trsm sweeps otherwise."""
+    from ..matrix.base import is_distributed
+
+    if not is_distributed(B) and _band_narrow(L.kd, L.n):
+        from ..ops import band_kernels
+
+        G = L._with(op=Op.NoTrans).to_global()
+        B2 = B.to_global()
+        complex_t = L.is_complex
+        if L.uplo == Uplo.Upper:
+            # A = U^H U: L_eff = U^H (lower band)
+            G = jnp.conj(G).T if complex_t else G.T
+        Y = band_kernels.band_trsm_lower(G, B2, L.kd)
+        # L^H solve by index reversal: J L^H J is lower band
+        M = jnp.conj(G[::-1, ::-1]).T if complex_t else G[::-1, ::-1].T
+        X = band_kernels.band_trsm_lower(M, Y[::-1], L.kd)[::-1]
+        return B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
+
     Lt = TriangularMatrix(L.data, L.layout, grid=L.grid, uplo=L.uplo)
     return chol.potrs(Lt, B, opts)
 

@@ -44,10 +44,19 @@ from ..aux.trace import traced
 
 
 from ..matrix.base import is_distributed as _is_distributed
+from ..internal import fallbacks
 
 
 def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
-    """Pack a computed (m, n) global array back into C's layout/grid."""
+    """Pack a computed LOGICAL (m, n) global array back into C's
+    layout/grid.  For op-views the logical dims are the transpose of the
+    storage layout, so the result gets the transposed layout with the op
+    resolved away."""
+    if C.op != Op.NoTrans:
+        lay = C.layout.transposed()
+        return Matrix(
+            tiles_from_global(C_new_2d.astype(C.dtype), lay), lay, grid=C.grid
+        ).shard()
     T = tiles_from_global(C_new_2d.astype(C.dtype), C.layout)
     out = C._with(data=T)
     return out.shard()
@@ -105,6 +114,7 @@ def gemm(
             )
             return C._with(data=data)
         # fall through to global path (GSPMD inserts collectives)
+        fallbacks.record("gemm", opts, "tile-size/grid mismatch")
 
     A2 = A.to_global()
     B2 = B.to_global()
@@ -121,6 +131,8 @@ def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
     out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
     if out is not None:
         return out
+    if _is_distributed(C):
+        fallbacks.record("symm", opts, "shape/grid not spmd-conformable")
     Af = A.full_global()
     B2, C2 = B.to_global(), C.to_global()
     out = (
@@ -141,6 +153,8 @@ def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
     out = _hemm_spmd(side, alpha, A, B, beta, C, opts)
     if out is not None:
         return out
+    if _is_distributed(C):
+        fallbacks.record("hemm", opts, "shape/grid not spmd-conformable")
     Af = A.full_global()
     B2, C2 = B.to_global(), C.to_global()
     out = (
@@ -201,50 +215,52 @@ def _hemm_spmd(side, alpha, A, B, beta, C, opts):
 
 
 def _herk_like_spmd(alpha, A, beta, C, conj: bool, rank2=False, B=None):
-    """Distributed rank-k update over the mesh: the SUMMA pipeline on
-    full tiles, writing back only C's stored triangle (the reference's
-    internal::herk is a masked batched gemm the same way,
-    internal_herk.cc).  Returns None if tile shapes don't conform."""
-    from ..matrix.base import conj_transpose as _ct, transpose as _tr
+    """Distributed rank-k update over the mesh via the direct panel-gather
+    kernel (parallel/spmd_blas.py::spmd_herk — the reference's
+    internal::herk batched symmetric update, internal_herk.cc).
 
+    No transposed operand is resolved (a materialized A^H lives on the
+    transposed process grid, which breaks p != q meshes) and C's stored
+    triangle needs no global mirror.  Returns None if shapes/ops don't
+    conform (the caller records the fallback)."""
     if C.op != Op.NoTrans:
         return None
-    Ar = A.resolved()
-    Ah = (_ct(A) if conj else _tr(A)).resolved()
-    lay, layC = Ar.layout, C.layout
+    # supported op(A) combos: NoTrans; ConjTrans with herk (A^H A);
+    # Trans with syrk (A^T A).  Mixed conj/op views fall back.
+    if A.op == Op.NoTrans:
+        trans = False
+    elif (A.op == Op.ConjTrans and conj) or (A.op == Op.Trans and not conj):
+        trans = True
+    else:
+        return None
+    lay = A.layout  # storage layout (op applies logically only)
+    layC = C.layout
+    kb = lay.mb if trans else lay.nb
+    nt_match = (lay.nt if trans else lay.mt) == layC.mt
     if not (
-        lay.mb == layC.mb
-        and lay.mb == layC.nb
+        nt_match
+        and (lay.nb if trans else lay.mb) == layC.mb
+        and layC.mb == layC.nb
         and (lay.p, lay.q) == (layC.p, layC.q)
-        and (Ah.layout.p, Ah.layout.q) == (layC.p, layC.q)
     ):
         return None
+    TB = layB = None
     if rank2:
-        layB = B.resolved().layout
+        if B.op != A.op:
+            return None
+        layB = B.layout
         if not (
             layB.mb == lay.mb
             and layB.nb == lay.nb
             and (layB.p, layB.q) == (layC.p, layC.q)
         ):
             return None
-    Tfull = tiles_from_global(C.full_global().astype(C.dtype), layC)
-    if rank2:
-        # C = alpha A op(B) + alpha2 B op(A) + beta C
-        Br = B.resolved()
-        Bh = (_ct(B) if conj else _tr(B)).resolved()
-        a2 = jnp.conj(alpha) if (conj and C.is_complex) else alpha
-        out = spmd_blas.summa_gemm(
-            C.grid, alpha, Ar.data, Ar.layout, Bh.data, Bh.layout,
-            beta, Tfull, layC,
-        )
-        out = spmd_blas.summa_gemm(
-            C.grid, a2, Br.data, Br.layout, Ah.data, Ah.layout, 1.0, out, layC
-        )
-    else:
-        out = spmd_blas.summa_gemm(
-            C.grid, alpha, Ar.data, Ar.layout, Ah.data, Ah.layout,
-            beta, Tfull, layC,
-        )
+        TB = B.data
+    a2 = jnp.conj(alpha) if (conj and C.is_complex) else alpha
+    out = spmd_blas.spmd_herk(
+        C.grid, alpha, A.data, lay, beta, C.data, layC,
+        conj=conj, trans=trans, alpha2=a2, TB=TB, layB=layB,
+    )
     return C._with(data=out)
 
 
@@ -254,6 +270,9 @@ def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None, opts=None):
         spmd = _herk_like_spmd(alpha, A, beta, C, conj, rank2, B)
         if spmd is not None:
             return spmd
+        fallbacks.record(
+            "her2k" if rank2 else "herk", opts, "shape/grid not conformable"
+        )
     k_dim = A.n
     A2 = A.to_global()
     C2 = C.full_global()
@@ -314,21 +333,60 @@ def _resolve_tri(A: TriangularMatrix):
     ), op
 
 
+def _trmm_spmd_ok(side: Side, A: TriangularMatrix, B: Matrix) -> bool:
+    layA, layB = A.layout, B.layout
+    bdim_b, bt = (layB.mb, layB.mt) if side == Side.Left else (layB.nb, layB.nt)
+    return (
+        layA.m == layA.n
+        and layA.mb == layA.nb == bdim_b
+        and layA.nt == bt
+        and (layA.p, layA.q) == (layB.p, layB.q)
+        and B.op == Op.NoTrans
+    )
+
+
 @accurate_matmul
 def trmm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
-    """B = alpha op(A) B or alpha B op(A) (reference: src/trmm.cc)."""
+    """B = alpha op(A) B or alpha B op(A) (reference: src/trmm.cc ->
+    work::trmm pipeline, src/work/work_trmm.cc).
+
+    Distributed: the triangular SUMMA in parallel/spmd_blas.py::spmd_trmm
+    — panel gathers of the masked triangle, psum broadcasts of B's block
+    row/column, no gather of A or B."""
+    if (
+        _is_distributed(B)
+        and get_option(opts, Option.UseShardMap)
+        and _trmm_spmd_ok(side, A, B)
+    ):
+        data = spmd_blas.spmd_trmm(
+            B.grid,
+            side == Side.Left,
+            alpha,
+            A.data,
+            A.layout,
+            lower=A.uplo == Uplo.Lower,
+            unit_diag=A.diag == Diag.Unit,
+            opa_trans=A.op != Op.NoTrans,
+            opa_conj=A.op == Op.ConjTrans,
+            TB=B.data,
+            layB=B.layout,
+        )
+        return B._with(data=data)
+    if _is_distributed(B):
+        fallbacks.record("trmm", opts, "shape/grid/view not spmd-conformable")
     A2 = A._with(op=Op.NoTrans).to_global()
     out = blas2d.trmm2d(side, A.uplo, A.op, A.diag, alpha, A2, B.to_global())
     return _repack_like(out, B)
 
 
-def _trsm_spmd_ok(A: TriangularMatrix, B: Matrix) -> bool:
+def _trsm_spmd_ok(side: Side, A: TriangularMatrix, B: Matrix) -> bool:
     layT, layB = A.layout, B.layout
+    bdim_b, bt = (layB.mb, layB.mt) if side == Side.Left else (layB.nb, layB.nt)
     return (
         layT.m == layT.n
-        and layT.mb == layT.nb == layB.mb
+        and layT.mb == layT.nb == bdim_b
         and (layT.p, layT.q) == (layB.p, layB.q)
-        and layT.nt == layB.mt
+        and layT.nt == bt
         and B.op == Op.NoTrans
     )
 
@@ -340,17 +398,22 @@ def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix
 
     Global path: one XLA triangular_solve (internally blocked/pipelined by
     XLA — the work_trsm row pipeline is the compiler's job on TPU).
-    SPMD path (left side, distributed): the shard_map row pipeline in
-    parallel/spmd_trsm.py — no gather of A or B to a global array.
+    SPMD paths (distributed): the shard_map row pipeline (left side) or
+    its column-pipeline dual (right side) in parallel/spmd_trsm.py — no
+    gather of A or B to a global array.
     """
     if (
-        side == Side.Left
-        and _is_distributed(B)
+        _is_distributed(B)
         and get_option(opts, Option.UseShardMap)
-        and _trsm_spmd_ok(A, B)
+        and _trsm_spmd_ok(side, A, B)
     ):
         TT = eye_splice(A.layout, A.data)
-        data = spmd_trsm.spmd_trsm_left(
+        fn = (
+            spmd_trsm.spmd_trsm_left
+            if side == Side.Left
+            else spmd_trsm.spmd_trsm_right
+        )
+        data = fn(
             B.grid,
             TT,
             A.layout,
@@ -363,6 +426,12 @@ def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix
             alpha=alpha,
         )
         return B._with(data=data)
+    if _is_distributed(B):
+        fallbacks.record(
+            "trsm",
+            opts,
+            "right side / transposed B / non-conformable tiles",
+        )
     A2 = A._with(op=Op.NoTrans).to_global()
     out = blas2d.trsm2d(side, A.uplo, A.op, A.diag, alpha, A2, B.to_global())
     return _repack_like(out, B)

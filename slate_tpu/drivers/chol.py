@@ -33,6 +33,7 @@ from ..aux.trace import traced
 
 
 from ..matrix.base import is_distributed as _is_distributed
+from ..internal import fallbacks
 
 
 def _hermitian_full_tiles(A: HermitianMatrix) -> jnp.ndarray:
@@ -56,11 +57,21 @@ def potrf(
 
     use_spmd = _is_distributed(A) and get_option(opts, Option.UseShardMap)
     if use_spmd:
-        T = _hermitian_full_tiles(A)
+        if A.uplo == Uplo.Lower and A.op == Op.NoTrans:
+            # spmd_potrf_lower reads only the stored lower triangle —
+            # no mirror round trip needed
+            T = A.data
+        else:
+            fallbacks.record(
+                "potrf.mirror", opts, "upper/viewed Hermitian mirrors globally"
+            )
+            T = _hermitian_full_tiles(A)
         T = eye_splice(A.layout, T)
         Ld = spmd_chol.spmd_potrf_lower(A.grid, T, A.layout)
         L = TriangularMatrix(Ld, A.layout, grid=A.grid, uplo=Uplo.Lower)
     else:
+        if _is_distributed(A):
+            fallbacks.record("potrf", opts, "UseShardMap disabled")
         full = A.full_global()
         n = A.n
         lay = A.layout

@@ -86,6 +86,12 @@ def he2hb(
         )
         return band, Matrix(V_t, lay, grid=A.grid), TriangularFactors(Tstack)
 
+    if _is_distributed(A):
+        from ..internal import fallbacks
+
+        fallbacks.record(
+            "he2hb", opts, "upper uplo / viewed / non-square tiles gather"
+        )
     G = A.full_global()
     kt = lay.nt
     complex_t = A.is_complex
@@ -198,6 +204,8 @@ def unmtr_he2hb(
         )
         return C_mat._with(data=Ct)
 
+    from jax import lax
+
     Vg = V.to_global()
     C2 = C_mat.to_global()
     complex_t = V.is_complex
@@ -206,20 +214,28 @@ def unmtr_he2hb(
         return jnp.conj(x) if complex_t else x
 
     npanels = T.T.shape[0]
+    if npanels == 0:
+        return C_mat
     forward = (side == Side.Left) == (op != Op.NoTrans)
-    order = range(npanels) if forward else range(npanels - 1, -1, -1)
-    for k in order:
-        lo = (k + 1) * nb
-        w = min(nb, n - k * nb)
-        Vk = Vg[lo:, k * nb : k * nb + w]
-        Tk = T.T[k][:w, :w]
+    # one traced body under lax.fori_loop (compile time flat in the panel
+    # count — the same static-shape batching as he2hb itself): V_k is the
+    # full-height column block, zero above row (k+1) nb, so the masked
+    # slice updates collapse into full-size matmuls.
+    Vp = jnp.pad(Vg, ((0, 0), (0, max(kt * nb - Vg.shape[1], 0))))
+    Ts = T.T
+
+    def step(i, C2):
+        k = i if forward else npanels - 1 - i
+        Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
+        Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
         Tm = CC(Tk).T if op != Op.NoTrans else Tk
         if side == Side.Left:
-            W = CC(Vk).T @ C2[lo:]
-            C2 = C2.at[lo:].set(C2[lo:] - Vk @ (Tm @ W))
-        else:
-            W = C2[:, lo:] @ Vk
-            C2 = C2.at[:, lo:].set(C2[:, lo:] - (W @ Tm) @ CC(Vk).T)
+            W = CC(Vk).T @ C2
+            return C2 - Vk @ (Tm @ W)
+        W = C2 @ Vk
+        return C2 - (W @ Tm) @ CC(Vk).T
+
+    C2 = lax.fori_loop(0, npanels, step, C2)
     return C_mat._with(data=tiles_from_global(C2.astype(C_mat.dtype), C_mat.layout))
 
 

@@ -35,6 +35,7 @@ from ..aux.trace import traced
 
 
 from ..matrix.base import is_distributed as _is_distributed
+from ..internal import fallbacks
 
 
 def _padded_global(A: BaseMatrix, splice_diag=True) -> jnp.ndarray:
@@ -99,9 +100,7 @@ def getrf(
         # tournament pivoting (reference: getrf_tntpiv.cc; BEAM maps to
         # the tournament too — both trade the per-column pivot search for
         # a communication-free reduction, the fit for static schedules)
-        if _is_distributed(A) and opts and Option.UseShardMap in dict(opts):
-            # warn only on an EXPLICIT UseShardMap request (it defaults
-            # to True, and default-configured runs should stay quiet)
+        if _is_distributed(A):
             import warnings
 
             warnings.warn(
@@ -110,6 +109,7 @@ def getrf(
                 "the UseShardMap option is ignored on this path",
                 stacklevel=2,
             )
+            fallbacks.record("getrf_tntpiv", opts, "tournament gathers")
         Gp = _padded_global(A)
         lu2d, perm = lu_kernels.blocked_getrf_tntpiv(Gp, lay.nb)
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
@@ -121,6 +121,8 @@ def getrf(
         LU = A._with(data=Td)
         m_valid = lay.m
     else:
+        if _is_distributed(A):
+            fallbacks.record("getrf", opts, "non-square tiles")
         Gp = _padded_global(A)
         # vendor LU when the backend supports the dtype (TPU: f32/c64
         # only), else the native blocked right-looking kernel
@@ -244,6 +246,8 @@ def getrs(
             lower=False, trans=False, conj=False, unit_diag=False,
         )
         return B._with(data=X)
+    if _is_distributed(B):
+        fallbacks.record("getrs", opts, "layout/view not spmd-conformable")
     G = LU.to_global()
     B2 = B.to_global()
     if pivots is not None:

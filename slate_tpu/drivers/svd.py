@@ -78,6 +78,10 @@ def ge2tb(
             TriangularFactors(VT),
         )
 
+    if _is_distributed(A):
+        from ..internal import fallbacks
+
+        fallbacks.record("ge2tb", opts, "viewed / non-square tiles gather")
     G = A.to_global()
     kt = min(lay.mt, lay.nt)
     complex_t = A.is_complex
@@ -371,6 +375,8 @@ def unmbr_ge2tb_left(
         )
         return Cm._with(data=Ct)
 
+    from jax import lax
+
     UVg = UVm.to_global()
     complex_t = UVm.is_complex
 
@@ -379,13 +385,23 @@ def unmbr_ge2tb_left(
 
     npanels = UT.T.shape[0]
     out = jnp.asarray(C2)
-    for k in range(npanels - 1, -1, -1):
-        lo = k * nb
-        w = min(nb, UVg.shape[1] - lo)
-        Vk = UVg[lo:, lo : lo + w]
-        Tk = UT.T[k][:w, :w]
-        W = C(Vk).T @ out[lo:]
-        out = out.at[lo:].set(out[lo:] - Vk @ (Tk @ W))
+    if npanels == 0:
+        return Matrix.from_global(out.astype(A.dtype), lay.mb, lay.nb, grid=A.grid)
+    # static-shape fori_loop over panels (compile time flat in panel
+    # count): V_k is zero above row k nb and zero in absent columns, and
+    # absent reflectors have zero T rows/cols, so full-width applies are
+    # exact no-ops there.
+    Vp = jnp.pad(UVg, ((0, 0), (0, max(npanels * nb - UVg.shape[1], 0))))
+    Ts = UT.T
+
+    def step(i, out):
+        k = npanels - 1 - i
+        Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
+        Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
+        W = C(Vk).T @ out
+        return out - Vk @ (Tk @ W)
+
+    out = lax.fori_loop(0, npanels, step, out)
     return Matrix.from_global(out.astype(A.dtype), lay.mb, lay.nb, grid=A.grid)
 
 
@@ -420,6 +436,8 @@ def unmbr_ge2tb_right(
         )
         return Cm._with(data=Ct)
 
+    from jax import lax
+
     VVg = VVm.to_global()
     complex_t = VVm.is_complex
 
@@ -428,15 +446,21 @@ def unmbr_ge2tb_right(
 
     npanels = VT.T.shape[0]
     out = jnp.asarray(C2)
-    for k in range(npanels - 1, -1, -1):
-        lo = k * nb
-        co = lo + nb  # columns the k-th LQ panel acts on
-        if co >= VVg.shape[0]:
-            continue
-        w = min(nb, VVg.shape[1] - lo)
-        Vk = VVg[co:, lo : lo + w]  # zero-padded columns are no-ops
-        Tk = VT.T[k][:w, :w]
-        # out <- out Qr_k^H = out (I - Vk Tk^H Vk^H), acting on columns co:
-        Wb = out[:, co:] @ Vk
-        out = out.at[:, co:].set(out[:, co:] - (Wb @ C(Tk).T) @ C(Vk).T)
+    if npanels == 0:
+        return Matrix.from_global(out.astype(A.dtype), lay.mb, lay.nb, grid=A.grid)
+    # static-shape fori_loop (see unmbr_ge2tb_left): V_k is zero above
+    # row (k+1) nb and in absent columns, absent reflectors have zero T
+    # rows/cols, so the full-width apply is exact.
+    Vp = jnp.pad(VVg, ((0, 0), (0, max(npanels * nb - VVg.shape[1], 0))))
+    Ts = VT.T
+
+    def step(i, out):
+        k = npanels - 1 - i
+        Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
+        Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
+        # out <- out Qr_k^H = out (I - Vk Tk^H Vk^H)
+        Wb = out @ Vk
+        return out - (Wb @ C(Tk).T) @ C(Vk).T
+
+    out = lax.fori_loop(0, npanels, step, out)
     return Matrix.from_global(out.astype(A.dtype), lay.mb, lay.nb, grid=A.grid)

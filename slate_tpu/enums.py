@@ -263,6 +263,7 @@ class Option(enum.Enum):
     # slate_tpu extensions
     MaxUnrolledTiles = "max_unrolled_tiles"  # unroll k-loop below this nt
     UseShardMap = "use_shard_map"  # explicit SPMD fast path vs GSPMD
+    RequireSpmd = "require_spmd"  # error instead of gathered fallback
 
 
 # Marker constants kept for API parity (reference: enums.hh:531-534).

@@ -1,0 +1,56 @@
+"""Gather-fallback accounting (reference behavior: SLATE either runs the
+distributed algorithm or fails loudly — it never silently gathers a
+distributed matrix to one rank; cf. the redistribution asserts in
+src/work/work_trsm.cc and the MPI-collective structure of every driver).
+
+On TPU the gathered-global path is always *available* (GSPMD will insert
+collectives), which makes accidental scaling cliffs easy to ship: a
+distributed input quietly round-trips through one device's memory.  Every
+driver route that abandons the explicit SPMD path for a gathered-global
+evaluation on a distributed operand calls :func:`record`:
+
+* by default the fallback is tallied in a process-wide counter
+  (:func:`counters`), so tests and the multichip dryrun can assert
+  gather-freedom;
+* with ``Option.RequireSpmd`` the record raises ``DistributedException``
+  instead — the SLATE-style fail-loud contract.
+
+Accounting is TRACE-TIME: it reflects the routing decision taken while
+the driver Python executed (eagerly, or during a jit trace).  A cached
+jitted executable re-runs whatever route was traced without touching
+the counters — so assert gather-freedom on a fresh trace (as
+__graft_entry__.dryrun_multichip does), not after warm cache replays.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Optional
+
+_COUNTS: Counter = Counter()
+
+
+def record(route: str, opts=None, detail: str = "") -> None:
+    """Note that `route` fell back to a gathered global evaluation for a
+    distributed operand; raise if the caller demanded SPMD execution."""
+    from ..enums import Option
+    from ..options import get_option
+
+    _COUNTS[route] += 1
+    if get_option(opts, Option.RequireSpmd, False):
+        from ..exceptions import DistributedException
+
+        raise DistributedException(
+            f"Option.RequireSpmd: '{route}' would gather a distributed "
+            "matrix to a global array"
+            + (f" ({detail})" if detail else "")
+        )
+
+
+def counters() -> dict:
+    """Snapshot of fallback tallies since the last reset()."""
+    return dict(_COUNTS)
+
+
+def reset() -> None:
+    _COUNTS.clear()

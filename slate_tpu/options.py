@@ -35,6 +35,7 @@ _DEFAULTS = {
     Option.PrintPrecision: 4,
     Option.MaxUnrolledTiles: 256,
     Option.UseShardMap: True,
+    Option.RequireSpmd: False,
 }
 
 

@@ -177,3 +177,252 @@ def gemm_reduce_a(
     spec = P(ROW_AXIS, COL_AXIS)
     fn = shard_map(local, mesh=grid.mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(TA, TB, TC)
+
+
+def spmd_herk(
+    grid: ProcessGrid,
+    alpha,
+    TA: jnp.ndarray,
+    layA: TileLayout,
+    beta,
+    TC: jnp.ndarray,
+    layC: TileLayout,
+    conj: bool,
+    trans: bool,
+    alpha2=None,
+    TB: jnp.ndarray = None,
+    layB: TileLayout = None,
+) -> jnp.ndarray:
+    """Rank-k update C = alpha op(A) op(A)^(H|T) + beta C directly from
+    A's stored tiles (reference: src/herk.cc + internal_herk.cc's batched
+    symmetric update).
+
+    Unlike routing through summa_gemm, no transposed copy of A is ever
+    materialized (a resolved A^H lives on the TRANSPOSED process grid —
+    unusable for p != q meshes) and C needs no Hermitian mirror: per step
+    k the full tile column (trans=False) or tile row (trans=True) of A is
+    rebuilt on every process by two all_gathers, and each local C tile
+    takes its update from the two gathered panels.  With TB given this is
+    the rank-2k her2k/syr2k: alpha A B^H + alpha2 B A^H + beta C.
+
+    Both triangles of every local C tile are written (the Hermitian
+    wrapper references one), so the update does 2x the minimal triangle
+    FLOPs — the same redundancy internal::herk avoids by touching only
+    stored tiles; acceptable until a triangle-aware schedule lands.
+    """
+    p, q = grid.p, grid.q
+    kt_total = layA.mt if trans else layA.nt
+    mtl, ntl = layC.mtl, layC.ntl
+    rank2 = TB is not None
+    acc_t = _acc_dtype(TC.dtype)
+    complex_t = jnp.issubdtype(TC.dtype, jnp.complexfloating)
+    row_scatter = jnp.asarray(layA.row_scatter)
+    col_scatter = jnp.asarray(layA.col_scatter)
+
+    def cj(x):
+        return jnp.conj(x) if (conj and complex_t) else x
+
+    def local(ta, tc, *tbs):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtl) * p + r
+        gj = jnp.arange(ntl) * q + c
+
+        def gather_col(t, k):
+            # tile column k in NATURAL tile-row order: (layA.P, mb, kb)
+            loc = lax.dynamic_slice_in_dim(t, k // q, 1, axis=1)[:, 0]
+            aq = lax.all_gather(loc, COL_AXIS)
+            rows = lax.dynamic_index_in_dim(aq, k % q, 0, keepdims=False)
+            full = lax.all_gather(rows, ROW_AXIS)
+            return full.reshape((layA.P,) + full.shape[2:])[row_scatter]
+
+        def gather_row(t, k):
+            # tile row k in NATURAL tile-col order: (layA.Q, kb, nb)
+            loc = lax.dynamic_slice_in_dim(t, k // p, 1, axis=0)[0]
+            ap = lax.all_gather(loc, ROW_AXIS)
+            cols = lax.dynamic_index_in_dim(ap, k % p, 0, keepdims=False)
+            full = lax.all_gather(cols, COL_AXIS)
+            return full.reshape((layA.Q,) + full.shape[2:])[col_scatter]
+
+        def panels(k):
+            if trans:
+                pa = gather_row(ta, k)
+                pb = gather_row(tbs[0], k) if rank2 else pa
+            else:
+                pa = gather_col(ta, k)
+                pb = gather_col(tbs[0], k) if rank2 else pa
+            return pa, pb
+
+        def tile_upd(pl, pr):
+            # C_ij += op(L)_i,k op(R)_j,k^(H|T) for local (i, j)
+            if trans:
+                # op(M)_{i,k} = M_{k,i}^(H|T): contraction over panel rows
+                return jnp.einsum(
+                    "ica,jcb->ijab", cj(pl[gi]), pr[gj],
+                    preferred_element_type=acc_t,
+                )
+            return jnp.einsum(
+                "iak,jbk->ijab", pl[gi], cj(pr[gj]),
+                preferred_element_type=acc_t,
+            )
+
+        def apply(acc, pa, pb):
+            if rank2:
+                return acc + alpha * tile_upd(pa, pb) + alpha2 * tile_upd(pb, pa)
+            return acc + alpha * tile_upd(pa, pa)
+
+        def step(k, carry):
+            acc, (pa, pb) = carry
+            nxt = panels(k + 1)  # lookahead: gather before the einsum
+            return apply(acc, pa, pb), nxt
+
+        acc = jnp.zeros(tc.shape, acc_t)
+        if kt_total > 0:
+            # loop stops one short so the lookahead never gathers an
+            # out-of-range panel; the last panel applies after the loop
+            acc, (pa, pb) = lax.fori_loop(
+                0, kt_total - 1, step, (acc, panels(0))
+            )
+            acc = apply(acc, pa, pb)
+        out = acc + beta * tc.astype(acc_t)
+        return out.astype(tc.dtype)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    args = (TA, TC) + ((TB,) if rank2 else ())
+    fn = shard_map(
+        local,
+        mesh=grid.mesh,
+        in_specs=(spec,) * len(args),
+        out_specs=spec,
+    )
+    return fn(*args)
+
+
+def spmd_trmm(
+    grid: ProcessGrid,
+    side_left: bool,
+    alpha,
+    TA: jnp.ndarray,
+    layA: TileLayout,
+    lower: bool,
+    unit_diag: bool,
+    opa_trans: bool,
+    opa_conj: bool,
+    TB: jnp.ndarray,
+    layB: TileLayout,
+) -> jnp.ndarray:
+    """Triangular multiply B <- alpha op(A) B (side_left) or
+    alpha B op(A) over the mesh (reference: src/trmm.cc ->
+    work::trmm's in-place pipeline, src/work/work_trmm.cc).
+
+    Being functional, there is no in-place aliasing hazard to pipeline
+    around: per step k the needed panel of op(A) is rebuilt (masked to
+    the referenced triangle elementwise, honoring Diag::Unit) and B's
+    block row/column k is psum-broadcast from its owner — a SUMMA over
+    a triangular operand.  `lower`/`unit_diag` describe A's STORAGE
+    triangle; `opa_trans`/`opa_conj` the view being multiplied.
+    """
+    p, q = grid.p, grid.q
+    assert layA.m == layA.n and layA.mb == layA.nb
+    mb = layA.mb
+    nt = layA.nt
+    n = layA.n
+    mtlA, ntlA = layA.mtl, layA.ntl
+    mtlB, ntlB = layB.mtl, layB.ntl
+    acc_t = _acc_dtype(TB.dtype)
+    complex_t = jnp.issubdtype(TB.dtype, jnp.complexfloating)
+    row_scatter = jnp.asarray(layA.row_scatter)
+    col_scatter = jnp.asarray(layA.col_scatter)
+
+    def cjA(x):
+        return jnp.conj(x) if (opa_conj and complex_t) else x
+
+    def tri_mask_panel(pan, k, panel_is_col):
+        """Mask gathered panel tiles to A's stored triangle (elementwise,
+        with Diag::Unit substitution and padding zeroed)."""
+        t = jnp.arange(pan.shape[0])
+        a = jnp.arange(mb)
+        if panel_is_col:  # pan[t] = A(t, k): rows t*mb+a, cols k*mb+b
+            gr = (t[:, None, None] * mb + a[:, None])
+            gc = (k * mb + a)[None, None, :]
+        else:  # pan[t] = A(k, t): rows k*mb+a, cols t*mb+b
+            gr = (k * mb + a)[None, :, None]
+            gc = (t[:, None, None] * mb + a[None, None, :])
+        keep = (gr >= gc) if lower else (gr <= gc)
+        if unit_diag:
+            keep = keep & (gr != gc)
+        keep = keep & (gr < n) & (gc < n)
+        out = jnp.where(keep, pan, jnp.zeros_like(pan))
+        if unit_diag:
+            out = out + jnp.where(
+                (gr == gc) & (gr < n),
+                jnp.ones_like(pan),
+                jnp.zeros_like(pan),
+            )
+        return out
+
+    def local(ta, tb):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtlB) * p + r
+        gj = jnp.arange(ntlB) * q + c
+
+        def gather_colA(k):
+            loc = lax.dynamic_slice_in_dim(ta, k // q, 1, axis=1)[:, 0]
+            aq = lax.all_gather(loc, COL_AXIS)
+            rows = lax.dynamic_index_in_dim(aq, k % q, 0, keepdims=False)
+            full = lax.all_gather(rows, ROW_AXIS)
+            return full.reshape(p * mtlA, mb, mb)[row_scatter]
+
+        def gather_rowA(k):
+            loc = lax.dynamic_slice_in_dim(ta, k // p, 1, axis=0)[0]
+            ap = lax.all_gather(loc, ROW_AXIS)
+            cols = lax.dynamic_index_in_dim(ap, k % p, 0, keepdims=False)
+            full = lax.all_gather(cols, COL_AXIS)
+            return full.reshape(q * ntlA, mb, mb)[col_scatter]
+
+        def opA_col(k):
+            """op(A)'s tile column k, natural order, triangle-masked."""
+            if not opa_trans:
+                return cjA(tri_mask_panel(gather_colA(k), k, True))
+            pan = tri_mask_panel(gather_rowA(k), k, False)  # A(k, t)
+            return cjA(jnp.swapaxes(pan, -1, -2))
+
+        def opA_row(k):
+            """op(A)'s tile row k, natural order, triangle-masked."""
+            if not opa_trans:
+                return cjA(tri_mask_panel(gather_rowA(k), k, False))
+            pan = tri_mask_panel(gather_colA(k), k, True)  # A(t, k)
+            return cjA(jnp.swapaxes(pan, -1, -2))
+
+        def step(k, acc):
+            if side_left:
+                # acc(i, :) += op(A)(gi, k) B(k, :)
+                pan = opA_col(k)[gi]
+                b_row = lax.dynamic_index_in_dim(tb, k // p, 0, keepdims=False)
+                own = r == (k % p)
+                b_row = lax.psum(
+                    jnp.where(own, b_row, jnp.zeros_like(b_row)), ROW_AXIS
+                )
+                upd = jnp.einsum(
+                    "iab,jbc->ijac", pan, b_row, preferred_element_type=acc_t
+                )
+            else:
+                # acc(:, j) += B(:, k) op(A)(k, gj)
+                pan = opA_row(k)[gj]
+                b_col = lax.dynamic_slice_in_dim(tb, k // q, 1, axis=1)[:, 0]
+                own = c == (k % q)
+                b_col = lax.psum(
+                    jnp.where(own, b_col, jnp.zeros_like(b_col)), COL_AXIS
+                )
+                upd = jnp.einsum(
+                    "iab,jbc->ijac", b_col, pan, preferred_element_type=acc_t
+                )
+            return acc + upd
+
+        acc = lax.fori_loop(0, nt, step, jnp.zeros(tb.shape, acc_t))
+        return (alpha * acc).astype(tb.dtype)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(local, mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(TA, TB)

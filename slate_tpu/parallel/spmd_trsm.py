@@ -192,3 +192,121 @@ def spmd_permute_rows(
         local, mesh=grid.mesh, in_specs=(spec, P()), out_specs=spec
     )
     return fn(TB, perm.astype(jnp.int32))
+
+
+def spmd_trsm_right(
+    grid: ProcessGrid,
+    TT: jnp.ndarray,
+    layT: TileLayout,
+    TB: jnp.ndarray,
+    layB: TileLayout,
+    *,
+    lower: bool,
+    trans: bool,
+    conj: bool,
+    unit_diag: bool,
+    alpha=1.0,
+) -> jnp.ndarray:
+    """Solve X op(T) = alpha B in place of B's tile array — the
+    column-pipeline dual of spmd_trsm_left (reference: trsmB's right-side
+    work pipeline, src/work/work_trsm.cc): per step the solved block
+    COLUMN is broadcast along 'q' and the trailing update runs over the
+    not-yet-solved local columns.
+    """
+    p, q = grid.p, grid.q
+    assert layT.m == layT.n and layT.mb == layT.nb, "trsm T must be square tiles"
+    assert layT.mb == layB.nb, "T/B tile-col mismatch"
+    assert (layT.p, layT.q) == (layB.p, layB.q) == (p, q), "grid mismatch"
+    nt = layT.nt
+    assert layB.nt == nt, "T/B tile-count mismatch"
+    mtlT, ntlT = layT.mtl, layT.ntl
+    ntlB = layB.ntl
+    mb = layT.mb
+    eff_lower = lower != trans  # triangle of op(T)
+    forward = not eff_lower  # X U = B solves column 0 first
+    complex_t = jnp.issubdtype(TT.dtype, jnp.complexfloating)
+    do_conj = conj and complex_t
+
+    def local(tt, tb):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gj = jnp.arange(ntlB) * q + c  # global tile cols of local B cols
+
+        tb = (jnp.asarray(alpha, tb.dtype) * tb) if alpha != 1.0 else tb
+
+        def step(kk, tb):
+            k = kk if forward else nt - 1 - kk
+
+            # -- 1. tiles op(T)(k, gj) for local cols + replicated diag ---
+            if not trans:
+                # T's tile row k: owner process row k % p, columns already
+                # distributed the way B's are -> psum-broadcast down 'p'
+                row_loc = lax.dynamic_index_in_dim(tt, k // p, 0, keepdims=False)
+                own_row_T = r == (k % p)
+                right_tiles = lax.psum(
+                    jnp.where(own_row_T, row_loc, jnp.zeros_like(row_loc)),
+                    ROW_AXIS,
+                )  # (ntlT, mb, mb) = T(k, gj)
+                dcand = lax.dynamic_index_in_dim(
+                    right_tiles, k // q, 0, keepdims=False
+                )
+                own_diag = c == (k % q)
+                Tkk = lax.psum(
+                    jnp.where(own_diag, dcand, jnp.zeros_like(dcand)), COL_AXIS
+                )
+                if do_conj:
+                    right_tiles = jnp.conj(right_tiles)
+                    Tkk = jnp.conj(Tkk)
+            else:
+                # op(T)(k, gj) = T(gj, k)^T: T's tile column k, owner
+                # process col k % q -> psum-broadcast along 'q', then
+                # select the slots of this process's gj and transpose
+                col_loc = lax.dynamic_slice_in_dim(tt, k // q, 1, axis=1)[:, 0]
+                own_col_T = c == (k % q)
+                col_bc = lax.psum(
+                    jnp.where(own_col_T, col_loc, jnp.zeros_like(col_loc)),
+                    COL_AXIS,
+                )  # (mtlT, mb, mb) local storage rows of T(:, k)
+                col_full = lax.all_gather(col_bc, ROW_AXIS).reshape(
+                    p * mtlT, mb, mb
+                )  # replicated T(:, k) in storage-row order
+                slots = (gj % p) * mtlT + gj // p
+                sel = col_full[slots]  # T(gj, k)
+                right_tiles = jnp.swapaxes(sel, -1, -2)
+                dslot = (k % p) * mtlT + k // p
+                Tkk = jnp.swapaxes(col_full[dslot], -1, -2)
+                if do_conj:
+                    right_tiles = jnp.conj(right_tiles)
+                    Tkk = jnp.conj(Tkk)
+
+            # -- 2. solve block column k on its owner process column ------
+            col_tiles = lax.dynamic_slice_in_dim(tb, k // q, 1, axis=1)[:, 0]
+            X_col = lax.linalg.triangular_solve(
+                jnp.broadcast_to(Tkk, col_tiles.shape[:1] + Tkk.shape),
+                col_tiles,
+                left_side=False,
+                lower=eff_lower,
+                unit_diagonal=unit_diag,
+            )
+            own_col = c == (k % q)
+            X_col = lax.psum(
+                jnp.where(own_col, X_col, jnp.zeros_like(X_col)), COL_AXIS
+            )
+            new_col = jnp.where(own_col, X_col, col_tiles)
+            tb = lax.dynamic_update_slice_in_dim(
+                tb, new_col[:, None], k // q, axis=1
+            )
+
+            # -- 3. trailing update over not-yet-solved local columns -----
+            mask_j = (gj > k) if forward else (gj < k)
+            right_act = jnp.where(
+                mask_j[:, None, None], right_tiles, jnp.zeros_like(right_tiles)
+            )
+            upd = jnp.einsum("iab,jbc->ijac", X_col, right_act)
+            return tb - upd.astype(tb.dtype)
+
+        return lax.fori_loop(0, nt, step, tb)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(local, mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(TT, TB)

@@ -23,16 +23,26 @@ class Pivots:
     Length covers the padded row space; rows >= m map to themselves.
     Reference analogue: Pivots = vector<vector<Pivot>> (types.hh:117),
     applied by internal::permuteRows (internal_swap.cc).
+
+    Band factorizations (windowed gbtrf) additionally carry the
+    per-window local pivot orders (``band_lperms``, (steps, W1) int32)
+    and the window step ``band_w``: their LU stores LAPACK-style
+    in-place multipliers whose solve must interleave the window swaps
+    (ops/band_kernels.py::band_getrs) — the net ``perm`` alone does not
+    reproduce that factorization (reference: gbtrf.cc's banded ipiv
+    semantics vs getrf's fully-swapped rows).
     """
 
     perm: jnp.ndarray  # (m_pad,) int32
+    band_lperms: Optional[jnp.ndarray] = None  # (steps, W1) int32
+    band_w: Optional[int] = None
 
     def tree_flatten(self):
-        return (self.perm,), None
+        return (self.perm, self.band_lperms), (self.band_w,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0])
+        return cls(children[0], children[1], aux[0])
 
     def apply(self, B: jnp.ndarray) -> jnp.ndarray:
         """B <- P B (rows permuted forward)."""

@@ -1,0 +1,152 @@
+"""Windowed band kernel tests (ops/band_kernels.py) and the band-aware
+driver routes (reference: test/test_pbsv.cc, test_gbsv.cc, test_tbsm.cc
+acceptance: norm-based residuals at LAPACK tolerance)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import band
+from slate_tpu.enums import Diag, Op, Side, Uplo
+from slate_tpu.matrix.base import conj_transpose, transpose
+from slate_tpu.matrix.matrix import (
+    BandMatrix,
+    HermitianBandMatrix,
+    Matrix,
+    TriangularBandMatrix,
+)
+from slate_tpu.ops import band_kernels
+
+
+def _spd_band(rng, n, kd, dtype=np.float64):
+    i = np.arange(n)
+    mask = np.abs(i[:, None] - i[None, :]) <= kd
+    A = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        A = A + 1j * rng.standard_normal((n, n))
+    A = (A + A.conj().T) / 2 * mask
+    A = A + (2 * kd + 2) * np.eye(n)
+    return A
+
+
+def _gen_band(rng, n, kl, ku):
+    i = np.arange(n)
+    mask = ((i[None, :] - i[:, None]) <= ku) & ((i[:, None] - i[None, :]) <= kl)
+    return (rng.standard_normal((n, n)) + 2 * np.eye(n)) * mask
+
+
+@pytest.mark.parametrize("n,kd", [(200, 8), (333, 17), (128, 1)])
+def test_band_potrf_lower_kernel(rng, n, kd):
+    A = _spd_band(rng, n, kd)
+    L = np.asarray(band_kernels.band_potrf_lower(A, kd))
+    assert np.abs(np.triu(L, 1)).max() == 0
+    i = np.arange(n)
+    assert np.abs(L[(i[:, None] - i[None, :]) > kd]).max() == 0
+    res = np.abs(L @ L.T - A).max() / np.abs(A).max()
+    assert res < 1e-13 * n, res
+
+
+def test_band_potrf_complex(rng):
+    n, kd = 150, 6
+    A = _spd_band(rng, n, kd, np.complex128)
+    L = np.asarray(band_kernels.band_potrf_lower(A, kd))
+    res = np.abs(L @ L.conj().T - A).max() / np.abs(A).max()
+    assert res < 1e-13 * n, res
+
+
+@pytest.mark.parametrize("n,kd,unit", [(180, 7, False), (255, 16, True)])
+def test_band_trsm_lower_kernel(rng, n, kd, unit):
+    i = np.arange(n)
+    mask = (i[:, None] - i[None, :] <= kd) & (i[:, None] >= i[None, :])
+    # keep the substitution well-conditioned: unit-lower with O(1)
+    # multipliers has exp(n) solution growth, which no solver survives
+    L = rng.standard_normal((n, n)) * mask * (0.1 / np.sqrt(kd))
+    np.fill_diagonal(L, 1.0 if unit else np.abs(L.diagonal()) + n)
+    B = rng.standard_normal((n, 5))
+    X = np.asarray(band_kernels.band_trsm_lower(L, B, kd, unit_diag=unit))
+    res = np.abs(L @ X - B).max() / np.abs(B).max()
+    assert res < 1e-10, res
+
+
+@pytest.mark.parametrize("n,kl,ku", [(200, 5, 3), (257, 12, 9), (150, 1, 1)])
+def test_band_getrf_getrs_kernel(rng, n, kl, ku):
+    A = _gen_band(rng, n, kl, ku)
+    lu2d, lperms, perm, w = band_kernels.band_getrf(A, kl, ku)
+    lu2d_np, perm_np = np.asarray(lu2d), np.asarray(perm)
+    U = np.triu(lu2d_np)
+    L = np.tril(lu2d_np, -1)
+    # U fill-in bounded by kl + ku; L multipliers within the window span
+    i = np.arange(n)
+    assert np.abs(U[(i[None, :] - i[:, None]) > kl + ku]).max() == 0
+    assert np.abs(L[(i[:, None] - i[None, :]) >= w + kl]).max() == 0
+    assert sorted(perm_np.tolist()) == list(range(n))
+    # the factorization is validated through its interleaved solve
+    B = rng.standard_normal((n, 4))
+    X = np.asarray(band_kernels.band_getrs(lu2d, lperms, w, kl, ku, B))
+    res = np.abs(A @ X - B).max() / np.abs(B).max()
+    assert res < 1e-10 * n, res
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_pbsv_band_aware(rng, uplo):
+    n, kd, nb = 192, 9, 32
+    A0 = _spd_band(rng, n, kd)
+    B0 = rng.standard_normal((n, 4))
+    A = HermitianBandMatrix(
+        Matrix.from_global(A0, nb).data,
+        Matrix.from_global(A0, nb).layout,
+        kd=kd,
+        uplo=uplo,
+    )
+    B = Matrix.from_global(B0, nb)
+    X, L, info = band.pbsv(A, B)
+    assert int(info) == 0
+    res = np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max()
+    assert res < 1e-11, res
+
+
+def test_gbsv_band_aware(rng):
+    n, kl, ku, nb = 200, 6, 4, 32
+    A0 = _gen_band(rng, n, kl, ku)
+    B0 = rng.standard_normal((n, 3))
+    A = BandMatrix.from_global(A0, kl, ku, nb)
+    B = Matrix.from_global(B0, nb)
+    X, LU, piv, info = band.gbsv(A, B)
+    assert int(info) == 0
+    res = np.abs(A0 @ np.asarray(X.to_global()) - B0).max() / np.abs(B0).max()
+    assert res < 1e-10, res
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("opname", ["n", "t"])
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+def test_tbsm_band_aware(rng, uplo, opname, side):
+    n, kd, nb = 160, 8, 32
+    i = np.arange(n)
+    if uplo == Uplo.Lower:
+        mask = (i[:, None] - i[None, :] <= kd) & (i[:, None] >= i[None, :])
+    else:
+        mask = (i[None, :] - i[:, None] <= kd) & (i[:, None] <= i[None, :])
+    T0 = rng.standard_normal((n, n)) * mask + (n + 2) * np.eye(n)
+    B0 = rng.standard_normal((n, 6) if side == Side.Left else (6, n))
+    T = TriangularBandMatrix(
+        Matrix.from_global(T0, nb).data,
+        Matrix.from_global(T0, nb).layout,
+        kd=kd,
+        uplo=uplo,
+    )
+    A = T if opname == "n" else transpose(T)
+    M = T0 if opname == "n" else T0.T
+    B = Matrix.from_global(B0, nb)
+    X = band.tbsm(side, 1.0, A, B)
+    Xg = np.asarray(X.to_global())
+    want = (
+        np.linalg.solve(M, B0)
+        if side == Side.Left
+        else np.linalg.solve(M.T, B0.T).T
+    )
+    np.testing.assert_allclose(Xg, want, atol=1e-10)

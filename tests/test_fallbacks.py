@@ -1,0 +1,201 @@
+"""Fallback accounting tests: every driver route that abandons the SPMD
+path for a gathered-global evaluation must be recorded, and must raise
+under Option.RequireSpmd (reference behavior: SLATE never silently
+gathers a distributed matrix — internal/fallbacks.py)."""
+
+import numpy as np
+import pytest
+
+from slate_tpu.drivers import blas3, chol, lu
+from slate_tpu.enums import Diag, MethodLU, Op, Option, Side, Uplo
+from slate_tpu.exceptions import DistributedException
+from slate_tpu.internal import fallbacks
+from slate_tpu.matrix.base import BaseMatrix, conj_transpose, transpose
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix, TriangularMatrix
+
+REQ = {Option.RequireSpmd: True}
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    fallbacks.reset()
+    yield
+    fallbacks.reset()
+
+
+def _tri(rng, n, nb, grid):
+    L0 = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    return L0, TriangularMatrix.from_global(L0, nb, grid=grid, uplo=Uplo.Lower)
+
+
+def test_trmm_distributed_records_and_raises(rng, grid22):
+    """Non-conformable tiles (B mb != A nb) fall back and record."""
+    n, nb = 64, 16
+    L0, L = _tri(rng, n, nb, grid22)
+    B = Matrix.from_global(rng.standard_normal((n, 4)), 32, grid=grid22)
+    blas3.trmm(Side.Left, 1.0, L, B)
+    assert fallbacks.counters().get("trmm") == 1
+    with pytest.raises(DistributedException):
+        blas3.trmm(Side.Left, 1.0, L, B, opts=REQ)
+
+
+def test_trsm_viewed_b_records_and_raises(rng, grid22):
+    """A transposed B view is not spmd-conformable: falls back, records."""
+    n, nb = 32, 16
+    L0, L = _tri(rng, n, nb, grid22)
+    B = Matrix.from_global(rng.standard_normal((n, 4)), nb, grid=grid22)
+    Bt = transpose(Matrix.from_global(rng.standard_normal((4, n)), nb, grid=grid22))
+    blas3.trsm(Side.Left, 1.0, L, Bt)
+    assert fallbacks.counters().get("trsm") == 1
+    with pytest.raises(DistributedException):
+        blas3.trsm(Side.Left, 1.0, L, Bt, opts=REQ)
+
+
+def test_trsm_right_side_spmd(rng, grid22):
+    """Right-side solves ride the SPMD column pipeline now: no fallback."""
+    n, nb = 50, 16
+    L0, L = _tri(rng, n, nb, grid22)
+    B0 = rng.standard_normal((8, n))
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Right, 1.0, L, B, opts=REQ)
+    assert fallbacks.counters() == {}
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()),
+        np.linalg.solve(L0.T, B0.T).T,
+        atol=1e-11,
+    )
+
+
+def test_trmm_spmd(rng, grid22):
+    """Distributed trmm rides the triangular SUMMA: no fallback."""
+    n, nb = 50, 16
+    L0, L = _tri(rng, n, nb, grid22)
+    B0 = rng.standard_normal((n, 8))
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    out = blas3.trmm(Side.Left, 2.0, L, B, opts=REQ)
+    assert fallbacks.counters() == {}
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), 2.0 * (L0 @ B0), atol=1e-11 * n
+    )
+
+
+def test_calu_distributed_warns_by_default(rng, grid22):
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    # default-config distributed CALU must warn (not only on explicit
+    # UseShardMap) and be recorded
+    with pytest.warns(UserWarning, match="gathers"):
+        LU, piv, info = lu.getrf(A, {Option.MethodLU: MethodLU.CALU})
+    assert fallbacks.counters().get("getrf_tntpiv") == 1
+    with pytest.warns(UserWarning, match="gathers"):
+        with pytest.raises(DistributedException):
+            lu.getrf(
+                A, {Option.MethodLU: MethodLU.CALU, Option.RequireSpmd: True}
+            )
+
+
+def test_calu_string_key_warns(rng, grid22):
+    """String option keys must canonicalize in the warning gate."""
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    with pytest.warns(UserWarning, match="gathers"):
+        lu.getrf(A, {"method_lu": "calu", "useshardmap": True})
+
+
+def test_herk_mixed_op_records(rng, grid22):
+    n, nb = 32, 16
+    A = Matrix.from_global(rng.standard_normal((n, n)), nb, grid=grid22)
+    C0 = rng.standard_normal((n, n))
+    C = HermitianMatrix.from_global(
+        C0 + C0.T, nb, grid=grid22, uplo=Uplo.Lower
+    )
+    # syrk of a conj-transposed view is a mixed op/conj combo: falls back
+    blas3.syrk(1.0, conj_transpose(A), 0.0, C)
+    assert fallbacks.counters().get("herk") == 1
+    with pytest.raises(DistributedException):
+        blas3.syrk(1.0, conj_transpose(A), 0.0, C, opts=REQ)
+
+
+def test_herk_transposed_grid_spmd(rng, grid42):
+    """herk/syrk on a non-square mesh must NOT fall back (the old SUMMA
+    route resolved A^H onto the transposed grid and gathered)."""
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n))
+    C0 = rng.standard_normal((n, n))
+    C0 = C0 + C0.T
+    A = Matrix.from_global(A0, nb, grid=grid42)
+    C = HermitianMatrix.from_global(C0, nb, grid=grid42, uplo=Uplo.Lower)
+    out = blas3.herk(1.0, A, 0.5, C, opts=REQ)
+    assert fallbacks.counters() == {}
+    got = np.tril(np.asarray(out.to_global()))
+    want = np.tril(A0 @ A0.T + 0.5 * C0)
+    np.testing.assert_allclose(got, want, atol=1e-11 * n)
+
+
+def test_herk_trans_view_spmd(rng, grid22):
+    """herk of A^H (ConjTrans view) rides the row-gather kernel."""
+    n, k, nb = 48, 32, 16
+    A0 = rng.standard_normal((k, n))
+    C0 = rng.standard_normal((n, n))
+    C0 = C0 + C0.T
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    C = HermitianMatrix.from_global(C0, nb, grid=grid22, uplo=Uplo.Lower)
+    out = blas3.herk(1.0, conj_transpose(A), 0.5, C, opts=REQ)
+    assert fallbacks.counters() == {}
+    got = np.tril(np.asarray(out.to_global()))
+    want = np.tril(A0.T @ A0 + 0.5 * C0)
+    np.testing.assert_allclose(got, want, atol=1e-11 * n)
+
+
+def test_her2k_spmd_no_fallback(rng, grid22):
+    n, k, nb = 48, 32, 16
+    A0 = rng.standard_normal((n, k))
+    B0 = rng.standard_normal((n, k))
+    C0 = rng.standard_normal((n, n))
+    C0 = C0 + C0.T
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    C = HermitianMatrix.from_global(C0, nb, grid=grid22, uplo=Uplo.Lower)
+    out = blas3.syr2k(1.0, A, B, 0.5, C, opts=REQ)
+    assert fallbacks.counters() == {}
+    got = np.tril(np.asarray(out.to_global()))
+    want = np.tril(A0 @ B0.T + B0 @ A0.T + 0.5 * C0)
+    np.testing.assert_allclose(got, want, atol=1e-11 * n)
+
+
+def test_potrf_lower_no_gather(rng, grid22, monkeypatch):
+    """Distributed lower potrf reads only stored tiles — no mirror."""
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n))
+    A0 = A0 @ A0.T + n * np.eye(n)
+    A = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+
+    def boom(self, *a, **kw):  # pragma: no cover - failure path
+        raise AssertionError("gather in distributed lower potrf")
+
+    monkeypatch.setattr(BaseMatrix, "to_global", boom)
+    monkeypatch.setattr(HermitianMatrix, "full_global", boom)
+    L, info = chol.potrf(A, REQ)
+    assert fallbacks.counters() == {}
+
+
+def test_getrs_fallback_records(rng, grid22):
+    """A non-conformable B layout falls back and is recorded."""
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    LU, piv, info = lu.getrf(A)
+    B = Matrix.from_global(rng.standard_normal((n, 4)), 32, grid=grid22)
+    lu.getrs(LU, piv, B)
+    assert fallbacks.counters().get("getrs") == 1
+    with pytest.raises(DistributedException):
+        lu.getrs(LU, piv, B, opts=REQ)
+
+
+def test_counters_reset():
+    fallbacks.record("x")
+    assert fallbacks.counters() == {"x": 1}
+    fallbacks.reset()
+    assert fallbacks.counters() == {}

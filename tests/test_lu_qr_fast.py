@@ -18,7 +18,16 @@ from slate_tpu.ops.householder import (
 )
 
 
-@pytest.mark.parametrize("n,nb,ib", [(256, 128, 16), (384, 128, 32)])
+@pytest.mark.parametrize(
+    "n,nb,ib",
+    [
+        (256, 128, 16),
+        (384, 128, 32),
+        # n > coarse_panels*nb exercises the multi-panel fori_loop path
+        # (W > nb) that the bench sizes hit (ADVICE r3)
+        (1280, 128, 32),
+    ],
+)
 def test_lu_fast_vs_scipy(n, nb, ib):
     key = jax.random.PRNGKey(n)
     G = jax.random.normal(key, (n, n), jnp.float64)
@@ -49,7 +58,15 @@ def test_lu_fast_singularish():
     assert bool(jnp.all(jnp.isfinite(LU)))
 
 
-@pytest.mark.parametrize("m,n,nb,ib", [(256, 256, 128, 16), (384, 256, 128, 32)])
+@pytest.mark.parametrize(
+    "m,n,nb,ib",
+    [
+        (256, 256, 128, 16),
+        (384, 256, 128, 32),
+        # multi-panel W > nb path (see test_lu_fast_vs_scipy)
+        (1280, 1280, 128, 32),
+    ],
+)
 def test_qr_fast(m, n, nb, ib):
     key = jax.random.PRNGKey(m + n)
     G = jax.random.normal(key, (m, n), jnp.float64)

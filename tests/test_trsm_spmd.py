@@ -163,3 +163,102 @@ def test_gesv_distributed_ragged(rng, grid42):
     assert int(info) == 0
     err = checks.solve_residual(M0, np.asarray(X.to_global()), B0)
     assert checks.passed(err, np.float64, factor=30), err
+
+
+# ---------------------------------------------------------------------------
+# right-side trsm (spmd_trsm_right) and distributed trmm (spmd_trmm)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("opname", ["n", "t"])
+def test_trsm_right_ops_distributed(rng, grid22, uplo, opname):
+    n, nb = 50, 16
+    T0 = rng.standard_normal((n, n))
+    T0 = (np.tril(T0) if uplo == Uplo.Lower else np.triu(T0)) + n * np.eye(n)
+    B0 = rng.standard_normal((8, n))
+    T = TriangularMatrix.from_global(T0, nb, grid=grid22, uplo=uplo)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    A = T if opname == "n" else transpose(T)
+    M = T0 if opname == "n" else T0.T
+    X = blas3.trsm(Side.Right, 1.0, A, B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()), np.linalg.solve(M.T, B0.T).T, atol=1e-11
+    )
+
+
+def test_trsm_right_complex_conj_distributed(rng, grid42):
+    n, nb = 64, 8
+    T0 = np.tril(
+        rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    ) + n * np.eye(n)
+    B0 = rng.standard_normal((4, n)) + 1j * rng.standard_normal((4, n))
+    T = TriangularMatrix.from_global(T0, nb, grid=grid42, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid42)
+    X = blas3.trsm(Side.Right, 1.0, conj_transpose(T), B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()),
+        np.linalg.solve(T0.conj(), B0.T).T,
+        atol=1e-10,
+    )
+
+
+def test_trsm_right_unit_diag_distributed(rng, grid22):
+    n, nb = 48, 16
+    T0 = np.tril(rng.standard_normal((n, n)), -1)
+    B0 = rng.standard_normal((6, n))
+    T = TriangularMatrix.from_global(
+        T0 + np.eye(n), nb, grid=grid22, uplo=Uplo.Lower, diag=Diag.Unit
+    )
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    X = blas3.trsm(Side.Right, 1.0, T, B)
+    np.testing.assert_allclose(
+        np.asarray(X.to_global()),
+        np.linalg.solve((T0 + np.eye(n)).T, B0.T).T,
+        atol=1e-11,
+    )
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("opname", ["n", "t"])
+def test_trmm_distributed(rng, grid22, side, uplo, opname):
+    n, nb = 50, 16
+    T0 = rng.standard_normal((n, n))
+    T0 = np.tril(T0) if uplo == Uplo.Lower else np.triu(T0)
+    B0 = rng.standard_normal((n, n) if side == Side.Left else (n, n))
+    T = TriangularMatrix.from_global(T0, nb, grid=grid22, uplo=uplo)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    A = T if opname == "n" else transpose(T)
+    M = T0 if opname == "n" else T0.T
+    out = blas3.trmm(side, 1.5, A, B)
+    want = 1.5 * (M @ B0 if side == Side.Left else B0 @ M)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), want, atol=1e-11 * n
+    )
+
+
+def test_trmm_unit_diag_ragged_distributed(rng, grid42):
+    n, nb = 58, 16  # ragged last tile
+    T0 = np.tril(rng.standard_normal((n, n)), -1) + np.eye(n)
+    B0 = rng.standard_normal((n, 10))
+    T = TriangularMatrix.from_global(
+        T0, nb, grid=grid42, uplo=Uplo.Lower, diag=Diag.Unit
+    )
+    B = Matrix.from_global(B0, nb, grid=grid42)
+    out = blas3.trmm(Side.Left, 1.0, T, B)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), T0 @ B0, atol=1e-11 * n
+    )
+
+
+def test_trmm_complex_conj_distributed(rng, grid22):
+    n, nb = 48, 16
+    T0 = np.triu(rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n)))
+    B0 = rng.standard_normal((n, 6)) + 1j * rng.standard_normal((n, 6))
+    T = TriangularMatrix.from_global(T0, nb, grid=grid22, uplo=Uplo.Upper)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    out = blas3.trmm(Side.Left, 1.0, conj_transpose(T), B)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), T0.conj().T @ B0, atol=1e-10
+    )
