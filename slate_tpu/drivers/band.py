@@ -96,6 +96,12 @@ def tbsm(
     pipeline (no new gathers on the mesh path)."""
     from ..matrix.base import is_distributed
 
+    slate_assert(
+        pivots is None or pivots.band_lperms is None,
+        "tbsm cannot apply windowed-gbtrf pivots: the interleaved band "
+        "factorization must be solved by gbtrs (net perm + plain "
+        "triangular solves do not reproduce it)",
+    )
     kd = A.kd
     n = A.n
     eff_lower = (A.uplo == Uplo.Lower) != (A.op != Op.NoTrans)

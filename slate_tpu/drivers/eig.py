@@ -206,6 +206,12 @@ def unmtr_he2hb(
 
     from jax import lax
 
+    if _is_distributed(V) or _is_distributed(C_mat):
+        from ..internal import fallbacks
+
+        fallbacks.record(
+            "unmtr_he2hb", opts, "right side / op view / tile mismatch"
+        )
     Vg = V.to_global()
     C2 = C_mat.to_global()
     complex_t = V.is_complex

@@ -72,13 +72,21 @@ def _lu_dense(A2: jnp.ndarray, nb: int = 32) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _udiag_info(LU: Matrix, lay) -> jnp.ndarray:
-    """info code: exact zero / non-finite on U's diagonal."""
-    G = LU.to_global()
+    """info code: exact zero / non-finite on U's diagonal.
+
+    Evaluated as a masked reduction over the storage tile array — on a
+    mesh GSPMD lowers it to a local reduction + psum, never a gather
+    (the reference's internal::reduce_info, potrf.cc:208; the old
+    to_global() here round-tripped the whole matrix to check n scalars)."""
     dmin = min(lay.m, lay.n)
-    udiag = jnp.diagonal(G)[:dmin]
-    return jnp.where(
-        jnp.any(udiag == 0) | ~jnp.all(jnp.isfinite(udiag)), 1, 0
-    ).astype(jnp.int32)
+    gr = jnp.asarray(lay.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(lay.global_cols_np)[None, :, None, :]
+    dmask = (gr == gc) & (gr < dmin)
+    T = LU.data
+    bad = (T == 0) | ~jnp.isfinite(T)
+    if jnp.issubdtype(T.dtype, jnp.complexfloating):
+        bad = (T == 0) | ~(jnp.isfinite(jnp.real(T)) & jnp.isfinite(jnp.imag(T)))
+    return jnp.where(jnp.any(bad & dmask), 1, 0).astype(jnp.int32)
 
 
 @traced("getrf")
@@ -100,13 +108,23 @@ def getrf(
         # tournament pivoting (reference: getrf_tntpiv.cc; BEAM maps to
         # the tournament too — both trade the per-column pivot search for
         # a communication-free reduction, the fit for static schedules)
+        if (
+            _is_distributed(A)
+            and get_option(opts, Option.UseShardMap)
+            and lay.mb == lay.nb
+        ):
+            # mesh tournament: local election per process row + one
+            # winner all_gather over 'p' (parallel/spmd_lu.py)
+            T = eye_splice(lay, A.data)
+            Td, perm = spmd_lu.spmd_getrf_tntpiv(A.grid, T, lay)
+            LU = A._with(data=Td)
+            return LU, Pivots(perm), _udiag_info(LU, lay)
         if _is_distributed(A):
             import warnings
 
             warnings.warn(
                 "getrf(MethodLU.CALU) on a distributed matrix gathers to a "
-                "global array (the tournament is not yet a mesh reduction); "
-                "the UseShardMap option is ignored on this path",
+                "global array (non-square tiles or UseShardMap disabled)",
                 stacklevel=2,
             )
             fallbacks.record("getrf_tntpiv", opts, "tournament gathers")
@@ -185,10 +203,7 @@ def getrf_nopiv(
 
     lu2d = nopiv_lu(Gp)
     LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
-    G = LU.to_global()
-    udiag = jnp.diagonal(G)[: min(lay.m, lay.n)]
-    info = jnp.where(jnp.any(udiag == 0) | ~jnp.all(jnp.isfinite(udiag)), 1, 0)
-    return LU, info.astype(jnp.int32)
+    return LU, _udiag_info(LU, lay)
 
 
 def _nopiv_block(a: jnp.ndarray) -> jnp.ndarray:

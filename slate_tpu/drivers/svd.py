@@ -377,6 +377,10 @@ def unmbr_ge2tb_left(
 
     from jax import lax
 
+    if _is_distributed(UVm):
+        from ..internal import fallbacks
+
+        fallbacks.record("unmbr_ge2tb_left", opts, "op view / gate miss")
     UVg = UVm.to_global()
     complex_t = UVm.is_complex
 
@@ -438,6 +442,10 @@ def unmbr_ge2tb_right(
 
     from jax import lax
 
+    if _is_distributed(VVm):
+        from ..internal import fallbacks
+
+        fallbacks.record("unmbr_ge2tb_right", opts, "op view / gate miss")
     VVg = VVm.to_global()
     complex_t = VVm.is_complex
 

@@ -200,3 +200,158 @@ def spmd_getrf(
         out_specs=(spec, P()),
     )
     return fn(T)
+
+
+def spmd_getrf_tntpiv(
+    grid: ProcessGrid,
+    T: jnp.ndarray,
+    layout: TileLayout,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Distributed LU with tournament pivoting (CALU) — the tournament
+    rides the mesh row axis (reference: src/getrf_tntpiv.cc:1-498 +
+    internal_getrf_tntpiv.cc: per-rank local panel LU elects nb
+    candidate rows, winners advance up an MPI binary tree, then the
+    panel factors without further exchanges).
+
+    Per step k, inside one lax.fori_loop:
+
+    1. the panel column is psum-broadcast along 'q' so every process
+       holds its LOCAL row chunk — no full panel gather for pivoting;
+    2. each process row runs the tournament leaves + intra-process
+       rounds on its own rows (ops/lu_kernels.py::tournament_pivots);
+    3. the nb winners per process row all_gather over 'p' (the mesh
+       reduction round) and the final playoff runs redundantly;
+    4. winner_i swaps with panel row k*nb+i — at most 2 nb changed rows,
+       exchanged with the same masked-psum fetch as partial pivoting;
+    5. the post-exchange panel is rebuilt by two all_gathers and
+       factored redundantly with NO further pivoting, then write-back /
+       U row / trailing update proceed exactly as spmd_getrf.
+
+    Returns (tiles with L\\U, perm) like spmd_getrf.
+    """
+    from ..ops.lu_kernels import tournament_pivots
+
+    p, q = grid.p, grid.q
+    nt = min(layout.mt, layout.nt)
+    mtl, ntl = layout.mtl, layout.ntl
+    mb = layout.mb
+    m_pad = layout.P * mb
+    row_scatter = jnp.asarray(layout.row_scatter)
+    row_gather = jnp.asarray(layout.row_gather)
+
+    def local(tl):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(mtl) * p + r
+        gj = jnp.arange(ntl) * q + c
+        g_rows = jnp.arange(m_pad, dtype=jnp.int32)
+        grow = (gi[:, None] * mb + jnp.arange(mb)[None, :]).reshape(-1)
+
+        def step(k, carry):
+            tl, perm_total = carry
+            # -- 1. broadcast panel column k along 'q' --------------------
+            col_loc = lax.dynamic_slice_in_dim(tl, k // q, 1, axis=1)[:, 0]
+            own_col = c == (k % q)
+            col_loc = lax.psum(
+                jnp.where(own_col, col_loc, jnp.zeros_like(col_loc)), COL_AXIS
+            )
+            loc2d = col_loc.reshape(mtl * mb, mb)
+            active = grow >= (k * mb)
+            loc_act = jnp.where(active[:, None], loc2d, jnp.zeros_like(loc2d))
+
+            # -- 2. local tournament (leaves + intra-process rounds) ------
+            win_loc = tournament_pivots(loc_act, mb, mb)
+            cand_vals = loc_act[win_loc]  # (nb, nb)
+            cand_gidx = grow[win_loc]  # (nb,) global rows
+
+            # -- 3. inter-process round over the mesh row axis ------------
+            vals_all = lax.all_gather(cand_vals, ROW_AXIS).reshape(p * mb, mb)
+            idx_all = lax.all_gather(cand_gidx, ROW_AXIS).reshape(p * mb)
+            fin = tournament_pivots(vals_all, mb, mb)
+            winners = idx_all[fin].astype(jnp.int32)  # pivot order
+
+            # -- 4. exchange: winners to the panel rows (in pivot order),
+            # displaced panel rows into the vacated winner positions —
+            # a direct construction, NOT sequential swaps (a winner
+            # already inside the panel block breaks swap chains)
+            panel_rows = k * mb + jnp.arange(mb, dtype=jnp.int32)
+            is_winner = jnp.zeros((m_pad,), bool).at[winners].set(True)
+            in_panel = (g_rows >= k * mb) & (g_rows < k * mb + mb)
+            hole = is_winner & ~in_panel  # vacated positions
+            disp = in_panel & ~is_winner  # panel rows needing a home
+            hrank = jnp.cumsum(hole) - 1
+            drank = jnp.cumsum(disp) - 1
+            disp_by_rank = (
+                jnp.zeros((m_pad,), jnp.int32)
+                .at[jnp.where(disp, drank, m_pad)]
+                .set(g_rows, mode="drop")
+            )
+            step_perm = jnp.arange(m_pad, dtype=jnp.int32)
+            step_perm = step_perm.at[panel_rows].set(winners)
+            step_perm = jnp.where(hole, disp_by_rank[hrank], step_perm)
+            cand_dst = jnp.concatenate([panel_rows, winners])
+            src = step_perm[cand_dst]
+            contrib = _fetch_rows(tl, src, p, r, mb)
+            fetched = lax.psum(contrib, ROW_AXIS)
+            tl = _write_rows(tl, cand_dst, fetched, p, r, mb)
+            perm_total = perm_total[step_perm]
+
+            # -- 5. panel gather (post-exchange) + no-pivot factor --------
+            pan_loc = lax.dynamic_slice_in_dim(tl, k // q, 1, axis=1)[:, 0]
+            pan_q = lax.all_gather(pan_loc, COL_AXIS)
+            pan_rows = lax.dynamic_index_in_dim(pan_q, k % q, 0, keepdims=False)
+            pan_full = lax.all_gather(pan_rows, ROW_AXIS).reshape(p * mtl, mb, mb)
+            panel2d = pan_full[row_scatter].reshape(m_pad, mb)
+            active_len = m_pad - k * mb
+            panel_act = jnp.roll(panel2d, -k * mb, axis=0)
+            panel_act = jnp.where(
+                (g_rows < active_len)[:, None],
+                panel_act,
+                jnp.zeros_like(panel_act),
+            )
+            lu_pan, _ = panel_lu(panel_act, pivot=False)
+
+            # -- 6. write factored panel back (rows >= k only) ------------
+            lu_nat = jnp.roll(lu_pan, k * mb, axis=0).reshape(layout.P, mb, mb)
+            pan_storage = lu_nat[row_gather]
+            mine = lax.dynamic_slice_in_dim(pan_storage, r * mtl, mtl, axis=0)
+            cur_col = lax.dynamic_slice_in_dim(tl, k // q, 1, axis=1)[:, 0]
+            row_ge_k = (gi >= k)[:, None, None]
+            owner_c = c == (k % q)
+            new_col = jnp.where(row_ge_k & owner_c, mine, cur_col)
+            tl = lax.dynamic_update_slice_in_dim(
+                tl, new_col[:, None], k // q, axis=1
+            )
+
+            # -- 7. U row on its owner, bcast down 'p' --------------------
+            Lkk_full = lu_nat[k]
+            Lkk = jnp.tril(Lkk_full, -1) + jnp.eye(mb, dtype=Lkk_full.dtype)
+            row_tiles = lax.dynamic_index_in_dim(tl, k // p, 0, keepdims=False)
+            U_row = lax.linalg.triangular_solve(
+                jnp.broadcast_to(Lkk, row_tiles.shape),
+                row_tiles,
+                left_side=True,
+                lower=True,
+                unit_diagonal=True,
+            )
+            own_row = r == (k % p)
+            U_row = jnp.where(own_row, U_row, jnp.zeros_like(U_row))
+            U_row = lax.psum(U_row, ROW_AXIS)
+            j_gt = (gj > k)[:, None, None]
+            new_row = jnp.where(j_gt & own_row, U_row, row_tiles)
+            tl = lax.dynamic_update_index_in_dim(tl, new_row, k // p, axis=0)
+
+            # -- 8. trailing update ---------------------------------------
+            upd = jnp.einsum("iab,jbc->ijac", mine, U_row)
+            mask = ((gi[:, None] > k) & (gj[None, :] > k))[:, :, None, None]
+            tl = tl - jnp.where(mask, upd, jnp.zeros_like(upd))
+            return tl, perm_total
+
+        perm0 = jnp.arange(m_pad, dtype=jnp.int32)
+        return lax.fori_loop(0, nt, step, (tl, perm0))
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local, mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P())
+    )
+    return fn(T)

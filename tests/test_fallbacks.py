@@ -79,29 +79,48 @@ def test_trmm_spmd(rng, grid22):
     )
 
 
-def test_calu_distributed_warns_by_default(rng, grid22):
+def test_calu_distributed_spmd_no_warning(rng, grid22):
+    """Distributed CALU rides the mesh tournament: no warning, no
+    fallback, LAPACK-grade solve residual."""
+    import warnings as _w
+
     n, nb = 64, 16
     A0 = rng.standard_normal((n, n)) + n * np.eye(n)
     A = Matrix.from_global(A0, nb, grid=grid22)
-    # default-config distributed CALU must warn (not only on explicit
-    # UseShardMap) and be recorded
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        LU, piv, info = lu.getrf(
+            A, {Option.MethodLU: MethodLU.CALU, Option.RequireSpmd: True}
+        )
+    assert fallbacks.counters() == {}
+    assert int(info) == 0
+    lu2d = np.asarray(LU.to_global())
+    L = np.tril(lu2d, -1) + np.eye(n)
+    U = np.triu(lu2d)
+    perm = np.asarray(piv.perm)[:n]
+    res = np.abs(L @ U - A0[perm]).max() / np.abs(A0).max()
+    assert res < 1e-12, res
+
+
+def test_calu_distributed_warns_on_fallback(rng, grid22):
+    """UseShardMap=False distributed CALU still gathers: warn + record;
+    string option keys canonicalize in the gate."""
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = Matrix.from_global(A0, nb, grid=grid22)
     with pytest.warns(UserWarning, match="gathers"):
-        LU, piv, info = lu.getrf(A, {Option.MethodLU: MethodLU.CALU})
+        lu.getrf(A, {"method_lu": "calu", "useshardmap": False})
     assert fallbacks.counters().get("getrf_tntpiv") == 1
     with pytest.warns(UserWarning, match="gathers"):
         with pytest.raises(DistributedException):
             lu.getrf(
-                A, {Option.MethodLU: MethodLU.CALU, Option.RequireSpmd: True}
+                A,
+                {
+                    Option.MethodLU: MethodLU.CALU,
+                    Option.UseShardMap: False,
+                    Option.RequireSpmd: True,
+                },
             )
-
-
-def test_calu_string_key_warns(rng, grid22):
-    """String option keys must canonicalize in the warning gate."""
-    n, nb = 64, 16
-    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
-    A = Matrix.from_global(A0, nb, grid=grid22)
-    with pytest.warns(UserWarning, match="gathers"):
-        lu.getrf(A, {"method_lu": "calu", "useshardmap": True})
 
 
 def test_herk_mixed_op_records(rng, grid22):
@@ -199,3 +218,28 @@ def test_counters_reset():
     assert fallbacks.counters() == {"x": 1}
     fallbacks.reset()
     assert fallbacks.counters() == {}
+
+
+@pytest.mark.parametrize("kind", ["svd_geo", "svd_arith"])
+def test_calu_distributed_illconditioned_parity(rng, grid22, kind):
+    """Mesh-tournament CALU matches partial pivoting's solve quality on
+    ill-conditioned matgen kinds (reference: test_gesv.cc tntpiv runs)."""
+    from slate_tpu.matgen.generate import generate_2d
+
+    n, nb = 96, 16
+    A0 = np.asarray(generate_2d(kind, n, n, cond=1e8, seed=11)[0])
+    B0 = rng.standard_normal((n, 3))
+    A = Matrix.from_global(A0, nb, grid=grid22)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+
+    LUc, pivc, infoc = lu.getrf(A, {Option.MethodLU: MethodLU.CALU})
+    Xc = lu.getrs(LUc, pivc, B)
+    LUp, pivp, infop = lu.getrf(A)
+    Xp = lu.getrs(LUp, pivp, B)
+    from slate_tpu.testing import checks
+
+    ec = checks.solve_residual(A0, np.asarray(Xc.to_global()), B0)
+    ep = checks.solve_residual(A0, np.asarray(Xp.to_global()), B0)
+    assert checks.passed(ec, np.float64, factor=60), (ec, ep)
+    # parity: tournament within ~30x of partial pivoting's backward error
+    assert ec <= 30 * max(ep, np.finfo(np.float64).eps), (ec, ep)

@@ -226,7 +226,7 @@ def test_trmm_distributed(rng, grid22, side, uplo, opname):
     n, nb = 50, 16
     T0 = rng.standard_normal((n, n))
     T0 = np.tril(T0) if uplo == Uplo.Lower else np.triu(T0)
-    B0 = rng.standard_normal((n, n) if side == Side.Left else (n, n))
+    B0 = rng.standard_normal((n, 72) if side == Side.Left else (72, n))
     T = TriangularMatrix.from_global(T0, nb, grid=grid22, uplo=uplo)
     B = Matrix.from_global(B0, nb, grid=grid22)
     A = T if opname == "n" else transpose(T)

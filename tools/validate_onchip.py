@@ -24,6 +24,7 @@ import numpy as np
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="smaller sizes")
+    ap.add_argument("--heev-only", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -47,55 +48,56 @@ def main() -> int:
 
     ok = True
 
-    # -- dpotrf: ops/chol_kernels.cholesky ------------------------------
-    n = 1024 if args.quick else 2048
-    A0 = rng.standard_normal((n, n))
-    A0 = A0 @ A0.T + n * np.eye(n)
-    from slate_tpu.ops.chol_kernels import cholesky
+    if not args.heev_only:
+        # -- dpotrf: ops/chol_kernels.cholesky --------------------------
+        n = 1024 if args.quick else 2048
+        A0 = rng.standard_normal((n, n))
+        A0 = A0 @ A0.T + n * np.eye(n)
+        from slate_tpu.ops.chol_kernels import cholesky
 
-    t0 = time.time()
-    L = np.asarray(jax.block_until_ready(cholesky(jnp.asarray(A0), 512)))
-    t1 = time.time()
-    L = np.tril(L)
-    err = np.abs(L @ L.T - A0).max() / (np.abs(A0).max() * n * eps)
-    ok &= report("dpotrf_native(n=%d)" % n, err, 100, t1 - t0)
+        t0 = time.time()
+        L = np.asarray(jax.block_until_ready(cholesky(jnp.asarray(A0), 512)))
+        t1 = time.time()
+        L = np.tril(L)
+        err = np.abs(L @ L.T - A0).max() / (np.abs(A0).max() * n * eps)
+        ok &= report("dpotrf_native(n=%d)" % n, err, 100, t1 - t0)
 
-    # -- dgetrf: ops/lu_fast ---------------------------------------------
-    from slate_tpu.ops.lu_fast import blocked_getrf_fast
+        # -- dgetrf: ops/lu_fast ----------------------------------------
+        from slate_tpu.ops.lu_fast import blocked_getrf_fast
 
-    M0 = rng.standard_normal((n, n))
-    t0 = time.time()
-    lu2d, perm = jax.block_until_ready(
-        blocked_getrf_fast(jnp.asarray(M0), 512)
-    )
-    t1 = time.time()
-    lu2d = np.asarray(lu2d)
-    perm = np.asarray(perm)
-    Lm = np.tril(lu2d, -1) + np.eye(n)
-    Um = np.triu(lu2d)
-    err = np.abs(Lm @ Um - M0[perm]).max() / (np.abs(M0).max() * n * eps)
-    ok &= report("dgetrf_native(n=%d)" % n, err, 100, t1 - t0)
+        M0 = rng.standard_normal((n, n))
+        t0 = time.time()
+        lu2d, perm = jax.block_until_ready(
+            blocked_getrf_fast(jnp.asarray(M0), 512)
+        )
+        t1 = time.time()
+        lu2d = np.asarray(lu2d)
+        perm = np.asarray(perm)
+        Lm = np.tril(lu2d, -1) + np.eye(n)
+        Um = np.triu(lu2d)
+        err = np.abs(Lm @ Um - M0[perm]).max() / (np.abs(M0).max() * n * eps)
+        ok &= report("dgetrf_native(n=%d)" % n, err, 100, t1 - t0)
 
-    # -- dgeqrf: ops/qr_fast ---------------------------------------------
-    from slate_tpu.ops.qr_fast import geqrf_fast
-    from slate_tpu.ops.householder import larft, materialize_v
+        # -- dgeqrf: ops/qr_fast ----------------------------------------
+        from slate_tpu.ops.qr_fast import geqrf_fast
+        from slate_tpu.ops.householder import larft, materialize_v
 
-    t0 = time.time()
-    fac, taus = jax.block_until_ready(geqrf_fast(jnp.asarray(M0), 512))
-    t1 = time.time()
-    # reconstruct Q^T A and compare to R (apply the panels)
-    Afac = np.asarray(fac)
-    R = np.triu(Afac)
-    C = jnp.asarray(M0)
-    nbp = 512
-    for k0 in range(0, n, nbp):
-        V = materialize_v(fac[:, k0:k0 + nbp], offset=k0)
-        T = larft(V, taus[k0:k0 + nbp])
-        W = V.conj().T @ C
-        C = C - V @ (T.conj().T @ W)
-    QtA = np.asarray(C)
-    err = np.abs(QtA - R).max() / (np.abs(M0).max() * n * eps)
-    ok &= report("dgeqrf_native(n=%d)" % n, err, 100, t1 - t0)
+        t0 = time.time()
+        fac, taus = jax.block_until_ready(geqrf_fast(jnp.asarray(M0), 512))
+        t1 = time.time()
+        # reconstruct Q^T A and compare to R (apply the panels)
+        Afac = np.asarray(fac)
+        R = np.triu(Afac)
+        C = jnp.asarray(M0)
+        nbp = 512
+        for k0 in range(0, n, nbp):
+            V = materialize_v(fac[:, k0:k0 + nbp], offset=k0)
+            T = larft(V, taus[k0:k0 + nbp])
+            W = V.conj().T @ C
+            C = C - V @ (T.conj().T @ W)
+        QtA = np.asarray(C)
+        err = np.abs(QtA - R).max() / (np.abs(M0).max() * n * eps)
+        ok &= report("dgeqrf_native(n=%d)" % n, err, 100, t1 - t0)
 
     # -- heev with vectors through the driver (he2hb + hb2st + stedc +
     #    back-transforms), the full flagship path ------------------------
@@ -109,11 +111,30 @@ def main() -> int:
     A = HermitianMatrix.from_global(
         jnp.asarray(H0), 128, uplo=Uplo.Lower
     )
+
+    # jit the WHOLE driver call (as bench.py does): the eager path pays
+    # ~100 ms tunnel latency per dispatched op
+
+    @jax.jit
+    def _heev_step(A):
+        w, Z = eig.heev(A)
+        return w, Z.data
+
+    print("compiling heev...", flush=True)
+    tc0 = time.time()
+    w, Zd = jax.block_until_ready(_heev_step(A))
+    print(f"heev compile+first run: {time.time() - tc0:.1f}s", flush=True)
+    # perturb the input: the tunnel caches identical dispatches
+    # (BENCH_NOTES.md methodology), so timing a replay measures nothing
+    A = A._with(data=A.data + jnp.float64(1e-14))
+    H0 = H0 + 1e-14
     t0 = time.time()
-    w, Z = eig.heev(A)
-    w = np.asarray(w)
-    Zg = np.asarray(Z.to_global())
+    w, Zd = jax.block_until_ready(_heev_step(A))
     t1 = time.time()
+    w = np.asarray(w)
+    from slate_tpu.matrix.matrix import Matrix as _M
+
+    Zg = np.asarray(_M(Zd, A.layout, grid=A.grid).to_global())
     err = np.abs(H0 @ Zg - Zg * w[None, :]).max() / (
         np.abs(H0).max() * n_eig * eps
     )
