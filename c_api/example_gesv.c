@@ -1,0 +1,47 @@
+/* Minimal standalone C client of the slate_tpu ABI (reference: the
+ * reference's examples/c_api usage): solve a 64x64 system and print the
+ * residual.  Build: make example_gesv; run with PYTHONPATH=<repo root>.
+ */
+
+#include "slate_tpu.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+int main(void) {
+    const int64_t n = 64, nrhs = 2;
+    double *a = malloc(sizeof(double) * n * n);
+    double *a0 = malloc(sizeof(double) * n * n);
+    double *b = malloc(sizeof(double) * n * nrhs);
+    double *b0 = malloc(sizeof(double) * n * nrhs);
+    int64_t *ipiv = malloc(sizeof(int64_t) * n);
+    srand(7);
+    for (int64_t j = 0; j < n; ++j)
+        for (int64_t i = 0; i < n; ++i) {
+            double v = (double)rand() / RAND_MAX - 0.5;
+            if (i == j) v += n;
+            a[j * n + i] = a0[j * n + i] = v;
+        }
+    for (int64_t i = 0; i < n * nrhs; ++i)
+        b[i] = b0[i] = (double)rand() / RAND_MAX - 0.5;
+
+    if (slate_tpu_init() != 0) return 1;
+    int info = slate_tpu_dgesv(n, nrhs, a, n, ipiv, b, n);
+    if (info != 0) {
+        fprintf(stderr, "dgesv info=%d\n", info);
+        return 2;
+    }
+    double rmax = 0.0;
+    for (int64_t r = 0; r < nrhs; ++r)
+        for (int64_t i = 0; i < n; ++i) {
+            double s = 0.0;
+            for (int64_t j = 0; j < n; ++j)
+                s += a0[j * n + i] * b[r * n + j];
+            double d = fabs(s - b0[r * n + i]);
+            if (d > rmax) rmax = d;
+        }
+    printf("max residual |AX-B| = %.3e\n", rmax);
+    slate_tpu_finalize();
+    return rmax < 1e-8 ? 0 : 3;
+}

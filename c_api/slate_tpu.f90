@@ -1,0 +1,84 @@
+! Fortran interfaces for the slate_tpu C ABI (reference: tools/fortran/
+! — the reference generates these; here a hand-written ISO_C_BINDING
+! module covering the same routine surface as slate_tpu.h).
+!
+! Usage:  use slate_tpu;  info = slate_tpu_dgesv(n, nrhs, a, n, ipiv, b, n)
+! Link against libslate_tpu.so (see c_api/Makefile).
+
+module slate_tpu
+  use iso_c_binding
+  implicit none
+
+  interface
+    integer(c_int) function slate_tpu_init() bind(C, name="slate_tpu_init")
+      import
+    end function
+
+    subroutine slate_tpu_finalize() bind(C, name="slate_tpu_finalize")
+    end subroutine
+
+    integer(c_int) function slate_tpu_dgesv(n, nrhs, a, lda, ipiv, b, ldb) &
+        bind(C, name="slate_tpu_dgesv")
+      import
+      integer(c_int64_t), value :: n, nrhs, lda, ldb
+      real(c_double) :: a(*), b(*)
+      integer(c_int64_t) :: ipiv(*)
+    end function
+
+    integer(c_int) function slate_tpu_dposv(uplo, n, nrhs, a, lda, b, ldb) &
+        bind(C, name="slate_tpu_dposv")
+      import
+      character(kind=c_char), value :: uplo
+      integer(c_int64_t), value :: n, nrhs, lda, ldb
+      real(c_double) :: a(*), b(*)
+    end function
+
+    integer(c_int) function slate_tpu_dgels(m, n, nrhs, a, lda, b, ldb) &
+        bind(C, name="slate_tpu_dgels")
+      import
+      integer(c_int64_t), value :: m, n, nrhs, lda, ldb
+      real(c_double) :: a(*), b(*)
+    end function
+
+    integer(c_int) function slate_tpu_dgetrf(m, n, a, lda, ipiv) &
+        bind(C, name="slate_tpu_dgetrf")
+      import
+      integer(c_int64_t), value :: m, n, lda
+      real(c_double) :: a(*)
+      integer(c_int64_t) :: ipiv(*)
+    end function
+
+    integer(c_int) function slate_tpu_dpotrf(uplo, n, a, lda) &
+        bind(C, name="slate_tpu_dpotrf")
+      import
+      character(kind=c_char), value :: uplo
+      integer(c_int64_t), value :: n, lda
+      real(c_double) :: a(*)
+    end function
+
+    integer(c_int) function slate_tpu_dgeqrf(m, n, a, lda, tau) &
+        bind(C, name="slate_tpu_dgeqrf")
+      import
+      integer(c_int64_t), value :: m, n, lda
+      real(c_double) :: a(*), tau(*)
+    end function
+
+    integer(c_int) function slate_tpu_dsyev(jobz, uplo, n, a, lda, w) &
+        bind(C, name="slate_tpu_dsyev")
+      import
+      character(kind=c_char), value :: jobz, uplo
+      integer(c_int64_t), value :: n, lda
+      real(c_double) :: a(*), w(*)
+    end function
+
+    integer(c_int) function slate_tpu_dgemm(transa, transb, m, n, k, alpha, &
+        a, lda, b, ldb, beta, c, ldc) bind(C, name="slate_tpu_dgemm")
+      import
+      character(kind=c_char), value :: transa, transb
+      integer(c_int64_t), value :: m, n, k, lda, ldb, ldc
+      real(c_double), value :: alpha, beta
+      real(c_double) :: a(*), b(*), c(*)
+    end function
+  end interface
+
+end module slate_tpu

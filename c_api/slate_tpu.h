@@ -1,0 +1,72 @@
+/* slate_tpu C API (reference: src/c_api/wrappers.cc + include/slate/c_api/
+ * — the extern "C" LAPACK-style surface over the driver layer).
+ *
+ * All matrices are COLUMN-MAJOR (LAPACK convention) with an explicit
+ * leading dimension.  Every routine returns the LAPACK info code
+ * (0 = success, >0 = numerical failure, <0 = API error).  The library
+ * embeds the Python runtime that hosts the JAX/XLA drivers; call
+ * slate_tpu_init() once before any routine (idempotent, safe when the
+ * caller is itself a Python process) and slate_tpu_finalize() at exit.
+ */
+
+#ifndef SLATE_TPU_H
+#define SLATE_TPU_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int  slate_tpu_init(void);
+void slate_tpu_finalize(void);
+
+/* ---- solves ---------------------------------------------------------- */
+
+/* A X = B, general A: LU with partial pivoting.  On exit a holds L\U,
+ * ipiv the 1-based sequential swap list, b the solution. */
+int slate_tpu_dgesv(int64_t n, int64_t nrhs, double *a, int64_t lda,
+                    int64_t *ipiv, double *b, int64_t ldb);
+
+/* A X = B, SPD A ('l'/'u' = stored triangle).  a <- factor, b <- X. */
+int slate_tpu_dposv(char uplo, int64_t n, int64_t nrhs, double *a,
+                    int64_t lda, double *b, int64_t ldb);
+
+/* min-norm least squares: b (max(m,n) x nrhs buffer) <- X. */
+int slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double *a,
+                    int64_t lda, double *b, int64_t ldb);
+
+/* ---- factorizations -------------------------------------------------- */
+
+int slate_tpu_dgetrf(int64_t m, int64_t n, double *a, int64_t lda,
+                     int64_t *ipiv);
+int slate_tpu_dpotrf(char uplo, int64_t n, double *a, int64_t lda);
+int slate_tpu_dgeqrf(int64_t m, int64_t n, double *a, int64_t lda,
+                     double *tau);
+
+/* ---- eigen / singular values ---------------------------------------- */
+
+/* jobz 'n'|'v'; on exit w holds eigenvalues ascending and (jobz='v')
+ * a holds the eigenvectors. */
+int slate_tpu_dsyev(char jobz, char uplo, int64_t n, double *a,
+                    int64_t lda, double *w);
+
+/* jobu/jobvt 'n'|'s': s (min(m,n)), u (m x min(m,n)), vt (min(m,n) x n);
+ * u/vt may be NULL when not requested. */
+int slate_tpu_dgesvd(char jobu, char jobvt, int64_t m, int64_t n,
+                     double *a, int64_t lda, double *s, double *u,
+                     int64_t ldu, double *vt, int64_t ldvt);
+
+/* ---- BLAS3 ----------------------------------------------------------- */
+
+/* C = alpha op(A) op(B) + beta C; transa/transb 'n'|'t'. */
+int slate_tpu_dgemm(char transa, char transb, int64_t m, int64_t n,
+                    int64_t k, double alpha, const double *a, int64_t lda,
+                    const double *b, int64_t ldb, double beta, double *c,
+                    int64_t ldc);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SLATE_TPU_H */

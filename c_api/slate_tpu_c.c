@@ -1,0 +1,220 @@
+/* slate_tpu C API implementation (reference: src/c_api/wrappers.cc).
+ *
+ * Embeds the CPython runtime hosting the JAX/XLA drivers and forwards
+ * each call to slate_tpu.compat.c_bridge with zero-copy writable
+ * memoryviews over the caller's column-major buffers.  Works both as a
+ * standalone embedding (any C/C++/Fortran program) and when loaded into
+ * an existing Python process (init detects the live interpreter).
+ */
+
+#include "slate_tpu.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdio.h>
+
+static PyObject *g_bridge = NULL;  /* slate_tpu.compat.c_bridge */
+static int g_we_initialized = 0;
+static PyThreadState *g_saved_ts = NULL;
+
+int slate_tpu_init(void) {
+    if (g_bridge != NULL) return 0;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_we_initialized = 1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *mod = PyImport_ImportModule("slate_tpu.compat.c_bridge");
+    int rc = 0;
+    if (mod == NULL) {
+        PyErr_Print();
+        rc = -1;
+    } else {
+        g_bridge = mod;  /* keep the reference */
+    }
+    PyGILState_Release(st);
+    if (g_we_initialized && g_saved_ts == NULL) {
+        /* release the GIL held by Py_InitializeEx so any thread can
+         * PyGILState_Ensure later */
+        g_saved_ts = PyEval_SaveThread();
+    }
+    return rc;
+}
+
+void slate_tpu_finalize(void) {
+    if (g_bridge == NULL) return;
+    if (g_we_initialized) {
+        if (g_saved_ts) PyEval_RestoreThread(g_saved_ts);
+        Py_XDECREF(g_bridge);
+        g_bridge = NULL;
+        Py_Finalize();
+        g_we_initialized = 0;
+        g_saved_ts = NULL;
+    } else {
+        PyGILState_STATE st = PyGILState_Ensure();
+        Py_XDECREF(g_bridge);
+        g_bridge = NULL;
+        PyGILState_Release(st);
+    }
+}
+
+/* writable memoryview over a caller buffer (NULL -> Py None) */
+static PyObject *mv(void *p, Py_ssize_t nbytes) {
+    if (p == NULL) Py_RETURN_NONE;
+    return PyMemoryView_FromMemory((char *)p, nbytes, PyBUF_WRITE);
+}
+
+static int call_bridge(const char *name, PyObject *args) {
+    /* consumes args; returns the bridge's int, or -100x on API errors */
+    if (g_bridge == NULL && slate_tpu_init() != 0) {
+        Py_XDECREF(args);
+        return -1001;
+    }
+    int rc;
+    PyObject *fn = PyObject_GetAttrString(g_bridge, name);
+    if (fn == NULL || args == NULL) {
+        PyErr_Print();
+        Py_XDECREF(fn);
+        Py_XDECREF(args);
+        return -1002;
+    }
+    PyObject *res = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_DECREF(args);
+    if (res == NULL) {
+        PyErr_Print();
+        return -1003;
+    }
+    rc = (int)PyLong_AsLong(res);
+    Py_DECREF(res);
+    return rc;
+}
+
+int slate_tpu_dgesv(int64_t n, int64_t nrhs, double *a, int64_t lda,
+                    int64_t *ipiv, double *b, int64_t ldb) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(LLNLNNL)", (long long)n, (long long)nrhs,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(ipiv, sizeof(int64_t) * n),
+        mv(b, sizeof(double) * ldb * nrhs), (long long)ldb);
+    int rc = call_bridge("dgesv", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dposv(char uplo, int64_t n, int64_t nrhs, double *a,
+                    int64_t lda, double *b, int64_t ldb) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(bLLNLNL)", (char)uplo, (long long)n, (long long)nrhs,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(b, sizeof(double) * ldb * nrhs), (long long)ldb);
+    int rc = call_bridge("dposv", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, double *a,
+                    int64_t lda, double *b, int64_t ldb) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(LLLNLNL)", (long long)m, (long long)n, (long long)nrhs,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(b, sizeof(double) * ldb * nrhs), (long long)ldb);
+    int rc = call_bridge("dgels", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dgetrf(int64_t m, int64_t n, double *a, int64_t lda,
+                     int64_t *ipiv) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int64_t k = m < n ? m : n;
+    PyObject *args = Py_BuildValue(
+        "(LLNLN)", (long long)m, (long long)n,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(ipiv, sizeof(int64_t) * k));
+    int rc = call_bridge("dgetrf", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dpotrf(char uplo, int64_t n, double *a, int64_t lda) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(bLNL)", (char)uplo, (long long)n,
+        mv(a, sizeof(double) * lda * n), (long long)lda);
+    int rc = call_bridge("dpotrf", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dgeqrf(int64_t m, int64_t n, double *a, int64_t lda,
+                     double *tau) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int64_t k = m < n ? m : n;
+    PyObject *args = Py_BuildValue(
+        "(LLNLN)", (long long)m, (long long)n,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(tau, sizeof(double) * k));
+    int rc = call_bridge("dgeqrf", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dsyev(char jobz, char uplo, int64_t n, double *a,
+                    int64_t lda, double *w) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *args = Py_BuildValue(
+        "(bbLNLN)", (char)jobz, (char)uplo, (long long)n,
+        mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(w, sizeof(double) * n));
+    int rc = call_bridge("dsyev", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dgesvd(char jobu, char jobvt, int64_t m, int64_t n,
+                     double *a, int64_t lda, double *s, double *u,
+                     int64_t ldu, double *vt, int64_t ldvt) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int64_t k = m < n ? m : n;
+    PyObject *args = Py_BuildValue(
+        "(bbLLNLNNLNL)", (char)jobu, (char)jobvt, (long long)m,
+        (long long)n, mv(a, sizeof(double) * lda * n), (long long)lda,
+        mv(s, sizeof(double) * k),
+        mv(u, u ? sizeof(double) * ldu * k : 0), (long long)ldu,
+        mv(vt, vt ? sizeof(double) * ldvt * n : 0), (long long)ldvt);
+    int rc = call_bridge("dgesvd", args);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int slate_tpu_dgemm(char transa, char transb, int64_t m, int64_t n,
+                    int64_t k, double alpha, const double *a, int64_t lda,
+                    const double *b, int64_t ldb, double beta, double *c,
+                    int64_t ldc) {
+    if (slate_tpu_init() != 0) return -1001;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int64_t acols = (transa == 'n' || transa == 'N') ? k : m;
+    int64_t bcols = (transb == 'n' || transb == 'N') ? n : k;
+    PyObject *args = Py_BuildValue(
+        "(bbLLLdNLNLdNL)", (char)transa, (char)transb, (long long)m,
+        (long long)n, (long long)k, alpha,
+        mv((void *)a, sizeof(double) * lda * acols), (long long)lda,
+        mv((void *)b, sizeof(double) * ldb * bcols), (long long)ldb, beta,
+        mv(c, sizeof(double) * ldc * n), (long long)ldc);
+    int rc = call_bridge("dgemm", args);
+    PyGILState_Release(st);
+    return rc;
+}

@@ -1,0 +1,133 @@
+"""Debug dumps: tile layout, ownership, and device-placement inspection
+(reference: src/core/Debug.cc:66-340 — checkTilesLives,
+printTilesLives, printTilesMaps, printNumFreeMemBlocks; SURVEY §5).
+
+The reference walks MatrixStorage's tile map and MOSI states; here the
+analogous introspection shows the block-cyclic index math (which global
+tile lives in which storage slot, owned by which process) and the JAX
+sharding actually placed on the data — the two things that can disagree
+with a driver's expectation and produce wrong-layout bugs.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+from ..matrix.base import BaseMatrix
+
+
+def tiles_map(A: BaseMatrix, max_tiles: int = 32) -> str:
+    """Ownership map of A's global tiles (reference: Debug.cc
+    printTilesMaps): one cell per tile, showing 'pr,pc' owner ranks,
+    truncated to max_tiles rows/cols."""
+    lay = A.layout
+    out = io.StringIO()
+    mt, nt = lay.mt, lay.nt
+    out.write(
+        f"tiles_map: {lay.m}x{lay.n}, tile {lay.mb}x{lay.nb}, "
+        f"grid {lay.p}x{lay.q}, storage {lay.storage_shape}\n"
+    )
+    for i in range(min(mt, max_tiles)):
+        cells = []
+        for j in range(min(nt, max_tiles)):
+            pr, pc = lay.tileRank(i, j)
+            cells.append(f"{pr},{pc}")
+        ell = " ..." if nt > max_tiles else ""
+        out.write("  " + " | ".join(cells) + ell + "\n")
+    if mt > max_tiles:
+        out.write("  ...\n")
+    return out.getvalue()
+
+
+def storage_map(A: BaseMatrix, max_slots: int = 32) -> str:
+    """Storage-slot map (reference: MatrixStorage's tile map dump):
+    which global tile each owner-major slot holds, plus padding flags."""
+    lay = A.layout
+    out = io.StringIO()
+    out.write(
+        f"storage_map: slots {lay.P}x{lay.Q} "
+        f"(local {lay.mtl}x{lay.ntl} per process)\n"
+    )
+    for s in range(min(lay.P, max_slots)):
+        i = lay.lrow(s)
+        row = []
+        for t in range(min(lay.Q, max_slots)):
+            j = lay.lcol(t)
+            pad = "" if (i < lay.mt and j < lay.nt) else "*"
+            row.append(f"({i},{j}){pad}")
+        out.write(f"  slot row {s:3d}: " + " ".join(row) + "\n")
+    if lay.P > max_slots:
+        out.write("  ...\n")
+    out.write("  (* = padding slot beyond the matrix)\n")
+    return out.getvalue()
+
+
+def sharding_info(A: BaseMatrix) -> str:
+    """The sharding actually on A.data vs the layout's expectation
+    (reference: Debug.cc checkTilesLives — storage vs expectation)."""
+    out = io.StringIO()
+    data = A.data
+    out.write(f"data: shape {tuple(data.shape)}, dtype {data.dtype}\n")
+    sh = getattr(data, "sharding", None)
+    if sh is None:
+        out.write("sharding: none (host / uncommitted)\n")
+        return out.getvalue()
+    out.write(f"sharding: {sh}\n")
+    try:
+        dev_map = sh.devices_indices_map(tuple(data.shape))
+        for dev, idx in list(dev_map.items())[:16]:
+            out.write(f"  {dev}: {idx}\n")
+        if len(dev_map) > 16:
+            out.write(f"  ... ({len(dev_map)} devices total)\n")
+    except Exception as e:  # pragma: no cover - backend-specific
+        out.write(f"  (indices map unavailable: {e})\n")
+    exp = (
+        f"expected for grid {A.grid.p}x{A.grid.q}: "
+        f"PartitionSpec('p','q') over storage axes 0,1\n"
+        if A.grid is not None and A.grid.size > 1
+        else "expected: single-device (no partitioning)\n"
+    )
+    out.write(exp)
+    return out.getvalue()
+
+
+def tiles_lives(A: BaseMatrix) -> str:
+    """Per-tile liveness summary (reference: Debug.cc printTilesLives):
+    on TPU there is no MOSI state — a tile is 'live' iff its slot holds
+    non-padding data; report counts and any NaN/Inf tiles (the usual
+    smoking gun a MOSI bug would have produced)."""
+    lay = A.layout
+    T = np.asarray(A.data)
+    bad = ~np.isfinite(T).reshape(lay.P, lay.Q, -1).all(axis=2)
+    valid = np.zeros((lay.P, lay.Q), dtype=bool)
+    for s in range(lay.P):
+        for t in range(lay.Q):
+            valid[s, t] = lay.lrow(s) < lay.mt and lay.lcol(t) < lay.nt
+    out = io.StringIO()
+    out.write(
+        f"tiles_lives: {valid.sum()} live / {lay.P * lay.Q} slots "
+        f"({(~valid).sum()} padding)\n"
+    )
+    nonfinite = np.argwhere(bad & valid)
+    if len(nonfinite):
+        out.write(f"  NON-FINITE tiles at slots: {nonfinite.tolist()[:20]}\n")
+    else:
+        out.write("  all live tiles finite\n")
+    return out.getvalue()
+
+
+def dump(A: BaseMatrix, label: str = "matrix", file=None) -> str:
+    """Full debug dump (layout + storage + sharding + liveness)."""
+    s = (
+        f"== debug dump: {label} ==\n"
+        + tiles_map(A)
+        + storage_map(A)
+        + sharding_info(A)
+        + tiles_lives(A)
+    )
+    if file is not None:
+        print(s, file=file)
+    return s

@@ -1,0 +1,151 @@
+"""Buffer-based bridge for the C API (reference: src/c_api/wrappers.cc —
+the mutate-caller-buffers LAPACK ABI the C surface must honor).
+
+Every function receives writable memoryviews of the caller's
+column-major buffers (created by c_api/slate_tpu_c.c with
+PyMemoryView_FromMemory), wraps them zero-copy as Fortran-ordered numpy
+views, routes through compat.lapack, and writes results back IN PLACE.
+Returns the LAPACK info code.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# the C ABI traffics in doubles; the embedding has no conftest to turn
+# x64 on (idempotent when the host process already did)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+from . import lapack as lp
+
+
+def _mat(mv, rows, cols, ld, dtype=np.float64):
+    """Column-major (ld, cols) buffer -> writable (rows, cols) view."""
+    buf = np.frombuffer(mv, dtype=dtype)
+    return buf.reshape((int(ld), int(cols)), order="F")[: int(rows), :]
+
+
+perm_to_swap_list = lp.perm_to_swap_list
+
+
+def dgesv(n, nrhs, a_mv, lda, ipiv_mv, b_mv, ldb) -> int:
+    A = _mat(a_mv, n, n, lda)
+    B = _mat(b_mv, n, nrhs, ldb)
+    from ..drivers import lu as lu_drv
+    from ..matrix.matrix import Matrix
+
+    nb = lp._nb(n)
+    Am = Matrix.from_global(np.ascontiguousarray(A), nb)
+    LU, piv, info = lu_drv.getrf(Am)
+    X = lu_drv.getrs(LU, piv, Matrix.from_global(np.ascontiguousarray(B), nb))
+    A[:, :] = np.asarray(LU.to_global())
+    B[:, :] = np.asarray(X.to_global())
+    perm = np.asarray(piv.perm)
+    ipiv = np.frombuffer(ipiv_mv, dtype=np.int64)
+    ipiv[: int(n)] = perm_to_swap_list(perm, int(n))
+    return int(info)
+
+
+def dposv(uplo, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
+    A = _mat(a_mv, n, n, lda)
+    B = _mat(b_mv, n, nrhs, ldb)
+    # factor explicitly so the caller's 'a' receives it (the LAPACK
+    # dposv contract: a <- factor, b <- X)
+    F, info = lp.potrf(chr(uplo), np.ascontiguousarray(A))
+    if info != 0:
+        return int(info)
+    lo = chr(uplo).lower().startswith("l")
+    Fm = np.asarray(F)
+    nn = int(n)
+    tri = np.tril_indices(nn) if lo else np.triu_indices(nn)
+    A[tri] = Fm[tri]
+    X = lp.trsm("l", chr(uplo), "n" if lo else "t", "n", 1.0, Fm,
+                np.ascontiguousarray(B))
+    X = lp.trsm("l", chr(uplo), "t" if lo else "n", "n", 1.0, Fm,
+                np.asarray(X))
+    B[:, :] = np.asarray(X)
+    return 0
+
+
+def dgels(m, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
+    A = _mat(a_mv, m, n, lda)
+    B = _mat(b_mv, max(m, n), nrhs, ldb)
+    X = lp.gels(np.ascontiguousarray(A), np.ascontiguousarray(B[: int(m), :]))
+    B[: int(n), :] = np.asarray(X)[: int(n), :]
+    return 0
+
+
+def dgetrf(m, n, a_mv, lda, ipiv_mv) -> int:
+    A = _mat(a_mv, m, n, lda)
+    LU, perm, info = lp.getrf(np.ascontiguousarray(A))
+    A[:, :] = LU
+    k = min(int(m), int(n))
+    ipiv = np.frombuffer(ipiv_mv, dtype=np.int64)
+    ipiv[:k] = perm_to_swap_list(np.asarray(perm), k)
+    return int(info)
+
+
+def dpotrf(uplo, n, a_mv, lda) -> int:
+    A = _mat(a_mv, n, n, lda)
+    F, info = lp.potrf(chr(uplo), np.ascontiguousarray(A))
+    if info == 0:
+        A[:, :] = F
+    return int(info)
+
+
+def dgeqrf(m, n, a_mv, lda, tau_mv) -> int:
+    A = _mat(a_mv, m, n, lda)
+    fac, taus = lp.geqrf(np.ascontiguousarray(A))
+    A[:, :] = np.asarray(fac)
+    tau = np.frombuffer(tau_mv, dtype=np.float64)
+    k = min(int(m), int(n))
+    tau[:k] = np.asarray(taus)[:k]
+    return 0
+
+
+def dsyev(jobz, uplo, n, a_mv, lda, w_mv) -> int:
+    A = _mat(a_mv, n, n, lda)
+    w, Z, info = lp.heev(chr(jobz), chr(uplo), np.ascontiguousarray(A))
+    w = np.asarray(w)
+    if info == 0 and not np.isfinite(w).all():
+        info = 1  # honor the header's '>0 = numerical failure' channel
+    wout = np.frombuffer(w_mv, dtype=np.float64)
+    wout[: int(n)] = w
+    if chr(jobz).lower() == "v" and Z is not None:
+        Zv = np.asarray(Z)
+        if not np.isfinite(Zv).all():
+            info = info or 1
+        A[:, :] = Zv
+    return int(info)
+
+
+def dgesvd(jobu, jobvt, m, n, a_mv, lda, s_mv, u_mv, ldu, vt_mv, ldvt) -> int:
+    A = _mat(a_mv, m, n, lda)
+    k = min(int(m), int(n))
+    want_u = chr(jobu).lower() != "n" and u_mv is not None
+    want_vt = chr(jobvt).lower() != "n" and vt_mv is not None
+    job = "s" if (want_u or want_vt) else "n"
+    s, U, Vt = lp.gesvd(job if want_u else "n", job if want_vt else "n",
+                        np.ascontiguousarray(A))
+    np.frombuffer(s_mv, dtype=np.float64)[:k] = np.asarray(s)[:k]
+    if want_u:
+        Um = _mat(u_mv, m, k, ldu)
+        Um[:, :] = np.asarray(U)[:, :k]
+    if want_vt:
+        Vm = _mat(vt_mv, k, n, ldvt)
+        Vm[:, :] = np.asarray(Vt)[:k, :]
+    return 0
+
+
+def dgemm(transa, transb, m, n, k, alpha, a_mv, lda, b_mv, ldb, beta,
+          c_mv, ldc) -> int:
+    ta, tb = chr(transa).lower(), chr(transb).lower()
+    A = _mat(a_mv, m if ta == "n" else k, k if ta == "n" else m, lda)
+    B = _mat(b_mv, k if tb == "n" else n, n if tb == "n" else k, ldb)
+    C = _mat(c_mv, m, n, ldc)
+    out = lp.gemm(ta, tb, alpha, np.ascontiguousarray(A),
+                  np.ascontiguousarray(B), beta, np.ascontiguousarray(C))
+    C[:, :] = np.asarray(out)
+    return 0

@@ -27,6 +27,23 @@ _SIDE = {"l": Side.Left, "r": Side.Right}
 _DIAG = {"n": Diag.NonUnit, "u": Diag.Unit}
 
 
+def perm_to_swap_list(perm, k: int) -> np.ndarray:
+    """Net forward permutation -> LAPACK 1-based sequential swap list
+    (the O(m) swap-target chase): under LAPACK swaps rows only move
+    forward, and a row is evicted from position p exactly at step p (to
+    the recorded target), so the position of row perm[i] is found by
+    chasing recorded targets from its home.  Pure numpy — shared by the
+    C bridge and compat.scalapack."""
+    pl = np.asarray(perm).tolist()
+    out = [0] * k
+    for i in range(k):
+        p_ = pl[i]
+        while p_ < i:
+            p_ = out[p_]
+        out[i] = p_
+    return np.asarray(out, dtype=np.int64) + 1
+
+
 def _nb(n: int) -> int:
     return min(int(os.environ.get("SLATE_LAPACK_NB", 256)), max(int(n), 1))
 

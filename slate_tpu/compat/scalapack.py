@@ -236,23 +236,12 @@ def pgetrf(m, n, a, desca, ipiv=None) -> Tuple[np.ndarray, int]:
     _scatter_back(desca, a, np.asarray(LU.to_global()))
     perm = np.asarray(piv.perm)
     if ipiv is not None:
-        # net forward perm -> sequential swap list (LAPACK convention:
-        # step i swaps rows i and ipiv[i]-1).  Under these swaps rows
-        # only ever move forward, and a row is evicted from position p
-        # exactly at step p (to the recorded target ipiv[p]), so the
-        # current position of row perm[i] is found by chasing recorded
-        # targets from its home — O(m) total work (each chase hop
-        # consumes one recorded eviction), no O(m) array bookkeeping
-        # per step.
+        # net forward perm -> LAPACK 1-based sequential swap list via
+        # the O(m) swap-target chase (shared with the C ABI bridge)
+        from .lapack import perm_to_swap_list
+
         k = min(len(ipiv), len(perm))
-        pl = perm.tolist()
-        out = [0] * k
-        for i in range(k):
-            p = pl[i]
-            while p < i:
-                p = out[p]
-            out[i] = p
-        ipiv[:k] = np.asarray(out, dtype=ipiv.dtype) + 1  # 1-based
+        ipiv[:k] = perm_to_swap_list(perm, k).astype(ipiv.dtype)
     return perm, int(info)
 
 

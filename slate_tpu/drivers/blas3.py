@@ -177,17 +177,13 @@ def _check_hemm_dims(side, A, B, C):
 
 
 def _hemm_spmd(side, alpha, A, B, beta, C, opts):
-    """Distributed hemm/symm: mirror the stored triangle into full tiles
-    and run the SUMMA pipeline (reference: hemmA's broadcast/reduce DAG,
-    src/hemmA.cc).
-
-    The mirror materializes through one global-array round trip; under
-    jit GSPMD lowers it to collectives.  A storage-level tile mirror
-    would avoid it but needs a reshard for p != q grids — noted as a
-    future optimization."""
+    """Distributed hemm/symm via the Hermitian SUMMA (reference: hemmA's
+    broadcast/reduce DAG, src/hemmA.cc): the op-full panel of A is
+    assembled per step from the STORED triangle's column + row panels —
+    no full_global() mirror round trip."""
     if not (_is_distributed(C) and get_option(opts, Option.UseShardMap)):
         return None
-    if C.op != Op.NoTrans:
+    if C.op != Op.NoTrans or A.op != Op.NoTrans:
         return None
     Br = B.resolved()
     layA, layB, layC = A.layout, Br.layout, C.layout
@@ -202,15 +198,22 @@ def _hemm_spmd(side, alpha, A, B, beta, C, opts):
         and (layA.p, layA.q) == (layC.p, layC.q) == (layB.p, layB.q)
     ):
         return None
-    Af = tiles_from_global(A.full_global().astype(A.dtype), layA)
-    if side == Side.Left:
-        data = spmd_blas.summa_gemm(
-            C.grid, alpha, Af, layA, Br.data, Br.layout, beta, C.data, layC
-        )
-    else:
-        data = spmd_blas.summa_gemm(
-            C.grid, alpha, Br.data, Br.layout, Af, layA, beta, C.data, layC
-        )
+    data = spmd_blas.spmd_hemm(
+        C.grid,
+        side == Side.Left,
+        alpha,
+        A.data,
+        layA,
+        A.uplo == Uplo.Lower,
+        Br.data,
+        Br.layout,
+        beta,
+        C.data,
+        layC,
+        # complex SYMMETRIC operands mirror without conjugation (the
+        # class-dispatched full_global did this before)
+        hermitian=isinstance(A, HermitianMatrix),
+    )
     return C._with(data=data)
 
 

@@ -152,22 +152,23 @@ def hb2st(
         DW = jax.vmap(densify)(strips)
         DW2, v, tau = jax.vmap(chase_window)(DW, r0)
         strips2 = jax.vmap(bandify)(DW2, strips)
-        # Scatter back ONLY the 2b stored columns a task can modify: a
+        # Write back ONLY the 2b stored columns a task can modify: a
         # task writes rows/cols R = [w0+r0, w0+r0+b-1] (r0 <= b), so its
         # modified stored entries W[d, c] all have c <= w0 + 2b - 1.
         # Concurrent windows sit 3b-1 columns apart, so these truncated
-        # scatter ranges are disjoint — writing the full L-wide strip
-        # would re-deposit stale copies of the 2 overlap columns a
-        # neighboring task just updated.
-        cols = jnp.where(
-            valid[:, None], w0c[:, None] + jnp.arange(2 * b)[None, :],
-            n_pad + 1,
-        )
-        cols_f = cols.reshape(-1)
-        vals_f = jnp.moveaxis(strips2[:, :, : 2 * b], 1, 0).reshape(
-            2 * b + 1, -1
-        )
-        W = W.at[:, cols_f].set(vals_f, mode="drop")
+        # ranges are disjoint — writing the full L-wide strip would
+        # re-deposit stale copies of the 2 overlap columns a neighboring
+        # task just updated.  Each window writes with ONE contiguous
+        # dynamic_update_slice (NSLOT static) instead of one big
+        # elementwise scatter: TPU scatters move ~an element per cycle,
+        # and this write was the dominant superstep cost at large n.
+        # Invalid windows were clamped to w0 = n_pad - L; they must
+        # write ZEROS there (not their dummy chase output): the clamp
+        # region overlaps the read range of late valid windows for
+        # b > 8, and it is zero-initialized padding.
+        for i in range(NSLOT):
+            blk = jnp.where(valid[i], strips2[i][:, : 2 * b], 0.0)
+            W = lax.dynamic_update_slice(W, blk, (0, w0c[i]))
         s_w = jnp.where(valid, s, n_sweeps + 1)
         VS = VS.at[s_w, j].set(v, mode="drop")
         TAUS = TAUS.at[s_w, j].set(tau, mode="drop")

@@ -426,3 +426,137 @@ def spmd_trmm(
     spec = P(ROW_AXIS, COL_AXIS)
     fn = shard_map(local, mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
     return fn(TA, TB)
+
+
+def spmd_hemm(
+    grid: ProcessGrid,
+    side_left: bool,
+    alpha,
+    TA: jnp.ndarray,
+    layA: TileLayout,
+    lower: bool,
+    TB: jnp.ndarray,
+    layB: TileLayout,
+    beta,
+    TC: jnp.ndarray,
+    layC: TileLayout,
+    hermitian: bool = True,
+) -> jnp.ndarray:
+    """C = alpha A B + beta C (side_left) or alpha B A + beta C, with A
+    Hermitian and ONE triangle stored (reference: src/hemmA.cc's
+    broadcast/reduce DAG).
+
+    SUMMA over k where the op-full tile column (or row) k of A is
+    assembled on the fly from the stored triangle: the stored tile
+    column supplies the stored side of the diagonal and the stored tile
+    ROW supplies the mirror A(i, k) = A(k, i)^H on the other side — two
+    panel gathers per step, no global mirror round trip (the previous
+    implementation materialized full_global())."""
+    p, q = grid.p, grid.q
+    mb = layA.mb
+    nt = layA.nt
+    n = layA.n
+    mtlA, ntlA = layA.mtl, layA.ntl
+    mtlB, ntlB = layB.mtl, layB.ntl
+    acc_t = _acc_dtype(TC.dtype)
+    complex_t = jnp.issubdtype(TC.dtype, jnp.complexfloating)
+    row_scatter = jnp.asarray(layA.row_scatter)
+    col_scatter = jnp.asarray(layA.col_scatter)
+
+    def cj(x):
+        # the mirror conjugates for Hermitian A only: complex SYMMETRIC
+        # operands (symm) mirror without conjugation
+        return jnp.conj(x) if (complex_t and hermitian) else x
+
+    def local(ta, tb, tc):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        gi = jnp.arange(layC.mtl) * p + r
+        gj = jnp.arange(layC.ntl) * q + c
+
+        def gather_colA(k):
+            loc = lax.dynamic_slice_in_dim(ta, k // q, 1, axis=1)[:, 0]
+            aq = lax.all_gather(loc, COL_AXIS)
+            rows = lax.dynamic_index_in_dim(aq, k % q, 0, keepdims=False)
+            full = lax.all_gather(rows, ROW_AXIS)
+            return full.reshape(p * mtlA, mb, mb)[row_scatter]
+
+        def gather_rowA(k):
+            loc = lax.dynamic_slice_in_dim(ta, k // p, 1, axis=0)[0]
+            ap = lax.all_gather(loc, ROW_AXIS)
+            cols = lax.dynamic_index_in_dim(ap, k % p, 0, keepdims=False)
+            full = lax.all_gather(cols, COL_AXIS)
+            return full.reshape(q * ntlA, mb, mb)[col_scatter]
+
+        t_idx_r = jnp.arange(layA.P)
+        t_idx_c = jnp.arange(layA.Q)
+        a_el = jnp.arange(mb)
+
+        def herm_col(k):
+            """Op-full tile column k of Hermitian A, natural order."""
+            colp = gather_colA(k)
+            rowp = _resize_rows_3d(gather_rowA(k), layA.P)
+            mirror = cj(jnp.swapaxes(rowp, -1, -2))
+            gr = t_idx_r[:, None, None] * mb + a_el[:, None]
+            gc = k * mb + a_el[None, None, :]
+            from_stored = (gr >= gc) if lower else (gr <= gc)
+            valid = (gr < n) & (gc < n)
+            return jnp.where(valid & from_stored, colp, 0) + jnp.where(
+                valid & ~from_stored, mirror, 0
+            )
+
+        def herm_row(k):
+            """Op-full tile row k of Hermitian A, natural order."""
+            rowp = gather_rowA(k)
+            colp = _resize_rows_3d(gather_colA(k), layA.Q)
+            mirror = cj(jnp.swapaxes(colp, -1, -2))
+            gr = k * mb + a_el[None, :, None]
+            gc = t_idx_c[:, None, None] * mb + a_el[None, None, :]
+            from_stored = (gr >= gc) if lower else (gr <= gc)
+            valid = (gr < n) & (gc < n)
+            return jnp.where(valid & from_stored, rowp, 0) + jnp.where(
+                valid & ~from_stored, mirror, 0
+            )
+
+        def step(k, acc):
+            if side_left:
+                a_col = herm_col(k)[gi]
+                b_row = lax.dynamic_slice_in_dim(tb, k // p, 1, axis=0)[0]
+                own = r == (k % p)
+                b_row = lax.psum(
+                    jnp.where(own, b_row, jnp.zeros_like(b_row)), ROW_AXIS
+                )
+                upd = jnp.einsum(
+                    "iab,jbc->ijac", a_col, b_row,
+                    preferred_element_type=acc_t,
+                )
+            else:
+                a_row = herm_row(k)[gj]
+                b_col = lax.dynamic_slice_in_dim(tb, k // q, 1, axis=1)[:, 0]
+                own = c == (k % q)
+                b_col = lax.psum(
+                    jnp.where(own, b_col, jnp.zeros_like(b_col)), COL_AXIS
+                )
+                upd = jnp.einsum(
+                    "iab,jbc->ijac", b_col, a_row,
+                    preferred_element_type=acc_t,
+                )
+            return acc + upd
+
+        acc = lax.fori_loop(0, nt, step, jnp.zeros(tc.shape, acc_t))
+        out = alpha * acc + beta * tc.astype(acc_t)
+        return out.astype(tc.dtype)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(
+        local, mesh=grid.mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )
+    return fn(TA, TB, TC)
+
+
+def _resize_rows_3d(x: jnp.ndarray, rows: int) -> jnp.ndarray:
+    if x.shape[0] == rows:
+        return x
+    if x.shape[0] > rows:
+        return x[:rows]
+    return jnp.pad(x, ((0, rows - x.shape[0]), (0, 0), (0, 0)))

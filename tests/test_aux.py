@@ -230,3 +230,31 @@ def test_hemm_dimension_mismatch_raises(rng, grid22):
     C = Matrix.from_global(np.zeros((33, 4)), 16, grid=grid22)
     with pytest.raises(DimensionError):
         blas3.hemm(Side.Left, 1.0, A, B, 0.0, C)
+
+
+class TestDebugDumps:
+    """aux/debug.py (reference: Debug.cc:66-340 tile maps + lives)."""
+
+    def test_dump_single(self, rng):
+        from slate_tpu.aux import debug
+        from slate_tpu.matrix.matrix import Matrix
+
+        A = Matrix.from_global(rng.standard_normal((50, 34)), 16)
+        s = debug.dump(A, "t")
+        assert "tiles_map" in s and "storage_map" in s
+        assert "all live tiles finite" in s
+
+    def test_dump_distributed_and_nan(self, rng, grid22):
+        import numpy as np
+
+        from slate_tpu.aux import debug
+        from slate_tpu.matrix.matrix import Matrix
+
+        A0 = rng.standard_normal((64, 64))
+        A0[3, 3] = np.nan
+        A = Matrix.from_global(A0, 16, grid=grid22)
+        s = debug.dump(A, "d")
+        assert "NON-FINITE tiles" in s
+        assert "PartitionSpec" in s or "sharding:" in s
+        # ownership map shows the 2x2 cyclic pattern
+        assert "0,0" in s and "1,1" in s

@@ -239,3 +239,69 @@ def test_hemm_distributed_spmd(rng, grid22):
         Matrix.from_global(np.zeros((n, w)), nb, grid=grid22),
     )
     np.testing.assert_allclose(np.asarray(out.to_global()), 2.0 * C0 @ B0, atol=1e-11)
+
+
+def test_hemm_distributed_no_mirror(rng, grid22, monkeypatch):
+    """The distributed hemm assembles A's panels from the stored
+    triangle — full_global must never be called."""
+    from slate_tpu.matrix.base import BaseMatrix
+
+    n, w, nb = 64, 32, 16
+    C0 = rng.standard_normal((n, n)); C0 = (C0 + C0.T) / 2
+    B0 = rng.standard_normal((n, w))
+    A = HermitianMatrix.from_global(C0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    C = Matrix.from_global(np.zeros((n, w)), nb, grid=grid22)
+
+    def boom(self, *a, **kw):  # pragma: no cover
+        raise AssertionError("gather in distributed hemm")
+
+    monkeypatch.setattr(HermitianMatrix, "full_global", boom)
+    monkeypatch.setattr(BaseMatrix, "to_global", boom)
+    out = blas3.hemm(Side.Left, 1.0, A, B, 0.0, C)
+    assert out.data.shape == C.data.shape
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_hemm_right_distributed(rng, grid42, uplo):
+    n, w, nb = 64, 48, 8
+    A0 = rng.standard_normal((n, n)); A0 = (A0 + A0.T) / 2
+    B0 = rng.standard_normal((w, n))
+    A = HermitianMatrix.from_global(A0, nb, grid=grid42, uplo=uplo)
+    B = Matrix.from_global(B0, nb, grid=grid42)
+    C = Matrix.from_global(rng.standard_normal((w, n)), nb, grid=grid42)
+    C0 = np.asarray(C.to_global())
+    out = blas3.hemm(Side.Right, 1.5, A, B, 0.5, C)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), 1.5 * B0 @ A0 + 0.5 * C0, atol=1e-11 * n
+    )
+
+
+def test_hemm_complex_distributed(rng, grid22):
+    n, w, nb = 48, 32, 16
+    A0 = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    A0 = (A0 + A0.conj().T) / 2
+    B0 = rng.standard_normal((n, w)) + 1j * rng.standard_normal((n, w))
+    A = HermitianMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    C = Matrix.from_global(np.zeros((n, w), complex), nb, grid=grid22)
+    out = blas3.hemm(Side.Left, 1.0, A, B, 0.0, C)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), A0 @ B0, atol=1e-11 * n
+    )
+
+
+def test_symm_complex_distributed_no_conj(rng, grid22):
+    """Complex SYMMETRIC (not Hermitian) symm must mirror WITHOUT
+    conjugation on the spmd path."""
+    n, w, nb = 48, 32, 16
+    A0 = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2  # complex symmetric: A == A^T
+    B0 = rng.standard_normal((n, w)) + 1j * rng.standard_normal((n, w))
+    A = SymmetricMatrix.from_global(A0, nb, grid=grid22, uplo=Uplo.Lower)
+    B = Matrix.from_global(B0, nb, grid=grid22)
+    C = Matrix.from_global(np.zeros((n, w), complex), nb, grid=grid22)
+    out = blas3.symm(Side.Left, 1.0, A, B, 0.0, C)
+    np.testing.assert_allclose(
+        np.asarray(out.to_global()), A0 @ B0, atol=1e-11 * n
+    )

@@ -1,0 +1,123 @@
+"""C ABI tests (reference: the lapack_api/c_api test coverage): compile
+c_api/slate_tpu_c.c at test time, load it into this process (the
+embedded-interpreter path detects the live interpreter), and drive the
+LAPACK-style entry points through ctypes with residual checks."""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def clib(tmp_path_factory):
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    build = tmp_path_factory.mktemp("c_api")
+    so = build / "libslate_tpu.so"
+    inc = sysconfig.get_paths()["include"]
+    cmd = [
+        cc, "-O1", "-fPIC", "-shared", f"-I{inc}",
+        os.path.join(ROOT, "c_api", "slate_tpu_c.c"), "-o", str(so),
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"c_api compile failed: {r.stderr[-800:]}")
+    lib = ctypes.CDLL(str(so), mode=ctypes.RTLD_GLOBAL)
+    lib.slate_tpu_init.restype = ctypes.c_int
+    assert lib.slate_tpu_init() == 0
+    return lib
+
+
+def _dp(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _ip(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+I64 = ctypes.c_int64
+
+
+def test_c_dgesv(clib, rng):
+    n, nrhs = 48, 3
+    A0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    B0 = rng.standard_normal((n, nrhs))
+    a = np.asfortranarray(A0)
+    b = np.asfortranarray(B0)
+    ipiv = np.zeros(n, np.int64)
+    info = clib.slate_tpu_dgesv(
+        I64(n), I64(nrhs), _dp(a), I64(n), _ip(ipiv), _dp(b), I64(n)
+    )
+    assert info == 0
+    assert np.abs(A0 @ b - B0).max() < 1e-10
+    # ipiv is a valid 1-based swap list reproducing the permutation
+    assert ipiv.min() >= 1 and ipiv.max() <= n
+    # a holds L\U consistent with the swaps
+    rows = list(range(n))
+    for i, j1 in enumerate(ipiv):
+        j = int(j1) - 1
+        rows[i], rows[j] = rows[j], rows[i]
+    L = np.tril(a, -1) + np.eye(n)
+    U = np.triu(a)
+    assert np.abs(L @ U - A0[rows]).max() < 1e-10 * np.abs(A0).max() * n
+
+
+def test_c_dposv(clib, rng):
+    n, nrhs = 40, 2
+    A0 = rng.standard_normal((n, n))
+    A0 = A0 @ A0.T + n * np.eye(n)
+    B0 = rng.standard_normal((n, nrhs))
+    a = np.asfortranarray(A0)
+    b = np.asfortranarray(B0)
+    info = clib.slate_tpu_dposv(
+        ctypes.c_char(b"l"), I64(n), I64(nrhs), _dp(a), I64(n), _dp(b), I64(n)
+    )
+    assert info == 0
+    assert np.abs(A0 @ b - B0).max() < 1e-10
+
+
+def test_c_dpotrf_info(clib, rng):
+    n = 24
+    A0 = -np.eye(n)  # not SPD
+    a = np.asfortranarray(A0)
+    info = clib.slate_tpu_dpotrf(ctypes.c_char(b"l"), I64(n), _dp(a), I64(n))
+    assert info != 0
+
+
+def test_c_dsyev(clib, rng):
+    n = 32
+    A0 = rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2
+    a = np.asfortranarray(A0)
+    w = np.zeros(n)
+    info = clib.slate_tpu_dsyev(
+        ctypes.c_char(b"v"), ctypes.c_char(b"l"), I64(n), _dp(a), I64(n), _dp(w)
+    )
+    assert info == 0
+    np.testing.assert_allclose(w, np.linalg.eigvalsh(A0), atol=1e-9)
+    assert np.abs(A0 @ a - a * w[None, :]).max() < 1e-9 * n
+
+
+def test_c_dgemm(clib, rng):
+    m, n, k = 24, 20, 28
+    A0 = rng.standard_normal((m, k))
+    B0 = rng.standard_normal((k, n))
+    C0 = rng.standard_normal((m, n))
+    a, b, c = map(np.asfortranarray, (A0, B0, C0))
+    info = clib.slate_tpu_dgemm(
+        ctypes.c_char(b"n"), ctypes.c_char(b"n"),
+        I64(m), I64(n), I64(k), ctypes.c_double(2.0),
+        _dp(a), I64(m), _dp(b), I64(k), ctypes.c_double(0.5), _dp(c), I64(m),
+    )
+    assert info == 0
+    np.testing.assert_allclose(c, 2.0 * A0 @ B0 + 0.5 * C0, atol=1e-11)

@@ -1,0 +1,127 @@
+"""Factorization throughput breakdown on the chip (round-4 ceiling
+analysis): measures the f64 gemm denominator at n=8192, the three
+factorization totals, their PANEL-ONLY costs, and exact-shape
+trailing-gemm proxies, so BENCH_NOTES.md can attribute the gap between
+the factorization rates and the chip's own gemm rate.
+
+Run: python tools/profile_factor.py [--n 8192]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/jax_comp")
+)
+
+import numpy as np
+
+
+def bench(fn, args, trials=3, perturb=None):
+    """Best-of wall-clock with input perturbation to defeat the tunnel's
+    result cache (BENCH_NOTES methodology)."""
+    import jax
+
+    best = float("inf")
+    for t in range(trials):
+        a = args if perturb is None else perturb(args, t)
+        jax.block_until_ready(a)
+        t0 = time.time()
+        out = jax.block_until_ready(fn(*a))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=8192)
+    args = ap.parse_args()
+    n = args.n
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    print(f"device: {jax.devices()[0]}, n={n}", flush=True)
+    key = jax.random.PRNGKey(0)
+    res = {}
+
+    def put(name, seconds, flops):
+        gf = flops / seconds / 1e9
+        res[name] = {"seconds": round(seconds, 4), "gflops": round(gf, 1)}
+        print(f"{name:32s} {seconds:8.3f}s  {gf:9.1f} GF/s", flush=True)
+
+    nb = 512
+
+    # -- denominator: f64 gemm at the same n ---------------------------
+    A = jax.random.normal(key, (n, n), jnp.float64)
+    B = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float64)
+    gemm = jax.jit(lambda a, b: a @ b)
+    pert = lambda ar, t: (ar[0] + t * 1e-13, ar[1])
+    s = bench(gemm, (A, B), perturb=pert)
+    put("dgemm", s, 2.0 * n**3)
+
+    # -- totals --------------------------------------------------------
+    from slate_tpu.ops.chol_kernels import blocked_potrf
+    from slate_tpu.ops.lu_fast import blocked_getrf_fast, _lu_panel_strips
+    from slate_tpu.ops.qr_fast import geqrf_fast, _qr_panel_strips
+
+    S = A @ A.T + n * jnp.eye(n, dtype=jnp.float64)
+    s = bench(jax.jit(lambda g: blocked_potrf(g, nb)), (S,), perturb=pert)
+    put("dpotrf_total", s, n**3 / 3.0)
+
+    s = bench(
+        jax.jit(lambda g: blocked_getrf_fast(g, nb)), (A,), perturb=pert
+    )
+    put("dgetrf_total", s, 2.0 * n**3 / 3.0)
+
+    s = bench(jax.jit(lambda g: geqrf_fast(g, nb)), (A,), perturb=pert)
+    put("dgeqrf_total", s, 4.0 * n**3 / 3.0)
+
+    # -- panel-only costs (the sequential micro-loops) ------------------
+    P = jax.random.normal(jax.random.PRNGKey(2), (n, nb), jnp.float64)
+    s = bench(jax.jit(lambda p: _qr_panel_strips(p, 32)), (P,), perturb=pert)
+    nt = n // nb
+    put("qr_panel(mxnb) x nt", s * nt, nt * (2.0 * n * nb * nb))
+
+    s = bench(
+        jax.jit(lambda p: _lu_panel_strips(p, 32)), (P,), perturb=pert
+    )
+    put("lu_panel(mxnb) x nt", s * nt, nt * (n * nb * nb))
+
+    from slate_tpu.ops.chol_kernels import chol_unblocked
+
+    D = S[:nb, :nb]
+    s = bench(jax.jit(lambda d: chol_unblocked(d, 16)), (D,), perturb=pert)
+    put("chol_diag(nbxnb) x nt", s * nt, nt * (nb**3 / 3.0))
+
+    # -- trailing-gemm proxy: the exact update shapes, chained ----------
+    # right-looking trailing updates ~ sum_k (n - k nb) x nb @ nb x (n - k nb)
+    def trailing_chain(a):
+        out = jnp.zeros((), jnp.float64)
+        acc = a
+        for k in range(nt - 1):
+            h = n - (k + 1) * nb
+            L = lax_slice(acc, h, nb)
+            acc = acc.at[:h, :h].add(-L @ jnp.swapaxes(L, 0, 1) * 1e-20)
+            out = out + acc[0, 0]
+        return out
+
+    def lax_slice(a, h, w):
+        return a[:h, :w]
+
+    s = bench(jax.jit(trailing_chain), (A,), perturb=pert)
+    fl = sum(2.0 * (n - (k + 1) * nb) ** 2 * nb for k in range(nt - 1))
+    put("trailing_syrk_chain", s, fl)
+
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
