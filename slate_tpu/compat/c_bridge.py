@@ -10,15 +10,25 @@ Returns the LAPACK info code.
 
 from __future__ import annotations
 
+import functools
+
 import jax
-
-# the C ABI traffics in doubles; the embedding has no conftest to turn
-# x64 on (idempotent when the host process already did)
-jax.config.update("jax_enable_x64", True)
-
 import numpy as np
 
 from . import lapack as lp
+
+
+def _with_x64(fn):
+    """Run a bridge call with x64 enabled, scoped to the call: the C ABI
+    traffics in doubles, but a host Python process that dlopens the
+    library must not have its global dtype promotion flipped."""
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        with jax.enable_x64(True):
+            return fn(*a, **kw)
+
+    return wrapper
 
 
 def _mat(mv, rows, cols, ld, dtype=np.float64):
@@ -30,6 +40,7 @@ def _mat(mv, rows, cols, ld, dtype=np.float64):
 perm_to_swap_list = lp.perm_to_swap_list
 
 
+@_with_x64
 def dgesv(n, nrhs, a_mv, lda, ipiv_mv, b_mv, ldb) -> int:
     A = _mat(a_mv, n, n, lda)
     B = _mat(b_mv, n, nrhs, ldb)
@@ -48,6 +59,7 @@ def dgesv(n, nrhs, a_mv, lda, ipiv_mv, b_mv, ldb) -> int:
     return int(info)
 
 
+@_with_x64
 def dposv(uplo, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
     A = _mat(a_mv, n, n, lda)
     B = _mat(b_mv, n, nrhs, ldb)
@@ -69,6 +81,7 @@ def dposv(uplo, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
     return 0
 
 
+@_with_x64
 def dgels(m, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
     A = _mat(a_mv, m, n, lda)
     B = _mat(b_mv, max(m, n), nrhs, ldb)
@@ -77,6 +90,7 @@ def dgels(m, n, nrhs, a_mv, lda, b_mv, ldb) -> int:
     return 0
 
 
+@_with_x64
 def dgetrf(m, n, a_mv, lda, ipiv_mv) -> int:
     A = _mat(a_mv, m, n, lda)
     LU, perm, info = lp.getrf(np.ascontiguousarray(A))
@@ -87,6 +101,7 @@ def dgetrf(m, n, a_mv, lda, ipiv_mv) -> int:
     return int(info)
 
 
+@_with_x64
 def dpotrf(uplo, n, a_mv, lda) -> int:
     A = _mat(a_mv, n, n, lda)
     F, info = lp.potrf(chr(uplo), np.ascontiguousarray(A))
@@ -95,6 +110,7 @@ def dpotrf(uplo, n, a_mv, lda) -> int:
     return int(info)
 
 
+@_with_x64
 def dgeqrf(m, n, a_mv, lda, tau_mv) -> int:
     A = _mat(a_mv, m, n, lda)
     fac, taus = lp.geqrf(np.ascontiguousarray(A))
@@ -105,6 +121,7 @@ def dgeqrf(m, n, a_mv, lda, tau_mv) -> int:
     return 0
 
 
+@_with_x64
 def dsyev(jobz, uplo, n, a_mv, lda, w_mv) -> int:
     A = _mat(a_mv, n, n, lda)
     w, Z, info = lp.heev(chr(jobz), chr(uplo), np.ascontiguousarray(A))
@@ -121,6 +138,7 @@ def dsyev(jobz, uplo, n, a_mv, lda, w_mv) -> int:
     return int(info)
 
 
+@_with_x64
 def dgesvd(jobu, jobvt, m, n, a_mv, lda, s_mv, u_mv, ldu, vt_mv, ldvt) -> int:
     A = _mat(a_mv, m, n, lda)
     k = min(int(m), int(n))
@@ -139,6 +157,7 @@ def dgesvd(jobu, jobvt, m, n, a_mv, lda, s_mv, u_mv, ldu, vt_mv, ldvt) -> int:
     return 0
 
 
+@_with_x64
 def dgemm(transa, transb, m, n, k, alpha, a_mv, lda, b_mv, ldb, beta,
           c_mv, ldc) -> int:
     ta, tb = chr(transa).lower(), chr(transb).lower()

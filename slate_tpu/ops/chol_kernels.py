@@ -152,14 +152,16 @@ def _chol_panels(G: jnp.ndarray, nb: int) -> jnp.ndarray:
         D = chol_unblocked(T[:w, :w])
         rest = n - k0 - w
         if rest > 0:
-            # full-height panel solve: rows above the diag block are
-            # junk but get sliced away; keeps one trsm shape for all k
-            full_col = jnp.concatenate([jnp.zeros((k0, w), G.dtype), T[:, :w]], axis=0)
-            sol = lax.linalg.triangular_solve(
-                D, full_col, left_side=False, lower=True, transpose_a=True,
-                conjugate_a=cplx,
+            # explicit (w, w) inverse + MXU gemm instead of a
+            # full-height vendor trsm: the vendor triangular_solve with
+            # a fat RHS is schedule-bound on this toolchain (~10-25 ms
+            # per panel) while the small trsm + gemm ride the MXU —
+            # the same MAGMA recipe blocked_potrf uses at the coarse
+            # level
+            Dinv = lax.linalg.triangular_solve(
+                D, jnp.eye(w, dtype=G.dtype), left_side=True, lower=True
             )
-            L21 = sol[k0 + w:]
+            L21 = _dot(T[w:, :w], _conj(Dinv).T)
             T = T[w:, w:] - _dot(L21, _conj(L21).T)
             colk = jnp.concatenate(
                 [jnp.zeros((k0, w), G.dtype), D, L21], axis=0

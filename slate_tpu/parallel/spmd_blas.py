@@ -492,6 +492,15 @@ def spmd_hemm(
         t_idx_c = jnp.arange(layA.Q)
         a_el = jnp.arange(mb)
 
+        def realify_diag(panel, gr, gc):
+            # zhemm contract: the Hermitian diagonal's imaginary parts
+            # "need not be set" — drop them (full_global did the same)
+            if not (complex_t and hermitian):
+                return panel
+            return jnp.where(
+                gr == gc, jnp.real(panel).astype(panel.dtype), panel
+            )
+
         def herm_col(k):
             """Op-full tile column k of Hermitian A, natural order."""
             colp = gather_colA(k)
@@ -501,9 +510,10 @@ def spmd_hemm(
             gc = k * mb + a_el[None, None, :]
             from_stored = (gr >= gc) if lower else (gr <= gc)
             valid = (gr < n) & (gc < n)
-            return jnp.where(valid & from_stored, colp, 0) + jnp.where(
+            out = jnp.where(valid & from_stored, colp, 0) + jnp.where(
                 valid & ~from_stored, mirror, 0
             )
+            return realify_diag(out, gr, gc)
 
         def herm_row(k):
             """Op-full tile row k of Hermitian A, natural order."""
@@ -514,9 +524,10 @@ def spmd_hemm(
             gc = t_idx_c[:, None, None] * mb + a_el[None, None, :]
             from_stored = (gr >= gc) if lower else (gr <= gc)
             valid = (gr < n) & (gc < n)
-            return jnp.where(valid & from_stored, rowp, 0) + jnp.where(
+            out = jnp.where(valid & from_stored, rowp, 0) + jnp.where(
                 valid & ~from_stored, mirror, 0
             )
+            return realify_diag(out, gr, gc)
 
         def step(k, acc):
             if side_left:

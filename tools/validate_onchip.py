@@ -29,6 +29,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="smaller sizes")
     ap.add_argument("--heev-only", action="store_true")
+    ap.add_argument("--n-eig", type=int, default=0,
+                    help="override heev size (default 2048, quick: 1024)")
     args = ap.parse_args()
 
     import jax
@@ -105,7 +107,7 @@ def main() -> int:
 
     # -- heev with vectors through the driver (he2hb + hb2st + stedc +
     #    back-transforms), the full flagship path ------------------------
-    n_eig = 1024 if args.quick else 2048
+    n_eig = args.n_eig or (1024 if args.quick else 2048)
     from slate_tpu.drivers import eig
     from slate_tpu.enums import Uplo
     from slate_tpu.matrix.matrix import HermitianMatrix
@@ -121,8 +123,6 @@ def main() -> int:
     # so each stage compiles separately (also giving the per-stage
     # timing breakdown for the wall-clock analysis); glue between stages
     # is a handful of dispatches at ~100 ms tunnel latency each.
-    from functools import partial
-
     from slate_tpu.matrix.matrix import Matrix as _M
     from slate_tpu.ops import bulge, stedc as stedc_mod
     from slate_tpu.ops.bulge import hb2st as _hb2st
@@ -145,7 +145,7 @@ def main() -> int:
         )
         return W, V.data, T.T
 
-    @partial(jax.jit, static_argnames=())
+    @jax.jit
     def _stage2(W):
         return _hb2st(W, n_eig, b)
 
