@@ -135,8 +135,18 @@ def redistribute(A: BaseMatrix, B: BaseMatrix, opts=None) -> BaseMatrix:
     One storage-to-storage gather: every element of B's tile array
     addresses its source element in A's tile array directly (no padded
     global intermediate); under sharded inputs GSPMD lowers the gather
-    to the needed collectives — the XLA-native tile re-send."""
+    to the needed collectives — which it is free to implement by
+    replicating A, so distributed inputs are recorded as a gathered
+    route (internal/fallbacks accounting)."""
     _check_same_shape(A, B)
+    from ..matrix.base import is_distributed as _is_dist
+
+    if _is_dist(A) or _is_dist(B):
+        from ..internal import fallbacks
+
+        fallbacks.record(
+            "redistribute", opts, "GSPMD element gather may replicate A"
+        )
     Ar, Br = A.resolved(), B.resolved()
     layA, layB = Ar.layout, Br.layout
 

@@ -276,12 +276,14 @@ def heev(
     bulge chase (ops/bulge.py) when the band is genuinely narrow
     (n > 4 nb); small problems dense-eigensolve the band directly.
     MethodEig.Bisection forces the two-stage chase + Sturm bisection."""
+    import jax
+
     from ..ops import bulge
+    from ..parallel.band_gather import band_storage_tiles, spmd_band_storage
 
     band, V, T = he2hb(A, opts)
     n = A.n
     b = A.layout.nb
-    Gband = band.to_global()
 
     method = get_option(opts, Option.MethodEig, MethodEig.Auto)
     if isinstance(method, str):
@@ -290,8 +292,40 @@ def heev(
         method == MethodEig.Bisection or (method == MethodEig.Auto and n > 4 * b)
     )
     if two_stage:
-        W = bulge.band_to_storage(Gband, b, n + 4 * b + 8)
-        d, e, u, VS, TAUS = bulge.hb2st(W, n, b)
+        # band-limited stage gather (he2hbGather semantics): the packed
+        # (2b+1, n_pad) chase storage is built straight from the <= 2
+        # relevant tile diagonals — O(n kd) data, never the dense n x n
+        # (reference: HermitianBandMatrix.hh:310, heev.cc:133-151)
+        n_pad = n + 4 * b + 8
+        if (
+            _is_distributed(band)
+            and get_option(opts, Option.UseShardMap)
+            and band.layout.mb == band.layout.nb
+        ):
+            W = spmd_band_storage(band.grid, band.data, band.layout, n_pad)
+        else:
+            W = band_storage_tiles(band.data, band.layout, n_pad)
+        # stage 2: the native host chaser when running eagerly on real
+        # data (the reference's hb2st is likewise a CPU-threaded stage
+        # over the gathered band, src/hb2st.cc:44-187); the jittable
+        # on-device wavefront otherwise
+        from .. import native as _native
+
+        host_ok = (
+            not isinstance(W, jax.core.Tracer)
+            and not A.is_complex
+            and W.dtype == jnp.float64
+            and _native.hb2st_available()
+        )
+        if host_ok:
+            d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n, b)
+            d = jnp.asarray(d_h)
+            e = jnp.asarray(e_h)
+            u = jnp.ones((n,), A.dtype)
+            VS = jnp.asarray(VS_h)
+            TAUS = jnp.asarray(TAUS_h)
+        else:
+            d, e, u, VS, TAUS = bulge.hb2st(W, n, b)
         if not vectors:
             return bulge.tridiag_eigvals_bisect(d, e), None
         # tridiagonal stage with vectors (steqr role): dense vendor +
@@ -365,9 +399,50 @@ def hegst(
     opts: Optional[Options] = None,
 ) -> HermitianMatrix:
     """Reduce the generalized problem to standard form (reference:
-    src/hegst.cc): itype 1: C = L^-1 A L^-H; itype 2/3: C = L^H A L."""
+    src/hegst.cc + internal_hegst.cc): itype 1: C = L^-1 A L^-H;
+    itype 2/3: C = L^H A L.
+
+    Distributed itype-1 inputs run the SPMD composition
+    (parallel/spmd_hegst.py): stored-triangle mirror assembly + the two
+    column-pipeline trsm sweeps — no global gather."""
+    from ..enums import Diag
+
+    if (
+        itype == 1
+        and _is_distributed(A)
+        and get_option(opts, Option.UseShardMap)
+        and A.uplo == Uplo.Lower
+        and A.op == Op.NoTrans
+        and L.uplo == Uplo.Lower
+        and L.op == Op.NoTrans
+        and A.layout.mb == A.layout.nb
+        and L.layout.mb == L.layout.nb
+        and A.layout.nb == L.layout.nb
+        and A.layout.nt == L.layout.nt
+    ):
+        from ..parallel.spmd_hegst import spmd_hegst_itype1
+
+        Ct = spmd_hegst_itype1(
+            A.grid,
+            A.data,
+            A.layout,
+            L.data,
+            L.layout,
+            lower_a=True,
+            unit_diag=(L.diag == Diag.Unit),
+        )
+        return HermitianMatrix(
+            Ct, A.layout, grid=A.grid, uplo=Uplo.Lower
+        )
+
     from ..ops import blas2d
 
+    if _is_distributed(A) or _is_distributed(L):
+        from ..internal import fallbacks
+
+        fallbacks.record(
+            "hegst", opts, "itype 2/3 / upper uplo / op view gather"
+        )
     Ag = A.full_global()
     Lg = L._with(op=Op.NoTrans).to_global()
     if itype == 1:

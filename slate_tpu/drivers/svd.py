@@ -166,52 +166,67 @@ def ge2tb(
 
 
 
-def _jw_band_storage(Bsq: jnp.ndarray, b: int):
+def _jw_band_storage(Dg: jnp.ndarray, b: int, n: int):
     """Diagonal-major band storage of the perfect-shuffle Jordan-Wielandt
-    embedding C = P [[0, B], [B^H, 0]] P^T of an upper-band B (b
-    superdiagonals): C is Hermitian banded with bandwidth 2b+1, entries
-    C[2j+1, 2i] = conj(B[i, i + (d-1)/2]) on the odd subdiagonals of the
-    even columns (Golub-Kahan; eigenvalues come in +-sigma pairs and
-    eigenvectors shuffle to (u; v)/sqrt(2))."""
-    n = Bsq.shape[0]
+    embedding C = P [[0, B], [B^H, 0]] P^T of an upper-band B given by
+    its packed superdiagonals Dg[t, i] = B[i, i+t], t in [0, b]: C is
+    Hermitian banded with bandwidth 2b+1, entries C[2j+1, 2i] =
+    conj(B[i, i + (d-1)/2]) on the odd subdiagonals of the even columns
+    (Golub-Kahan; eigenvalues come in +-sigma pairs and eigenvectors
+    shuffle to (u; v)/sqrt(2))."""
     bw = 2 * b + 1
     n2 = 2 * n
     n_pad = n2 + 4 * bw + 8
-    W = jnp.zeros((2 * bw + 1, n_pad), Bsq.dtype)
+    W = jnp.zeros((2 * bw + 1, n_pad), Dg.dtype)
     for t in range(b + 1):
         dd = 2 * t + 1
-        diag_t = jnp.conj(jnp.diagonal(Bsq, t))  # (n - t,)
+        diag_t = jnp.conj(Dg[t, : n - t])  # (n - t,)
         cols = 2 * jnp.arange(n - t)
         W = W.at[dd, cols].set(diag_t)
     return W, bw, n2
 
 
-def _band_svd_jw(Gband: jnp.ndarray, b: int, vectors: bool):
-    """SVD of an upper-triangular band matrix through the shuffled
-    Jordan-Wielandt embedding + the hb2st wavefront bulge chase: the
-    TPU-native stage 2 (replaces reference tb2bd + bdsqr, src/tb2bd.cc,
-    src/bdsqr.cc).  Returns (s desc, U, Vh) with U/Vh None unless
-    requested."""
+def _band_svd_jw(Dg: jnp.ndarray, n: int, b: int, vectors: bool):
+    """SVD of an upper-triangular band matrix (packed superdiagonals Dg,
+    shape (b+1, n)) through the shuffled Jordan-Wielandt embedding + the
+    hb2st bulge chase: the TPU-native stage 2 (replaces reference tb2bd
+    + bdsqr, src/tb2bd.cc, src/bdsqr.cc).  Returns (s desc, U, Vh) with
+    U/Vh None unless requested."""
+    import jax
+
+    from .. import native as _native
     from ..ops import bulge as bulge_mod
     from .eig import steqr
 
-    n = Gband.shape[1]
-    Bsq = Gband[:n, :n]
-    W, bw, n2 = _jw_band_storage(Bsq, b)
-    d, e, u, VS, TAUS = bulge_mod.hb2st(W, n2, bw)
+    dtype = Dg.dtype
+    W, bw, n2 = _jw_band_storage(Dg, b, n)
+    # native host chaser for eager real f64 (see drivers/eig.py heev)
+    host_ok = (
+        not isinstance(W, jax.core.Tracer)
+        and not jnp.issubdtype(dtype, jnp.complexfloating)
+        and W.dtype == jnp.float64
+        and _native.hb2st_available()
+    )
+    if host_ok:
+        d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n2, bw)
+        d, e = jnp.asarray(d_h), jnp.asarray(e_h)
+        u = jnp.ones((n2,), dtype)
+        VS, TAUS = jnp.asarray(VS_h), jnp.asarray(TAUS_h)
+    else:
+        d, e, u, VS, TAUS = bulge_mod.hb2st(W, n2, bw)
     if not vectors:
         w = bulge_mod.tridiag_eigvals_bisect(d, e)
         return w[::-1][:n], None, None
     w, ZT = steqr(d, e, vectors=True)
     Zjw = bulge_mod.unmtr_hb2st(
-        VS, TAUS, (u[:, None] * ZT).astype(Bsq.dtype), n2, bw
+        VS, TAUS, (u[:, None] * ZT).astype(dtype), n2, bw
     )
     top = jnp.argsort(-w)[:n]
     s = w[top]
     Zsel = Zjw[:, top] * np.sqrt(2.0)
     U = Zsel[0::2, :]
     V = Zsel[1::2, :]
-    return s, U, jnp.conj(V).T if jnp.issubdtype(Bsq.dtype, jnp.complexfloating) else V.T
+    return s, U, jnp.conj(V).T if jnp.issubdtype(dtype, jnp.complexfloating) else V.T
 
 
 @accurate_matmul
@@ -227,7 +242,13 @@ def tb2bd(band: TriangularBandMatrix):
     k = min(m, n)
     b = getattr(band, "kd", n)
     if m >= n and n > 4 * (2 * b + 1) and b >= 1:
-        s, U, Vh = _band_svd_jw(G, b, vectors=True)
+        t_ = jnp.arange(b + 1)[:, None]
+        i_ = jnp.arange(n)[None, :]
+        Dg = jnp.stack(
+            [jnp.pad(jnp.diagonal(G[:n, :n], t), (0, t)) for t in range(b + 1)]
+        )
+        Dg = jnp.where(i_ + t_ < n, Dg, 0)
+        s, U, Vh = _band_svd_jw(Dg, n, b, vectors=True)
     else:
         U, s, Vh = svd_accurate(G)
     d = s
@@ -318,24 +339,41 @@ def svd(
         return s, U, Vh
 
     band, UVm, UT, VVm, VT = ge2tb(A, opts)
-    Gband = band.to_global()
     b = lay.nb
     k = min(m, n)
     # stage 2: the JW bulge-chase when the band is genuinely narrow
     use_jw = (n <= m) and (n > 4 * (2 * b + 1)) and b >= 1
-    if not vectors:
-        if use_jw:
-            s = _band_svd_jw(Gband, b, vectors=False)[0]
-            return s, None, None
-        s = svd_accurate(Gband, compute_uv=False)
-        return s[:k], None, None
     if use_jw:
-        s, Ub, Vhb = _band_svd_jw(Gband, b, vectors=True)
+        # band-limited stage gather (ge2tbGather semantics): only the
+        # O(n kd) packed superdiagonals move between the stages
+        # (reference: TriangularBandMatrix.hh:327, svd.cc:270-304)
+        from ..parallel.band_gather import (
+            spmd_upper_band_diagonals,
+            upper_band_diagonals_tiles,
+        )
+
+        if (
+            _is_distributed(band)
+            and get_option(opts, Option.UseShardMap)
+            and band.layout.mb == band.layout.nb
+        ):
+            Dg = spmd_upper_band_diagonals(
+                band.grid, band.data, band.layout, n
+            )
+        else:
+            Dg = upper_band_diagonals_tiles(band.data, band.layout, n)
+        if not vectors:
+            return _band_svd_jw(Dg, n, b, vectors=False)[0], None, None
+        s, Ub, Vhb = _band_svd_jw(Dg, n, b, vectors=True)
         if m > n:
             Ub = jnp.concatenate(
                 [Ub, jnp.zeros((m - n, n), A.dtype)], axis=0
             )
     else:
+        Gband = band.to_global()
+        if not vectors:
+            s = svd_accurate(Gband, compute_uv=False)
+            return s[:k], None, None
         Ub, s, Vhb = svd_accurate(Gband)
     # back-transform (unmbr_ge2tb): U = Q_U Ub, V^H = Vhb Q_V^H
     U = unmbr_ge2tb_left(UVm, UT, Ub, A, opts)

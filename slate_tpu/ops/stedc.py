@@ -168,9 +168,14 @@ def _secular_roots(D, z2, rho, nondefl, nxt_idx, gap_hi):
     return kshift, sgn, x
 
 
-def _merge(w1, Q1, w2, Q2, e_r, eps):
-    """One Cuppen merge: children (w1, Q1), (w2, Q2) of size s each,
-    coupled by off-diagonal e_r.  Returns (w, Q) of size 2s, ascending."""
+def _merge_setup(w1, QT1, w2, QT2, e_r, eps):
+    """Phase 0 of a Cuppen merge: build (D, z, QT) for the rank-one
+    coupled problem and sort the poles ascending.  Eigenvector blocks
+    are carried in TRANSPOSED form across the whole tree (row i of QT
+    is the eigenvector belonging to w[i]): every permutation and Givens
+    pass then gathers/updates ROWS — the TPU-friendly (sublane) axis —
+    instead of lanes, which is what made the n=4096 top merges
+    pathologically slow on-chip."""
     s = w1.shape[0]
     n2 = 2 * s
     dt = w1.dtype
@@ -179,26 +184,36 @@ def _merge(w1, Q1, w2, Q2, e_r, eps):
     rho = jnp.abs(e_r)
 
     D = jnp.concatenate([w1, w2])
-    z = jnp.concatenate([sigma * Q1[-1, :], Q2[0, :]])
-    Qbig = jnp.zeros((n2, n2), dt)
-    Qbig = Qbig.at[:s, :s].set(Q1).at[s:, s:].set(Q2)
+    # z = (sigma * last row of Q1, first row of Q2) = (sigma * last
+    # column of QT1, first column of QT2): static lane slices, cheap
+    z = jnp.concatenate([sigma * QT1[:, -1], QT2[:, 0]])
+    QT = jnp.zeros((n2, n2), dt)
+    QT = QT.at[:s, :s].set(QT1).at[s:, s:].set(QT2)
 
     # sort poles ascending
     order = jnp.argsort(D)
     D = D[order]
     z = z[order]
-    Qbig = Qbig[:, order]
+    QT = QT[order, :]
 
     scale = jnp.maximum(jnp.abs(D).max(), rho * (z * z).sum())
     tol = 8.0 * eps * jnp.maximum(scale, jnp.asarray(np.float64(1e-30), dt))
+    return D, z, QT, rho, tol
 
+
+def _deflate(D, z, QT, rho, tol):
+    """Deflation phases (a) + (b): drop negligible coupling weight and
+    combine near-equal pole pairs by Givens passes (vectorized; rank
+    pairing halves an equal-pole run per pass).  QT rows are the
+    eigenvector columns."""
+    n2 = D.shape[0]
     # --- deflation (a): negligible coupling weight --------------------
     nondefl = rho * jnp.abs(z) > tol
     # --- deflation (b): near-equal poles, Givens passes ---------------
     idx = jnp.arange(n2)
 
     def defl_pass(carry):
-        p, D, z, Qbig, nondefl, _, prev = carry
+        p, D, z, QT, nondefl, _, prev = carry
         # pair nondeflated entries by their rank among the nondeflated
         # (even rank leads, its next nondeflated neighbour follows) —
         # index-adjacent pairing would stall on equal-pole runs once the
@@ -241,33 +256,42 @@ def _merge(w1, Q1, w2, Q2, e_r, eps):
             ),
             D,
         )
-        # rotate Q column pairs: lead <- c q_l + s q_f, fol <- -s q_l + c q_f
-        ql = Qbig[:, lead]
-        qf = Qbig[:, fol]
+        # rotate eigenvector pairs (rows of QT):
+        #   lead <- c q_l + s q_f, fol <- -s q_l + c q_f
+        ql = QT[lead, :]
+        qf = QT[fol, :]
         Qrot = jnp.where(
-            is_lead[None, :],
-            c[None, :] * ql + sn[None, :] * qf,
-            -sn[None, :] * ql + c[None, :] * qf,
+            is_lead[:, None],
+            c[:, None] * ql + sn[:, None] * qf,
+            -sn[:, None] * ql + c[:, None] * qf,
         )
-        Qbig = jnp.where(act[None, :], Qrot, Qbig)
+        QT = jnp.where(act[:, None], Qrot, QT)
         nondefl = nondefl & ~(act & is_fol)
-        return p + 1, D, z, Qbig, nondefl, jnp.any(act), carry[5]
+        return p + 1, D, z, QT, nondefl, jnp.any(act), carry[5]
 
     # early-exit after TWO consecutive quiet passes (the parities
     # alternate, and one parity being quiet says nothing about the
     # other); most merges need 0-2 passes, only degenerate clusters use
     # the full 2*log2(n2) budget (each pass halves a run)
     npass = max(4, 2 * int(np.ceil(np.log2(n2))) + 2)
-    _, D, z, Qbig, nondefl, _, _ = lax.while_loop(
+    _, D, z, QT, nondefl, _, _ = lax.while_loop(
         lambda c: (c[0] < npass) & (c[5] | c[6]),
         defl_pass,
-        (jnp.int32(0), D, z, Qbig, nondefl, jnp.bool_(True), jnp.bool_(True)),
+        (jnp.int32(0), D, z, QT, nondefl, jnp.bool_(True), jnp.bool_(True)),
     )
     # re-apply deflation (a) after rotations moved the weight
     nondefl = nondefl & (rho * jnp.abs(z) > tol)
     z = jnp.where(nondefl, z, 0.0)
-    z2 = z * z
+    return D, z, QT, nondefl
 
+
+def _solve_secular(D, z, rho, nondefl, tol):
+    """Secular-equation phase: bracket construction + vectorized laed4
+    roots.  Returns (kshift, sgn, x, lam)."""
+    n2 = D.shape[0]
+    dt = D.dtype
+    idx = jnp.arange(n2)
+    z2 = z * z
     # --- secular solve ------------------------------------------------
     # index of the next nondeflated pole above i (n2 if none)
     posn2 = jnp.where(nondefl, idx, n2).astype(jnp.int32)
@@ -282,7 +306,15 @@ def _merge(w1, Q1, w2, Q2, e_r, eps):
     sgn = jnp.where(nondefl, sgn, 1.0)
     x = jnp.where(nondefl, x, 0.0)
     lam = jnp.where(nondefl, D[kshift] + sgn * x, D)
+    return kshift, sgn, x, lam
 
+
+def _assemble_u(D, z, nondefl, kshift, sgn, x):
+    """Lowner z-hat recomputation + eigenvector assembly.  Returns Ur
+    with ROWS indexed by root i (Ur = U^T of the classical U), ready
+    for the transposed back-rotation QT_out = Ur @ QT."""
+    n2 = D.shape[0]
+    dt = D.dtype
     # --- Lowner z-hat (Gu-Eisenstat) ----------------------------------
     # zhat_j^2 = prod_i (lam_i - D_j) / prod_{i != j} (D_i - D_j), over
     # nondeflated i, j.  lam_i - D_j = (D[kshift_i] - D_j) + sgn_i x_i
@@ -311,18 +343,33 @@ def _merge(w1, Q1, w2, Q2, e_r, eps):
     sgn_u = zsign[None, :] * jnp.where(lam_minus_d < 0, -1.0, 1.0)
     M = jnp.max(logU, axis=1, keepdims=True)
     Msafe = jnp.where(jnp.isfinite(M), M, 0.0)
-    U = jnp.where(both, sgn_u * jnp.exp(logU - Msafe), 0.0)
-    U = U.T  # columns indexed by root i
-    norms = jnp.sqrt((U * U).sum(axis=0))
-    U = U / jnp.where(norms == 0, 1.0, norms)[None, :]
-    # deflated columns: unit vectors
+    Ur = jnp.where(both, sgn_u * jnp.exp(logU - Msafe), 0.0)  # (root i, j)
+    norms = jnp.sqrt((Ur * Ur).sum(axis=1))
+    Ur = Ur / jnp.where(norms == 0, 1.0, norms)[:, None]
+    # deflated roots: unit vectors
     eye = jnp.eye(n2, dtype=dt)
-    U = jnp.where(nondefl[None, :], U, eye)
+    Ur = jnp.where(nondefl[:, None], Ur, eye)
+    return Ur
 
-    # --- back-rotation + final sort -----------------------------------
-    Q = _dot(Qbig, U)
+
+def _merge(w1, QT1, w2, QT2, e_r, eps):
+    """One Cuppen merge: children (w1, QT1), (w2, QT2) of size s each
+    (QT in row-eigenvector form), coupled by off-diagonal e_r.  Returns
+    (w, QT) of size 2s, ascending.
+
+    Composed of the phase functions above (setup/sort -> deflate ->
+    secular -> assemble -> back-rotate); tools/profile_stedc.py times
+    each phase separately on-chip."""
+    D, z, QT, rho, tol = _merge_setup(w1, QT1, w2, QT2, e_r, eps)
+    D, z, QT, nondefl = _deflate(D, z, QT, rho, tol)
+    kshift, sgn, x, lam = _solve_secular(D, z, rho, nondefl, tol)
+    Ur = _assemble_u(D, z, nondefl, kshift, sgn, x)
+
+    # --- back-rotation + final sort (all in transposed form): the
+    # classical Q @ U becomes QT_out = U^T @ QT, still one MXU gemm ----
+    QT = _dot(Ur, QT)
     order2 = jnp.argsort(lam)
-    return lam[order2], Q[:, order2]
+    return lam[order2], QT[order2, :]
 
 
 def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -366,7 +413,7 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     left = jnp.concatenate([jnp.zeros((1,), dt), eabs])
     right = jnp.concatenate([eabs, jnp.zeros((1,), dt)])
     w = (dpad - left - right)[:, None]  # (N, 1) block eigenvalues
-    Q = jnp.ones((N, 1, 1), dt)
+    QT = jnp.ones((N, 1, 1), dt)  # row-eigenvector (transposed) form
     w = w.reshape(N, 1)
 
     merge_b = jax.vmap(_merge, in_axes=(0, 0, 0, 0, 0, None))
@@ -375,16 +422,17 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     while s < N:
         nm = N // (2 * s)
         w_pairs = w.reshape(nm, 2, s)
-        Q_pairs = Q.reshape(nm, 2, s, s)
+        Q_pairs = QT.reshape(nm, 2, s, s)
         e_r = epad[s - 1 :: 2 * s][:nm]
-        w, Q = merge_b(
+        w, QT = merge_b(
             w_pairs[:, 0], Q_pairs[:, 0], w_pairs[:, 1], Q_pairs[:, 1],
             e_r, eps,
         )
         s *= 2
         w = w.reshape(nm, s)
-        Q = Q.reshape(nm, s, s)
+        QT = QT.reshape(nm, s, s)
 
     w = w.reshape(N)
-    Q = Q.reshape(N, N)
-    return w[:n] * scale, Q[:n, :n]
+    QT = QT.reshape(N, N)
+    # single transpose back to column-eigenvector convention
+    return w[:n] * scale, QT[:n, :n].T

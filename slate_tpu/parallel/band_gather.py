@@ -1,0 +1,182 @@
+"""Band-limited stage-2 gather: tile array -> packed band storage.
+
+The reference moves O(n kd) data between the eigensolver stages: the
+band produced by he2hb/ge2tb is gathered into a 1D-distributed band
+matrix, never the dense n x n (reference:
+include/slate/HermitianBandMatrix.hh:310 he2hbGather,
+TriangularBandMatrix.hh:327 ge2tbGather, src/heev.cc:133-151).  These
+helpers are the TPU equivalents: they extract the (kd+1) stored
+diagonals straight from the (P, Q, mb, nb) tile array into the
+diagonal-major chase storage W[d, c] = A[c+d, c] of ops/bulge.py —
+O(n kd) data, never materializing the dense matrix.
+
+Two entry points:
+* band_storage_tiles  — single-device / replicated tile arrays (also
+  replaces the to_global + band_to_storage O(n^2) route everywhere);
+* spmd_band_storage   — shard_map version: each process extracts its
+  local diagonal/subdiagonal tiles, one psum of the packed O(n kd)
+  band replicates the result (the he2hbGather analogue).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .grid import COL_AXIS, ROW_AXIS, ProcessGrid
+from .layout import TileLayout
+from .spmd_blas import shard_map
+
+
+def _band_rowidx(nb: int) -> np.ndarray:
+    """(nb+1, nb) row indices: stacked[rowidx[d, c], c] = A[c+d, c] for
+    a (2nb, nb) stacked [diag; subdiag] tile pair."""
+    return np.arange(nb + 1)[:, None] + np.arange(nb)[None, :]
+
+
+def _assemble_w(E: jnp.ndarray, layout: TileLayout, n_pad: int) -> jnp.ndarray:
+    """(nt, nb+1, nb) per-tile-column band -> (2nb+1, n_pad) W."""
+    nb = layout.nb
+    n = layout.n
+    Wtop = E.transpose(1, 0, 2).reshape(nb + 1, layout.nt * nb)[:, :n]
+    return jnp.pad(Wtop, ((0, nb), (0, n_pad - n)))
+
+
+def band_storage_tiles(
+    T: jnp.ndarray, layout: TileLayout, n_pad: int
+) -> jnp.ndarray:
+    """Pack the Hermitian band (kd = nb, lower storage) held in tile
+    array T into (2nb+1, n_pad) diagonal-major storage, touching only
+    the nt diagonal + nt-1 subdiagonal tiles (O(n kd) data)."""
+    nb = layout.nb
+    assert layout.mb == nb, "band storage requires square tiles"
+    nt = layout.nt
+    js = np.arange(nt)
+    diag = T[np.asarray(layout.row_scatter)[js],
+             np.asarray(layout.col_scatter)[js]]
+    jsub = np.minimum(js + 1, layout.P - 1)
+    sub = T[np.asarray(layout.row_scatter)[jsub],
+            np.asarray(layout.col_scatter)[js]]
+    sub = jnp.where((js < nt - 1)[:, None, None], sub, 0)
+    stacked = jnp.concatenate([diag, sub], axis=1)  # (nt, 2nb, nb)
+    rowidx = jnp.asarray(_band_rowidx(nb))
+    E = stacked[:, rowidx, jnp.arange(nb)[None, :]]  # (nt, nb+1, nb)
+    return _assemble_w(E, layout, n_pad)
+
+
+def spmd_band_storage(
+    grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout, n_pad: int
+) -> jnp.ndarray:
+    """shard_map he2hbGather: every process extracts the band pieces it
+    owns from its local shard; one psum of the packed (nt, nb+1, nb)
+    band — O(n kd) ICI traffic — replicates W on all processes."""
+    p, q = grid.p, grid.q
+    nb = layout.nb
+    assert layout.mb == nb, "band storage requires square tiles"
+    nt = layout.nt
+    mtl, ntl = layout.mtl, layout.ntl
+    rowidx = jnp.asarray(_band_rowidx(nb))
+    js = jnp.arange(nt)
+
+    def local(tl):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        # diagonal tile (j, j): local slot (j // p, j // q) when owned
+        own_d = (js % p == r) & (js % q == c)
+        D = tl[jnp.clip(js // p, 0, mtl - 1), jnp.clip(js // q, 0, ntl - 1)]
+        D = jnp.where(own_d[:, None, None], D, 0)
+        # subdiagonal tile (j+1, j)
+        j1 = js + 1
+        own_s = (j1 % p == r) & (js % q == c) & (j1 < layout.mt)
+        S = tl[jnp.clip(j1 // p, 0, mtl - 1), jnp.clip(js // q, 0, ntl - 1)]
+        S = jnp.where(own_s[:, None, None], S, 0)
+        stacked = jnp.concatenate([D, S], axis=1)  # (nt, 2nb, nb)
+        E = stacked[:, rowidx, jnp.arange(nb)[None, :]]
+        E = lax.psum(lax.psum(E, COL_AXIS), ROW_AXIS)
+        return _assemble_w(E, layout, n_pad)
+
+    fn = shard_map(
+        local,
+        mesh=grid.mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),),
+        out_specs=P(),
+    )
+    return fn(T)
+
+
+# ---------------------------------------------------------------------------
+# Upper-triangular band (ge2tb output): packed superdiagonals for the
+# Jordan-Wielandt SVD stage (ge2tbGather analogue).
+# ---------------------------------------------------------------------------
+
+
+def _upper_band_extract(stacked: jnp.ndarray, nb: int) -> jnp.ndarray:
+    """stacked: (nt, nb, 2nb) [diag | right] tile pairs.  Returns
+    (nt, nb+1, nb) E with E[j, t, a] = B[j nb + a, j nb + a + t]."""
+    colidx = jnp.asarray(_band_rowidx(nb))  # (nb+1, nb): t + a
+    return stacked[:, jnp.arange(nb)[None, :], colidx]
+
+
+def upper_band_diagonals_tiles(
+    T: jnp.ndarray, layout: TileLayout, n: int
+) -> jnp.ndarray:
+    """Extract the nb+1 stored superdiagonals of an upper-triangular
+    band matrix (kd = nb) from its tile array: returns (nb+1, n) D with
+    D[t, i] = B[i, i+t] (zero where i+t >= n) — O(n kd) data."""
+    nb = layout.nb
+    assert layout.mb == nb, "band storage requires square tiles"
+    nt = layout.nt
+    js = np.arange(nt)
+    row_sc = np.asarray(layout.row_scatter)
+    col_sc = np.asarray(layout.col_scatter)
+    diag = T[row_sc[js], col_sc[js]]
+    jr = np.minimum(js + 1, layout.Q - 1)
+    right = T[row_sc[js], col_sc[jr]]
+    right = jnp.where((js < nt - 1)[:, None, None], right, 0)
+    stacked = jnp.concatenate([diag, right], axis=2)  # (nt, nb, 2nb)
+    E = _upper_band_extract(stacked, nb)
+    Dg = E.transpose(1, 0, 2).reshape(nb + 1, nt * nb)[:, :n]
+    # zero entries running past column n
+    t = jnp.arange(nb + 1)[:, None]
+    i = jnp.arange(n)[None, :]
+    return jnp.where(i + t < n, Dg, 0)
+
+
+def spmd_upper_band_diagonals(
+    grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout, n: int
+) -> jnp.ndarray:
+    """shard_map ge2tbGather: O(n kd) psum of the packed superdiagonals."""
+    p, q = grid.p, grid.q
+    nb = layout.nb
+    assert layout.mb == nb, "band storage requires square tiles"
+    nt = layout.nt
+    mtl, ntl = layout.mtl, layout.ntl
+    js = jnp.arange(nt)
+
+    def local(tl):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        own_d = (js % p == r) & (js % q == c)
+        D = tl[jnp.clip(js // p, 0, mtl - 1), jnp.clip(js // q, 0, ntl - 1)]
+        D = jnp.where(own_d[:, None, None], D, 0)
+        j1 = js + 1
+        own_r = (js % p == r) & (j1 % q == c) & (j1 < layout.nt)
+        R = tl[jnp.clip(js // p, 0, mtl - 1), jnp.clip(j1 // q, 0, ntl - 1)]
+        R = jnp.where(own_r[:, None, None], R, 0)
+        stacked = jnp.concatenate([D, R], axis=2)
+        E = _upper_band_extract(stacked, nb)
+        E = lax.psum(lax.psum(E, COL_AXIS), ROW_AXIS)
+        Dg = E.transpose(1, 0, 2).reshape(nb + 1, nt * nb)[:, :n]
+        t = jnp.arange(nb + 1)[:, None]
+        i = jnp.arange(n)[None, :]
+        return jnp.where(i + t < n, Dg, 0)
+
+    fn = shard_map(
+        local,
+        mesh=grid.mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),),
+        out_specs=P(),
+    )
+    return fn(T)
