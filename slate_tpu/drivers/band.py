@@ -43,17 +43,18 @@ def gbmm(alpha, A: BandMatrix, B: Matrix, beta, C: Matrix, opts=None) -> Matrix:
 
 def hbmm(side: Side, alpha, A: HermitianBandMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
-    """C = alpha A B + beta C with Hermitian band A (reference: src/hbmm.cc)."""
-    Af = _hermitian_band_full(A)
-    B2, C2 = B.to_global(), C.to_global()
-    from ..ops import blas2d
+    """C = alpha A B + beta C with Hermitian band A (reference:
+    src/hbmm.cc).
 
-    out = (
-        blas2d.gemm2d(alpha, Af, B2, beta, C2)
-        if side == Side.Left
-        else blas2d.gemm2d(alpha, B2, Af, beta, C2)
-    )
-    return C._with(data=tiles_from_global(out.astype(C.dtype), C.layout))
+    Routes through the hemm driver on the band-masked stored triangle:
+    distributed inputs take the spmd_hemm stored-triangle SUMMA (no
+    gather of A, B or C), dense inputs the fused global product — the
+    band's zero tiles cost nothing either way."""
+    # band_mask() already encodes the stored triangle: kl/ku are derived
+    # from uplo/kd by the band-matrix hierarchy, padding masked off
+    masked = A.data * A.band_mask().astype(A.dtype)
+    Ah = HermitianMatrix(masked, A.layout, grid=A.grid, uplo=A.uplo)
+    return blas3.hemm(side, alpha, Ah, B, beta, C, opts)
 
 
 def _hermitian_band_full(A: HermitianBandMatrix) -> jnp.ndarray:

@@ -263,6 +263,7 @@ def _herk_like_spmd(alpha, A, beta, C, conj: bool, rank2=False, B=None):
     out = spmd_blas.spmd_herk(
         C.grid, alpha, A.data, lay, beta, C.data, layC,
         conj=conj, trans=trans, alpha2=a2, TB=TB, layB=layB,
+        lower=(C.uplo == Uplo.Lower),
     )
     return C._with(data=out)
 

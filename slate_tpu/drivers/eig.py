@@ -261,6 +261,139 @@ def _gathered_band_eig(
     return eigh_accurate(band_2d, vectors=vectors)
 
 
+_STAGED_CACHE: dict = {}
+
+
+def heev_staged(
+    A: HermitianMatrix,
+    opts: Optional[Options] = None,
+    vectors: bool = True,
+):
+    """Two-stage heev with PER-STAGE jits for large n (reference
+    staging: src/heev.cc:123-210).
+
+    One whole-problem jit exceeds this toolchain's remote-compile
+    service beyond n ~ 1024 (BENCH_NOTES r4), so the product path for
+    large eigenproblems compiles the four stages separately — he2hb +
+    band gather | hb2st (native host chaser when available, on-device
+    wavefront otherwise) | tridiagonal eigensolve + hb2st
+    back-transform | he2hb back-transform — and reuses the compiled
+    stages across calls of the same shape.
+
+    Returns (w, Z-or-None, stage_seconds)."""
+    import time as _time
+
+    import jax
+
+    from .. import native as _native
+    from ..ops import bulge
+    from ..parallel.band_gather import band_storage_tiles, spmd_band_storage
+
+    n = A.n
+    b = A.layout.nb
+    if b < 2 or n <= 2 or n <= 4 * b:
+        w, Z = heev(A, opts, vectors=vectors)
+        return w, Z, {}
+    n_pad = n + 4 * b + 8
+    lay = A.layout
+    use_spmd_gather = (
+        _is_distributed(A)
+        and get_option(opts, Option.UseShardMap)
+        and lay.mb == lay.nb
+    )
+    host_ok = (
+        not A.is_complex
+        and A.dtype == jnp.float64
+        and _native.hb2st_available()
+    )
+    adtype = A.dtype
+    grid = A.grid
+    opts_key = tuple(
+        sorted((str(k), str(v)) for k, v in (opts or {}).items())
+    )
+    key = (
+        n, b, str(adtype), lay.p, lay.q, vectors, use_spmd_gather,
+        id(grid), opts_key,
+    )
+    stages = _STAGED_CACHE.get(key)
+    if stages is None:
+        # closures capture only scalars/layout/grid + opts — never the
+        # input matrix (a captured A would pin its device buffers for
+        # the cache's lifetime)
+
+        @jax.jit
+        def _s1(A):
+            band, V, T = he2hb(A, opts)
+            if use_spmd_gather:
+                W = spmd_band_storage(band.grid, band.data, band.layout, n_pad)
+            else:
+                W = band_storage_tiles(band.data, band.layout, n_pad)
+            return W, V.data, T.T
+
+        _s2_chip = jax.jit(bulge.hb2st, static_argnames=("n", "b"))
+
+        @jax.jit
+        def _s3(d, e, u, VS, TAUS):
+            wv, ZT = steqr(d, e, vectors=True)
+            Z2 = bulge.unmtr_hb2st(
+                VS=VS, TAUS=TAUS, Z=(u[:, None] * ZT).astype(adtype),
+                n=n, b=b,
+            )
+            return wv, Z2
+
+        @jax.jit
+        def _s3v(d, e):
+            return bulge.tridiag_eigvals_bisect(d, e)
+
+        @jax.jit
+        def _s4(Vd, Ts, Zd):
+            Z = unmtr_he2hb(
+                Side.Left,
+                Op.NoTrans,
+                Matrix(Vd, lay, grid=grid),
+                TriangularFactors(Ts),
+                Matrix(Zd, lay, grid=grid),
+                opts,
+            )
+            return Z.data
+
+        @jax.jit
+        def _pack(Z2):
+            return tiles_from_global(Z2, lay)
+
+        stages = (_s1, _s2_chip, _s3, _s3v, _s4, _pack)
+        _STAGED_CACHE[key] = stages
+    _s1, _s2_chip, _s3, _s3v, _s4, _pack = stages
+
+    times = {}
+    t0 = _time.time()
+    W, Vd, Ts = jax.block_until_ready(_s1(A))
+    times["he2hb+gather"] = round(_time.time() - t0, 2)
+    t0 = _time.time()
+    if host_ok:
+        d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n, b)
+        d, e = jnp.asarray(d_h), jnp.asarray(e_h)
+        u = jnp.ones((n,), A.dtype)
+        VS, TAUS = jnp.asarray(VS_h), jnp.asarray(TAUS_h)
+    else:
+        d, e, u, VS, TAUS = _s2_chip(W, n, b)
+    jax.block_until_ready((d, e, VS, TAUS))
+    times["hb2st"] = round(_time.time() - t0, 2)
+    if not vectors:
+        t0 = _time.time()
+        w = jax.block_until_ready(_s3v(d, e))
+        times["eigvals"] = round(_time.time() - t0, 2)
+        return w, None, times
+    t0 = _time.time()
+    wv, Z2 = jax.block_until_ready(_s3(d, e, u, VS, TAUS))
+    times["stedc+unmtr_hb2st"] = round(_time.time() - t0, 2)
+    t0 = _time.time()
+    Zd = jax.block_until_ready(_s4(Vd, Ts, _pack(Z2)))
+    times["unmtr_he2hb"] = round(_time.time() - t0, 2)
+    Z = Matrix(Zd, lay, grid=A.grid)
+    return wv, Z, times
+
+
 @accurate_matmul
 @traced("heev")
 def heev(
@@ -281,7 +414,6 @@ def heev(
     from ..ops import bulge
     from ..parallel.band_gather import band_storage_tiles, spmd_band_storage
 
-    band, V, T = he2hb(A, opts)
     n = A.n
     b = A.layout.nb
 
@@ -291,6 +423,22 @@ def heev(
     two_stage = b >= 2 and n > 2 and (
         method == MethodEig.Bisection or (method == MethodEig.Auto and n > 4 * b)
     )
+    # large eager accelerator problems: per-stage jits (one whole-heev
+    # jit exceeds the remote-compile service past n ~ 1024 on this
+    # toolchain — BENCH_NOTES r4); decided BEFORE the he2hb reduction so
+    # stage 1 runs exactly once.  Inside a jit trace this re-dispatch is
+    # skipped and the whole path traces inline as before.
+    if (
+        two_stage
+        and n >= 1024
+        and n > 4 * b  # heev_staged's own guard; avoids a re-dispatch loop
+        and not isinstance(A.data, jax.core.Tracer)
+        and jax.default_backend() != "cpu"
+    ):
+        w, Z, _times = heev_staged(A, opts, vectors=vectors)
+        return w, Z
+
+    band, V, T = he2hb(A, opts)
     if two_stage:
         # band-limited stage gather (he2hbGather semantics): the packed
         # (2b+1, n_pad) chase storage is built straight from the <= 2
@@ -359,16 +507,27 @@ def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
 
 
 def steqr(
-    d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
+    d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True,
+    method: str = "dc",
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Tridiagonal eigensolver (reference: src/steqr.cc implicit QR).
 
     Values-only runs the parallel Sturm bisection; with vectors, the
     native divide & conquer (ops/stedc.py) — no vendor eigensolver
     anywhere on the path (the vendor f64 eigh is a compile bomb past
-    n~512 on this toolchain)."""
+    n~512 on this toolchain).  ``method="stein"`` takes the independent
+    fallback pairing instead: Sturm-bisection eigenvalues + batched
+    inverse-iteration vectors (ops/stein.py — the dstebz+dstein
+    analogue, de-risking the D&C path)."""
     if not vectors:
         return sterf(d, e), None
+    if method == "stein":
+        from ..ops.bulge import tridiag_eigvals_bisect
+        from ..ops.stein import stein as _stein
+
+        dr, er = jnp.real(d), jnp.real(e)
+        w = tridiag_eigvals_bisect(dr, er)
+        return w, _stein(dr, er, w)
     return stedc(d, e, vectors=True)
 
 

@@ -71,19 +71,38 @@ def _ldl_nopiv(Af: jnp.ndarray, mb: int, grid, opts):
 
 
 def hetrf(
-    A: HermitianMatrix, opts: Optional[Options] = None
+    A: HermitianMatrix, opts: Optional[Options] = None,
+    method: str = "auto",
 ) -> Tuple[TriangularMatrix, jnp.ndarray, jnp.ndarray]:
     """Factor A = L D L^H, L unit lower, D real diagonal
     (reference contract: src/hetrf.cc; see module docstring for the
     pivot-free TPU algorithm).
 
-    Returns (L, d, info).  If the pivot-free pass breaks down, L carries a
-    random-butterfly congruence (L._rbt) and factors U^H A U instead;
-    hetrs consumes it transparently, so (L, d) remains a valid solve
-    factor for A either way."""
+    Returns (L, d, info).  ``method``:
+
+    * "auto"  — pivot-free LDL^H; on breakdown, refactor with Aasen's
+      partially-pivoted LTL^H (ops/aasen.py — the reference's hetrf
+      algorithm, host-driven there too); L carries the Aasen factors
+      (L._aasen) and hetrs consumes them transparently.
+    * "aasen" — Aasen directly (the reference's method).
+    * "rbt"   — pivot-free with the random-butterfly breakdown fallback
+      of earlier rounds (L._rbt)."""
     slate_assert(A.m == A.n, "hetrf requires square")
     Af = A.full_global()
     lay = A.layout
+
+    def _aasen_factor():
+        from ..ops.aasen import aasen_ltl
+
+        Lnp, al, be, perm, _info = aasen_ltl(np.asarray(Af))
+        L = TriangularMatrix.from_global(
+            jnp.asarray(Lnp), lay.mb, lay.mb, grid=A.grid, uplo=Uplo.Lower
+        )
+        L._aasen = (al, be, perm)
+        return L, jnp.asarray(al), jnp.zeros((), jnp.int32)
+
+    if method == "aasen":
+        return _aasen_factor()
     L, d, info = _ldl_nopiv(Af, lay.mb, A.grid, opts)
     try:
         broke = bool(info != 0)
@@ -98,6 +117,9 @@ def hetrf(
         ) from None
     if not broke:
         return L, d, info
+    if method == "auto":
+        # breakdown: the reference's pivoted-stability algorithm
+        return _aasen_factor()
     # breakdown: randomize with a Hermitian-preserving butterfly congruence
     # A' = U^H A U, pad to a power of 2 with an identity block so the
     # static-shape butterfly stays invertible (gesv_rbt structure).
@@ -139,9 +161,21 @@ def hetrs(
 ) -> Matrix:
     """Solve A X = B from the L D L^H factor (reference: src/hetrs.cc).
 
-    Handles both the plain factor and the butterfly-randomized fallback
-    (L._rbt set by hetrf): A x = b  <=>  (U^H A U) y = U^H b, x = U y."""
+    Handles the plain factor, the Aasen LTL^H factor (L._aasen), and
+    the butterfly-randomized fallback (L._rbt set by hetrf):
+    A x = b <=> (U^H A U) y = U^H b, x = U y."""
     from . import blas3
+
+    aasen_fac = getattr(L, "_aasen", None)
+    if aasen_fac is not None:
+        from ..ops.aasen import aasen_solve
+
+        al, be, perm = aasen_fac
+        Lnp = np.asarray(L._with(op=Op.NoTrans).to_global())
+        X = aasen_solve(np.tril(Lnp), al, be, perm, np.asarray(B.to_global()))
+        return B._with(
+            data=tiles_from_global(jnp.asarray(X).astype(B.dtype), B.layout)
+        )
 
     rbt = getattr(L, "_rbt", None)
     if rbt is None:

@@ -29,6 +29,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .householder import _larfg
@@ -202,24 +203,25 @@ def unmtr_hb2st(
     def conj(x):
         return jnp.conj(x) if complex_t else x
 
-    Zp = jnp.pad(Z, ((0, b + J1 * b + 8), (0, 0)))  # safe gather slack
+    Zp = jnp.pad(Z, ((0, b + J1 * b + 8), (0, 0)))  # slice slack
 
     def sweep_apply(k, Zp):
         s = (n_sweeps - 1 - k) if not trans else k
-        rows = s + 1 + jnp.arange(J1)[:, None] * b + jnp.arange(b)[None, :]
-        ok = (rows <= n - 1)
-        rows_c = jnp.where(ok, rows, n)  # padded region (zeros, untouched)
+        # sweep s's reflector rows s+1+j*b+arange(b) tile the CONTIGUOUS
+        # range [s+1, s+1+J1*b): one dynamic_slice + update_slice instead
+        # of a row gather/scatter pair (the gather form was the stage-3
+        # wall-clock bottleneck at n=4096 on-chip).  Rows past n-1 fall
+        # in the zero padding where VS/TAUS are zero, so the update is an
+        # exact no-op there — no masking needed.
         v = VS[s]  # (J1, b)
         tau = TAUS[s]  # (J1,)
-        tau = jnp.where(trans, conj(tau), tau)
-        vv = jnp.where(ok, v, 0)
-        Zr = Zp[rows_c.reshape(-1)].reshape(J1, b, m)
-        wrow = jnp.einsum("jb,jbm->jm", conj(vv), Zr)
-        Zr = Zr - tau[:, None, None] * vv[:, :, None] * wrow[:, None, :]
-        rows_w = jnp.where(ok, rows, Zp.shape[0] + 1)
-        return Zp.at[rows_w.reshape(-1)].set(
-            Zr.reshape(-1, m), mode="drop"
+        tau = conj(tau) if trans else tau
+        Zr = lax.dynamic_slice(Zp, (s + 1, 0), (J1 * b, m)).reshape(
+            J1, b, m
         )
+        wrow = jnp.einsum("jb,jbm->jm", conj(v), Zr)
+        Zr = Zr - tau[:, None, None] * v[:, :, None] * wrow[:, None, :]
+        return lax.dynamic_update_slice(Zp, Zr.reshape(-1, m), (s + 1, 0))
 
     Zp = lax.fori_loop(0, n_sweeps, sweep_apply, Zp)
     return Zp[: Z.shape[0]]
@@ -238,7 +240,13 @@ def tridiag_eigvals_bisect(
     if n == 1:
         return d
     e2 = (e * e).astype(real_t)
-    tiny = jnp.asarray(jnp.finfo(real_t).tiny * 4, real_t)
+    # pivot floor (LAPACK dstebz's pivmin role).  NOT finfo.tiny: the
+    # TPU f64 emulation's f32-grade exponent range flushes ~1e-307 to
+    # zero, which would defeat the guard entirely on-chip.
+    scale_p = jnp.maximum(
+        jnp.maximum(jnp.abs(d).max(), e2.max() if n > 1 else 0.0), 1.0
+    )
+    pivmin = scale_p * jnp.asarray(np.float64(1e-30), real_t)
     # Gershgorin bounds
     ae = jnp.abs(e)
     rad = jnp.concatenate([ae, jnp.zeros(1, real_t)]) + jnp.concatenate(
@@ -252,12 +260,18 @@ def tridiag_eigvals_bisect(
     ks = jnp.arange(n)
 
     def count_less(sig):
-        """Sturm count: #eigenvalues < sig[k] for each k, one scan."""
+        """Sturm count: #eigenvalues < sig[k] for each k, one scan.
+
+        The pivot guard is applied to the pivot BEFORE it is counted
+        (dstebz convention): an exactly-zero pivot is an eigenvalue of
+        a leading minor and must tally as negative — counting the raw
+        qn < 0 silently dropped one count per zero pivot (periodic
+        spectra like the free Toeplitz chain hit this every 3 rows)."""
 
         def body(q, de):
             di, e2i = de
-            q_safe = jnp.where(jnp.abs(q) < tiny, -tiny, q)
-            qn = (di - sig) - e2i / q_safe
+            qn = (di - sig) - e2i / q
+            qn = jnp.where(jnp.abs(qn) < pivmin, -pivmin, qn)
             return qn, qn < 0
 
         xs = (d, jnp.concatenate([jnp.zeros(1, real_t), e2]))

@@ -232,6 +232,31 @@ def blocked_potrf(
     return jnp.concatenate(cols, axis=1)
 
 
+def tri_inv_blocked(L: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
+    """Explicit inverse of a lower-triangular matrix by recursive
+    2x2 blocking: inv([[A,0],[B,C]]) = [[inv(A),0],[-inv(C) B inv(A),
+    inv(C)]] — two half-size inverses + two MXU gemms per level; the
+    vendor triangular_solve only ever sees <= nb-sized blocks (the
+    full-size vendor trsm is schedule-bound on this toolchain, the
+    same finding as _chol_panels')."""
+    n = L.shape[0]
+    if n <= nb:
+        return lax.linalg.triangular_solve(
+            L, jnp.eye(n, dtype=L.dtype), left_side=True, lower=True
+        )
+    h = max(((n + 1) // 2 + 127) // 128 * 128, 128)
+    h = min(h, n - 1)
+    A = L[:h, :h]
+    B = L[h:, :h]
+    C = L[h:, h:]
+    Ai = tri_inv_blocked(A, nb)
+    Ci = tri_inv_blocked(C, nb)
+    lowblk = -_dot(Ci, _dot(B, Ai))
+    top = jnp.concatenate([Ai, jnp.zeros((h, n - h), L.dtype)], axis=1)
+    bot = jnp.concatenate([lowblk, Ci], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
 def cholesky(G: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
     """Platform-dispatched Cholesky: vendor kernel on CPU (LAPACK —
     already optimal), native blocked schedule on accelerators.

@@ -387,8 +387,12 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         # IEEE one (measured ~2.5e-15 on gemm); deflation calibrated to
         # IEEE eps leaves degenerate clusters undeflated with pole
         # differences that are pure emulation noise, which destroys
-        # eigenvector orthogonality
-        eps *= 32.0
+        # eigenvector orthogonality.  The n-growth keeps large merges'
+        # root interlacing robust: orthogonality measured 45/67/117
+        # (x n eps) at n=1024/2048/4096 with a flat 32x factor — the
+        # sqrt(n) term holds the 4096 case under the 100x bound while
+        # residuals keep ~30x headroom (BENCH_NOTES round 5).
+        eps *= 32.0 * max(1.0, float(np.sqrt(n / 2048.0)))
     if n == 1:
         return d, jnp.ones((1, 1), dt)
 
@@ -434,5 +438,19 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
     w = w.reshape(N)
     QT = QT.reshape(N, N)
+    QT = QT[:n, :n]
+    if jax.default_backend() != "cpu" and n >= 1024:
+        # one CholQR orthogonality polish: the f64 emulation's extra
+        # rounding in the secular/Lowner arithmetic accumulates to
+        # ~100 n eps orthogonality loss by n=4096, concentrated in
+        # near-degenerate pairs.  Q <- Q chol(Q^T Q)^-T restores
+        # eps-grade orthogonality; the induced residual change is
+        # bounded by |E_ij (w_i - w_j)| — and E is large only where
+        # the gap is small, so the eigen-residual is preserved.
+        G = _dot(QT, QT.T)
+        from .chol_kernels import cholesky as _chol, tri_inv_blocked
+
+        Lc = jnp.tril(_chol(G, 512))
+        QT = _dot(tri_inv_blocked(Lc), QT)
     # single transpose back to column-eigenvector convention
-    return w[:n] * scale, QT[:n, :n].T
+    return w[:n] * scale, QT.T

@@ -1,0 +1,159 @@
+"""Tridiagonal eigenvectors by batched inverse iteration (stein).
+
+The de-risking fallback for the flagship stedc path (reference role:
+src/steqr_impl.cc's implicit-QR-with-vectors — LAPACK's other
+tridiagonal vector path; algorithmically this module is the
+dstebz+dstein pairing: eigenvalues from the parallel Sturm bisection,
+vectors from shifted inverse iteration).
+
+TPU-native structure: one batched tridiagonal LU with partial pivoting
+(a single lax.scan over the matrix, vmapped over ALL n shifts), two
+batched solve sweeps per iteration (forward/backward scans), then one
+CholQR2 orthonormalization of the whole vector block.  Sequential
+per-vector rotations (the steqr Givens stream) never appear; cluster
+handling falls out of the final orthonormalization — mixing inverse
+iterates WITHIN a numerical cluster still spans the right invariant
+subspace, so the CholQR basis is a valid eigenbasis for it (the same
+contract dstein's cluster reorthogonalization provides).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..internal.precision import hdot as _dot
+
+
+def _factor_shifted(d, e, lam, pivmin):
+    """Partial-pivot LU of (T - lam I) for one shift: returns per-row
+    (u1, u2, u3) (U's three stored diagonals), multipliers m and swap
+    flags — LAPACK dgttrf's recurrence as one scan.  ``pivmin`` is the
+    zero-pivot replacement (scale-relative, kept far above the TPU f64
+    emulation's ~1e-38 flush-to-zero line)."""
+    n = d.shape[0]
+    dt = d.dtype
+    tiny = pivmin
+    ep = jnp.concatenate([e, jnp.zeros((1,), dt)])
+
+    def step(carry, xs):
+        p1, p2, p3 = carry  # pending pivot row (cols k, k+1, k+2)
+        ek, dk1, ek1 = xs  # sub-diag e_k, next diagonal, next sub-diag
+        swap = jnp.abs(ek) > jnp.abs(p1)
+        r1 = jnp.where(swap, ek, p1)
+        r2 = jnp.where(swap, dk1, p2)
+        r3 = jnp.where(swap, ek1, p3)
+        s1 = jnp.where(swap, p1, ek)
+        s2 = jnp.where(swap, p2, dk1)
+        s3 = jnp.where(swap, p3, ek1)
+        piv = jnp.where(jnp.abs(r1) < tiny, tiny, r1)
+        m = s1 / piv
+        n2 = s2 - m * r2
+        n3 = s3 - m * r3
+        return (n2, n3, jnp.zeros((), dt)), (piv, r2, r3, m, swap)
+
+    d0 = d - lam
+    xs = (ep[:-1], d0[1:] if n > 1 else jnp.zeros((0,), dt),
+          ep[1:] if n > 1 else jnp.zeros((0,), dt))
+    init = (d0[0], ep[0], jnp.zeros((), dt))
+    (fin1, _, _), rows = lax.scan(step, init, xs)
+    u1 = jnp.concatenate([rows[0], jnp.where(
+        jnp.abs(fin1) < tiny, tiny, fin1)[None]])
+    u2 = jnp.concatenate([rows[1], jnp.zeros((1,), dt)])
+    u3 = jnp.concatenate([rows[2], jnp.zeros((1,), dt)])
+    m = jnp.concatenate([rows[3], jnp.zeros((1,), dt)])
+    swap = jnp.concatenate([rows[4], jnp.zeros((1,), bool)])
+    return u1, u2, u3, m, swap
+
+
+def _solve_factored(u1, u2, u3, m, swap, b):
+    """Solve L U x = P b given the factor streams."""
+    n = b.shape[0]
+    dt = b.dtype
+
+    def fwd(carry, xs):
+        bk = carry  # current rhs entry at row k (pre-elimination)
+        bk1, mk, sk = xs
+        hi = jnp.where(sk, bk1, bk)
+        lo = jnp.where(sk, bk, bk1)
+        lo = lo - mk * hi
+        return lo, hi
+
+    last, y = lax.scan(fwd, b[0], (b[1:], m[:-1], swap[:-1]))
+    y = jnp.concatenate([y, last[None]])
+
+    def bwd(carry, xs):
+        x1, x2 = carry  # x[k+1], x[k+2]
+        yk, a1, a2, a3 = xs
+        xk = (yk - a2 * x1 - a3 * x2) / a1
+        return (xk, x1), xk
+
+    z = jnp.zeros((), dt)
+    (x0, _), xs_r = lax.scan(
+        bwd, (z, z),
+        (y[::-1], u1[::-1], u2[::-1], u3[::-1]),
+    )
+    return xs_r[::-1]
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def stein(
+    d: jnp.ndarray, e: jnp.ndarray, w: jnp.ndarray, iters: int = 2
+) -> jnp.ndarray:
+    """Eigenvectors of tridiag(d, e) for the eigenvalues w by batched
+    inverse iteration + CholQR2 orthonormalization.  Returns Z (n, n)
+    with T Z ~= Z diag(w)."""
+    n = d.shape[0]
+    dt = d.dtype
+    if n == 1:
+        return jnp.ones((1, 1), dt)
+    # separate equal shifts a hair so iterates within an exact cluster
+    # are not numerically identical columns (the orthonormalization
+    # needs an independent basis to work with)
+    scale = jnp.maximum(jnp.abs(d).max(), jnp.abs(e).max())
+    scale = jnp.where(scale > 0, scale, 1.0)
+    jitter = (jnp.arange(n, dtype=dt) - 0.5 * n) * (
+        4.0 * jnp.finfo(dt).eps * scale
+    )
+    lam = w + jitter
+
+    pivmin = scale * jnp.asarray(1e-30, dt)
+    u1, u2, u3, m, swap = jax.vmap(
+        lambda l: _factor_shifted(d, e, l, pivmin)
+    )(lam)
+
+    # deterministic pseudo-random start vectors: the counter-based
+    # Philox stream (structured starts like sin-grids can be nearly
+    # orthogonal to whole eigenvector families — e.g. the Toeplitz
+    # sin-basis — and stall the iteration)
+    from ..matgen.philox import _bits_to_unit_jnp, philox_2x64_jnp
+
+    ii = jnp.broadcast_to(jnp.arange(n)[:, None], (n, n))
+    jj = jnp.broadcast_to(jnp.arange(n)[None, :], (n, n))
+    Lbits, _Rbits = philox_2x64_jnp(ii.reshape(-1), jj.reshape(-1), 0x5E17)
+    B0 = _bits_to_unit_jnp(Lbits, dt).reshape(n, n) - 0.5
+
+    def iterate(_, V):
+        V = jax.vmap(_solve_factored)(u1, u2, u3, m, swap, V)
+        # max-scale first: a dead-on shift amplifies by ~1/pivmin and
+        # the squared norm would overflow to inf (zeroing the iterate)
+        mx = jnp.max(jnp.abs(V), axis=1, keepdims=True)
+        V = V / jnp.where(mx == 0, 1.0, mx)
+        nrm = jnp.sqrt((V * V).sum(axis=1, keepdims=True))
+        return V / jnp.where(nrm == 0, 1.0, nrm)
+
+    V = lax.fori_loop(0, iters, iterate, B0)  # rows indexed by shift
+    Z = V.T
+    # CholQR2: orthonormalize while preserving (cluster) spans
+    for _ in range(2):
+        G = _dot(Z.T, Z)
+        G = G + jnp.finfo(dt).eps * 4 * jnp.trace(G) / n * jnp.eye(n, dtype=dt)
+        L = lax.linalg.cholesky(G)
+        Z = lax.linalg.triangular_solve(
+            L, Z, left_side=False, lower=True, transpose_a=True
+        )
+    return Z

@@ -24,6 +24,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -192,6 +193,7 @@ def spmd_herk(
     alpha2=None,
     TB: jnp.ndarray = None,
     layB: TileLayout = None,
+    lower: bool = True,
 ) -> jnp.ndarray:
     """Rank-k update C = alpha op(A) op(A)^(H|T) + beta C directly from
     A's stored tiles (reference: src/herk.cc + internal_herk.cc's batched
@@ -201,14 +203,17 @@ def spmd_herk(
     materialized (a resolved A^H lives on the TRANSPOSED process grid —
     unusable for p != q meshes) and C needs no Hermitian mirror: per step
     k the full tile column (trans=False) or tile row (trans=True) of A is
-    rebuilt on every process by two all_gathers, and each local C tile
-    takes its update from the two gathered panels.  With TB given this is
+    rebuilt on every process by two all_gathers.  With TB given this is
     the rank-2k her2k/syr2k: alpha A B^H + alpha2 B A^H + beta C.
 
-    Both triangles of every local C tile are written (the Hermitian
-    wrapper references one), so the update does 2x the minimal triangle
-    FLOPs — the same redundancy internal::herk avoids by touching only
-    stored tiles; acceptable until a triangle-aware schedule lands.
+    Triangle-aware accumulation (internal::herk touches stored tiles
+    only): each process enumerates its local STORED-triangle tile pairs
+    (a static-size packed list, indices traced from the mesh
+    coordinates), accumulates the rank-k updates as one batched matmul
+    over that packed list per step — half the all-pairs FLOPs — and
+    scatters into the tile array once at the end.  Non-stored local
+    tiles come back as beta * C only (the Hermitian wrapper never
+    references them).
     """
     p, q = grid.p, grid.q
     kt_total = layA.mt if trans else layA.nt
@@ -219,6 +224,22 @@ def spmd_herk(
     row_scatter = jnp.asarray(layA.row_scatter)
     col_scatter = jnp.asarray(layA.col_scatter)
 
+    # static upper bound of stored-triangle local pairs over all
+    # processes (the packed batch size; per-process indices are traced)
+    npairs = 0
+    for rr in range(p):
+        for cc in range(q):
+            gi_ = np.arange(mtl) * p + rr
+            gj_ = np.arange(ntl) * q + cc
+            st = (
+                (gi_[:, None] >= gj_[None, :])
+                if lower
+                else (gi_[:, None] <= gj_[None, :])
+            )
+            st &= (gi_[:, None] < layC.mt) & (gj_[None, :] < layC.nt)
+            npairs = max(npairs, int(st.sum()))
+    npairs = max(npairs, 1)
+
     def cj(x):
         return jnp.conj(x) if (conj and complex_t) else x
 
@@ -227,6 +248,18 @@ def spmd_herk(
         c = lax.axis_index(COL_AXIS)
         gi = jnp.arange(mtl) * p + r
         gj = jnp.arange(ntl) * q + c
+
+        stored = (
+            (gi[:, None] >= gj[None, :])
+            if lower
+            else (gi[:, None] <= gj[None, :])
+        )
+        stored &= (gi[:, None] < layC.mt) & (gj[None, :] < layC.nt)
+        flat = stored.reshape(-1)
+        order = jnp.argsort(~flat, stable=True)[:npairs]
+        I_idx = order // ntl
+        J_idx = order % ntl
+        slot_ok = flat[order]  # False on padding slots (non-stored)
 
         def gather_col(t, k):
             # tile column k in NATURAL tile-row order: (layA.P, mb, kb)
@@ -253,16 +286,20 @@ def spmd_herk(
                 pb = gather_col(tbs[0], k) if rank2 else pa
             return pa, pb
 
+        gi_p = gi[I_idx]  # global tile rows of the packed pairs
+        gj_p = gj[J_idx]
+
         def tile_upd(pl, pr):
-            # C_ij += op(L)_i,k op(R)_j,k^(H|T) for local (i, j)
+            # packed batch: C_pair += op(L)_i,k op(R)_j,k^(H|T) over the
+            # stored-triangle pairs only (half the all-pairs FLOPs)
             if trans:
                 # op(M)_{i,k} = M_{k,i}^(H|T): contraction over panel rows
                 return jnp.einsum(
-                    "ica,jcb->ijab", cj(pl[gi]), pr[gj],
+                    "pca,pcb->pab", cj(pl[gi_p]), pr[gj_p],
                     preferred_element_type=acc_t,
                 )
             return jnp.einsum(
-                "iak,jbk->ijab", pl[gi], cj(pr[gj]),
+                "pak,pbk->pab", pl[gi_p], cj(pr[gj_p]),
                 preferred_element_type=acc_t,
             )
 
@@ -271,12 +308,13 @@ def spmd_herk(
                 return acc + alpha * tile_upd(pa, pb) + alpha2 * tile_upd(pb, pa)
             return acc + alpha * tile_upd(pa, pa)
 
+        acc = jnp.zeros((npairs,) + tc.shape[2:], acc_t)
+
         def step(k, carry):
             acc, (pa, pb) = carry
             nxt = panels(k + 1)  # lookahead: gather before the einsum
             return apply(acc, pa, pb), nxt
 
-        acc = jnp.zeros(tc.shape, acc_t)
         if kt_total > 0:
             # loop stops one short so the lookahead never gathers an
             # out-of-range panel; the last panel applies after the loop
@@ -284,7 +322,13 @@ def spmd_herk(
                 0, kt_total - 1, step, (acc, panels(0))
             )
             acc = apply(acc, pa, pb)
-        out = acc + beta * tc.astype(acc_t)
+        # one scatter back to tile-array form (padding slots zeroed; a
+        # duplicate padding pair can only target a non-stored tile)
+        acc = jnp.where(slot_ok[:, None, None], acc, 0)
+        acc_full = (
+            jnp.zeros(tc.shape, acc_t).at[I_idx, J_idx].add(acc)
+        )
+        out = acc_full + beta * tc.astype(acc_t)
         return out.astype(tc.dtype)
 
     spec = P(ROW_AXIS, COL_AXIS)

@@ -183,8 +183,8 @@ def test_public_api_surface():
 
 
 def test_hesv_zero_leading_minors(rng):
-    """hetrf must survive exactly-singular leading minors via the RBT
-    fallback (reference hetrf's Aasen pivoting handles these natively)."""
+    """hetrf must survive exactly-singular leading minors via the
+    pivoted Aasen refactor (the reference hetrf's algorithm)."""
     import jax.numpy as jnp
 
     n = 32
@@ -192,7 +192,7 @@ def test_hesv_zero_leading_minors(rng):
     B0 = rng.standard_normal((n, 3))
     A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
     X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
-    assert hasattr(L, "_rbt")
+    assert hasattr(L, "_aasen"), "breakdown must refactor with Aasen"
     assert np.abs(A0 @ np.asarray(X.to_global()) - B0).max() < 1e-8
 
 
@@ -209,7 +209,7 @@ def test_hesv_zero_minors_complex(rng):
 
 def test_hesv_near_singular_leading_minor(rng):
     """A 1e-13-pivot leading minor (not an exact zero) must trip the
-    growth/d-ratio breakdown detection and take the RBT fallback —
+    growth/d-ratio breakdown detection and refactor with Aasen —
     exact-zero-only detection would hand the catastrophic growth to IR
     (VERDICT r2 weak point #30)."""
     import jax.numpy as jnp
@@ -221,6 +221,60 @@ def test_hesv_near_singular_leading_minor(rng):
     B0 = rng.standard_normal((n, 3))
     A = HermitianMatrix.from_global(jnp.asarray(A0), 8, uplo=Uplo.Lower)
     X, L, d, info = indef.hesv(A, Matrix.from_global(jnp.asarray(B0), 8))
-    assert hasattr(L, "_rbt"), "near-singular minor must trigger the butterfly"
+    assert hasattr(L, "_aasen"), "near-singular minor must trip the refactor"
     res = np.abs(A0 @ np.asarray(X.to_global()) - B0).max()
     assert res < 1e-9 * max(np.abs(A0).max(), 1.0)
+
+
+def test_hetrf_aasen_direct(rng):
+    """Aasen's pivoted LTL^H (reference: src/hetrf.cc's algorithm) as an
+    explicit method: factor + solve residuals at LAPACK grade."""
+    from slate_tpu.drivers.indefinite import hetrf, hetrs
+
+    n, nb = 64, 16
+    A0 = rng.standard_normal((n, n))
+    A0 = (A0 + A0.T) / 2
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    L, d, info = hetrf(A, method="aasen")
+    assert int(info) == 0
+    assert getattr(L, "_aasen", None) is not None
+    B0 = rng.standard_normal((n, 3))
+    B = Matrix.from_global(B0, nb)
+    X = hetrs(L, d, B)
+    err = np.abs(A0 @ np.asarray(X.to_global()) - B0).max()
+    assert err < 1e-11 * n, err
+
+
+def test_hetrf_aasen_complex(rng):
+    from slate_tpu.drivers.indefinite import hetrf, hetrs
+
+    n, nb = 48, 16
+    A0 = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    A0 = (A0 + A0.conj().T) / 2
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    L, d, info = hetrf(A, method="aasen")
+    B0 = (rng.standard_normal((n, 2))
+          + 1j * rng.standard_normal((n, 2)))
+    B = Matrix.from_global(B0, nb)
+    X = hetrs(L, d, B)
+    err = np.abs(A0 @ np.asarray(X.to_global()) - B0).max()
+    assert err < 1e-11 * n, err
+
+
+def test_hetrf_auto_breakdown_routes_to_aasen(rng):
+    """The zero-diagonal chain breaks the pivot-free pass; 'auto' must
+    recover through the pivoted Aasen factorization."""
+    from slate_tpu.drivers.indefinite import hesv, hetrf
+
+    n, nb = 32, 8
+    A0 = np.diag(np.ones(n - 1), 1) + np.diag(np.ones(n - 1), -1)
+    A = HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower)
+    L, d, info = hetrf(A)
+    assert getattr(L, "_aasen", None) is not None, (
+        "breakdown must refactor with Aasen"
+    )
+    B0 = rng.standard_normal((n, 2))
+    B = Matrix.from_global(B0, nb)
+    X, L2, d2, info2 = hesv(A, B)
+    err = np.abs(A0 @ np.asarray(X.to_global()) - B0).max()
+    assert err < 1e-10 * n, err

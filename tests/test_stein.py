@@ -1,0 +1,73 @@
+"""Batched inverse-iteration tridiagonal eigenvectors (ops/stein.py) —
+the independent fallback for stedc (reference role: steqr_impl.cc;
+algorithmically dstebz+dstein)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.drivers.eig import steqr
+from slate_tpu.ops.bulge import tridiag_eigvals_bisect
+from slate_tpu.ops.stein import stein
+
+
+def _check(d, e, rtol=5e-11):
+    d = jnp.asarray(d, jnp.float64)
+    e = jnp.asarray(e, jnp.float64)
+    n = d.shape[0]
+    w = tridiag_eigvals_bisect(d, e)
+    Z = stein(d, e, w)
+    T = (
+        np.diag(np.asarray(d))
+        + np.diag(np.asarray(e), 1)
+        + np.diag(np.asarray(e), -1)
+    )
+    wn = np.asarray(w)
+    Zn = np.asarray(Z)
+    scale = max(np.abs(wn).max(), 1e-30)
+    res = np.abs(T @ Zn - Zn * wn[None, :]).max() / scale
+    assert res < rtol * n, res
+    orth = np.abs(Zn.T @ Zn - np.eye(n)).max()
+    assert orth < rtol * n, orth
+
+
+@pytest.mark.parametrize("n", [2, 3, 16, 64, 157])
+def test_random(n):
+    rng = np.random.default_rng(n)
+    _check(rng.standard_normal(n), rng.standard_normal(max(n - 1, 0)))
+
+
+def test_toeplitz():
+    _check(np.zeros(96), np.ones(95))
+
+
+def test_identity_cluster():
+    # fully degenerate spectrum: any orthonormal basis is an eigenbasis
+    _check(np.ones(32), np.zeros(31))
+
+
+def test_wilkinson():
+    m = 10
+    _check(np.abs(np.arange(-m, m + 1)).astype(float), np.ones(2 * m))
+
+
+def test_scaled():
+    rng = np.random.default_rng(5)
+    _check(1e8 * rng.standard_normal(48), 1e8 * rng.standard_normal(47))
+
+
+def test_steqr_method_stein():
+    rng = np.random.default_rng(11)
+    d = jnp.asarray(rng.standard_normal(40))
+    e = jnp.asarray(rng.standard_normal(39))
+    w, Z = steqr(d, e, vectors=True, method="stein")
+    T = (
+        np.diag(np.asarray(d))
+        + np.diag(np.asarray(e), 1)
+        + np.diag(np.asarray(e), -1)
+    )
+    assert np.abs(
+        np.asarray(T @ Z) - np.asarray(Z * w[None, :])
+    ).max() < 1e-10

@@ -38,10 +38,20 @@ def main() -> int:
     rng = np.random.default_rng(0)
 
     def timed(fn, *a, tries=3):
+        """Steady-state wall time with HOST READBACK as the barrier:
+        block_until_ready is NOT a reliable execution barrier over this
+        tunnel (bench.py methodology) — a device-side scalar reduce +
+        one-element readback is."""
+
+        def run(args):
+            out = fn(*args)
+            s = jax.tree.leaves(out)[0].ravel()[-1]
+            return float(np.asarray(s))
+
         last = None
         for attempt in range(4):
             try:
-                jax.block_until_ready(fn(*a))
+                run(a)
                 break
             except Exception as e:
                 last = e
@@ -53,7 +63,7 @@ def main() -> int:
         for t in range(tries):
             a2 = tuple(x + (t + 1) * 1e-13 for x in a)
             t0 = time.time()
-            jax.block_until_ready(fn(*a2))
+            run(a2)
             best = min(best, time.time() - t0)
         return best
 

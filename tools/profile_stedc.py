@@ -44,14 +44,23 @@ def main() -> int:
     out = {}
 
     def timed(fn, *a):
-        """Steady-state time: compile+run once, then rerun on perturbed
-        input (the tunnel caches identical dispatches).  The tunnel's
-        remote-compile service sporadically drops connections
-        ("response body closed"); retry a few times."""
+        """Steady-state time with HOST READBACK as the barrier
+        (block_until_ready is not a reliable execution barrier over
+        this tunnel — bench.py methodology): compile+run once, rerun on
+        perturbed input (the tunnel caches identical dispatches), read
+        one scalar back.  The tunnel's remote-compile service
+        sporadically drops connections; retry a few times."""
+
+        def run(args):
+            out = fn(*args)
+            s = jax.tree.leaves(out)[0].ravel()[-1]
+            float(np.asarray(s))
+            return out
+
         last = None
         for attempt in range(4):
             try:
-                o = jax.block_until_ready(fn(*a))
+                o = run(a)
                 break
             except Exception as e:  # transient tunnel failure
                 last = e
@@ -64,7 +73,7 @@ def main() -> int:
             lambda x: x + jnp.asarray(1e-14, x.dtype)
             if jnp.issubdtype(x.dtype, jnp.floating) else x, a)
         t0 = time.time()
-        o = jax.block_until_ready(fn(*a2))
+        o = run(a2)
         return time.time() - t0, o
 
     for n in args.n:

@@ -118,98 +118,30 @@ def main() -> int:
         jnp.asarray(H0), 128, uplo=Uplo.Lower
     )
 
-    # STAGE-SPLIT jits: one whole-heev jit at n >= 2048 exceeds what the
-    # tunnel's remote-compile service survives ("response body closed"),
-    # so each stage compiles separately (also giving the per-stage
-    # timing breakdown for the wall-clock analysis); glue between stages
-    # is a handful of dispatches at ~100 ms tunnel latency each.
+    # The product stage-split path (drivers/eig.py heev_staged): one
+    # whole-heev jit at n >= 2048 exceeds what the tunnel's
+    # remote-compile service survives ("response body closed"), so the
+    # driver compiles the four stages separately, with the native host
+    # chaser for stage 2 when available.
     from slate_tpu import native as native_mod
-    from slate_tpu.matrix.matrix import Matrix as _M
-    from slate_tpu.ops import bulge, stedc as stedc_mod
-    from slate_tpu.ops.bulge import hb2st as _hb2st
-    from slate_tpu.parallel.band_gather import band_storage_tiles
+    from slate_tpu.drivers.eig import heev_staged
 
-    b = 128
-    stage_t = {}
-
-    def timed(name, fn, *a):
-        t0 = time.time()
-        out = jax.block_until_ready(fn(*a))
-        stage_t[name] = round(time.time() - t0, 2)
-        print(f"  stage {name}: {stage_t[name]}s", flush=True)
-        return out
-
-    use_native = native_mod.hb2st_available()
-    print(f"native hb2st: {use_native}", flush=True)
-    _hb2st_jit = jax.jit(_hb2st, static_argnums=(1, 2))
-
-    @jax.jit
-    def _stage1(A):
-        # band-limited gather (he2hbGather): O(n kd) packed storage
-        # straight from the band tiles, never the dense n x n
-        band, V, T = eig.he2hb(A)
-        W = band_storage_tiles(band.data, band.layout, n_eig + 4 * b + 8)
-        return W, V.data, T.T
-
-    def _stage2(W):
-        # the native host chaser (the product default on this path —
-        # drivers/eig.py heev routes eager real-f64 stage 2 here); the
-        # on-chip wavefront remains the jitted fallback
-        if use_native:
-            d, e, VS, TAUS = native_mod.hb2st_host(np.asarray(W), n_eig, b)
-            return (jnp.asarray(d), jnp.asarray(e),
-                    jnp.ones((n_eig,), jnp.float64),
-                    jnp.asarray(VS), jnp.asarray(TAUS))
-        return _hb2st_jit(W, n_eig, b)
-
-    @jax.jit
-    def _stage3(d, e, u, VS, TAUS):
-        wv, ZT = stedc_mod.stedc(d, e)
-        Z2 = bulge.unmtr_hb2st(
-            VS=VS, TAUS=TAUS, Z=(u[:, None] * ZT), n=n_eig, b=b
-        )
-        return wv, Z2
-
-    from slate_tpu.enums import Op, Side
-    from slate_tpu.parallel.layout import tiles_from_global
-    from slate_tpu.types import TriangularFactors
-
-    @jax.jit
-    def _stage4(Vd, Ts, Zd):
-        Z = eig.unmtr_he2hb(
-            Side.Left,
-            Op.NoTrans,
-            _M(Vd, A.layout, grid=A.grid),
-            TriangularFactors(Ts),
-            _M(Zd, A.layout, grid=A.grid),
-        )
-        return Z.data
-
-    @jax.jit
-    def _pack_z(Z2):
-        return tiles_from_global(Z2, A.layout)
-
-    def run_all(A):
-        t0 = time.time()
-        W, Vd, Ts = timed("he2hb+gather", _stage1, A)
-        d, e, u, VS, TAUS = timed("hb2st", _stage2, W)
-        wv, Z2 = timed("stedc+unmtr_hb2st", _stage3, d, e, u, VS, TAUS)
-        Zd = timed("unmtr_he2hb", _stage4, Vd, Ts, _pack_z(Z2))
-        return np.asarray(wv), np.asarray(
-            _M(Zd, A.layout, grid=A.grid).to_global()
-        ), time.time() - t0
-
+    print(f"native hb2st: {native_mod.hb2st_available()}", flush=True)
     print("compiling heev stages...", flush=True)
     tc0 = time.time()
-    run_all(A)
+    heev_staged(A, vectors=True)
     print(f"heev stages compile+first run: {time.time() - tc0:.1f}s",
           flush=True)
     # perturb the input: the tunnel caches identical dispatches
     # (BENCH_NOTES.md methodology), so timing a replay measures nothing
     A = A._with(data=A.data + jnp.float64(1e-14))
     H0 = H0 + 1e-14
-    w, Zg, dt = run_all(A)
-    t0, t1 = 0.0, dt
+    t0 = time.time()
+    w, Zm, stage_t = heev_staged(A, vectors=True)
+    t1 = time.time() - t0
+    t0 = 0.0
+    w = np.asarray(w)
+    Zg = np.asarray(Zm.to_global())
     print(f"stage breakdown: {stage_t}", flush=True)
     results["heev_stages"] = dict(stage_t)
     err = np.abs(H0 @ Zg - Zg * w[None, :]).max() / (
