@@ -10,7 +10,7 @@ from typing import Callable, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from ..enums import Diag, Norm, NormScope, Uplo
+from ..enums import Diag, Norm, NormScope, Op, Uplo
 from ..exceptions import DimensionError
 from ..internal import norms as _norms
 from ..internal import tile_ops
@@ -132,14 +132,34 @@ def redistribute(A: BaseMatrix, B: BaseMatrix, opts=None) -> BaseMatrix:
     """Copy A into B's (different) distribution (reference:
     src/redistribute.cc — per-tile sends between the two layouts).
 
-    One storage-to-storage gather: every element of B's tile array
-    addresses its source element in A's tile array directly (no padded
-    global intermediate); under sharded inputs GSPMD lowers the gather
-    to the needed collectives — which it is free to implement by
-    replicating A, so distributed inputs are recorded as a gathered
-    route (internal/fallbacks accounting)."""
+    Distributed same-grid inputs run the SPMD two-phase masked-psum
+    re-send (parallel/spmd_redistribute.py — O(n^2/q + n^2/p) per
+    process, the explicit-traffic analogue of the reference's per-tile
+    sends).  Otherwise: one storage-to-storage gather — every element
+    of B's tile array addresses its source element in A's tile array
+    directly (no padded global intermediate); under sharded inputs
+    GSPMD lowers the gather to collectives it is free to implement by
+    replicating A, so that route is recorded (internal/fallbacks)."""
     _check_same_shape(A, B)
+    from ..enums import Option as _Opt
     from ..matrix.base import is_distributed as _is_dist
+    from ..options import get_option as _get
+
+    if (
+        (_is_dist(A) or _is_dist(B))
+        and _get(opts, _Opt.UseShardMap)
+        and A.op == Op.NoTrans
+        and B.op == Op.NoTrans
+        and (A.layout.p, A.layout.q) == (B.layout.p, B.layout.q)
+        and A.grid is not None
+        and A.layout.p * A.layout.q > 1
+    ):
+        from ..parallel.spmd_redistribute import spmd_redistribute
+
+        out = spmd_redistribute(
+            A.grid, A.data, A.layout, B.layout, out_dtype=B.dtype
+        )
+        return B._with(data=out).shard()
 
     if _is_dist(A) or _is_dist(B):
         from ..internal import fallbacks

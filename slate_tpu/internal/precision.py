@@ -62,4 +62,34 @@ import functools as _functools
 
 from jax import lax as _lax
 
-hdot = _functools.partial(jnp.matmul, precision=_lax.Precision.HIGHEST)
+_hdot_raw = _functools.partial(jnp.matmul, precision=_lax.Precision.HIGHEST)
+
+# The chip's f64 emulation additionally LOSES ITS COMPENSATION TERMS on
+# cancellation-heavy contractions once the contraction length reaches
+# 4096: Q^T Q off-diagonals (sums of +-1e-2 terms cancelling to ~1e-16)
+# measure 6.5e-7 ABSOLUTE error at k=4096 vs 1e-15 at k=2048, while
+# non-cancelling random products stay at ~1e-13 (round-5 diagnosis;
+# tools/profile_* reproduce it).  Chunking the contraction at 2048 and
+# accumulating in f64 restores 3.8e-15.  hdot therefore k-chunks every
+# emulated-f64 matmul with k >= 4096 — the chunk loop is python-static,
+# two extra adds per 8192-contraction, MXU throughput unaffected.
+_KCHUNK = 2048
+_F64 = (jnp.dtype("float64"), jnp.dtype("complex128"))
+
+
+def hdot(a, b, **kw):
+    k = a.shape[-1]
+    try:
+        emul64 = (
+            jnp.dtype(a.dtype) in _F64
+            and jax.default_backend() != "cpu"
+        )
+    except TypeError:
+        emul64 = False
+    if not emul64 or k < 2 * _KCHUNK or a.ndim != 2 or b.ndim != 2:
+        return _hdot_raw(a, b, **kw)
+    acc = None
+    for s in range(0, k, _KCHUNK):
+        part = _hdot_raw(a[:, s : s + _KCHUNK], b[s : s + _KCHUNK, :], **kw)
+        acc = part if acc is None else acc + part
+    return acc

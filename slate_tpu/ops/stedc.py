@@ -34,7 +34,6 @@ its own edge.
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -42,7 +41,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-_dot = functools.partial(jnp.matmul, precision=lax.Precision.HIGHEST)
+# the emulation-safe HIGHEST matmul: k-chunks cancellation-sensitive
+# f64 contractions at k >= 4096 (the chip's emulation loses its
+# compensation there — see internal/precision.py).  The merge
+# back-rotations and the final polish are exactly such products.
+from ..internal.precision import hdot as _dot
 
 _BISECT = 18  # geometric bisection phase: localizes to ~2e-4 relative
 _NEWTON = 10  # hybrid Newton/geometric phase: eps from there
@@ -440,17 +443,22 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     QT = QT.reshape(N, N)
     QT = QT[:n, :n]
     if jax.default_backend() != "cpu" and n >= 1024:
-        # one CholQR orthogonality polish: the f64 emulation's extra
-        # rounding in the secular/Lowner arithmetic accumulates to
-        # ~100 n eps orthogonality loss by n=4096, concentrated in
-        # near-degenerate pairs.  Q <- Q chol(Q^T Q)^-T restores
-        # eps-grade orthogonality; the induced residual change is
-        # bounded by |E_ij (w_i - w_j)| — and E is large only where
-        # the gap is small, so the eigen-residual is preserved.
-        G = _dot(QT, QT.T)
-        from .chol_kernels import cholesky as _chol, tri_inv_blocked
-
-        Lc = jnp.tril(_chol(G, 512))
-        QT = _dot(tri_inv_blocked(Lc), QT)
+        # one Newton-Schulz orthogonality polish: the f64 emulation's
+        # extra rounding in the secular/Lowner arithmetic accumulates
+        # to ~100 n eps orthogonality loss by n=4096, concentrated in
+        # near-degenerate pairs.  Q <- Q (3I - Q^T Q)/2 contracts the
+        # orthogonality error quadratically (1e-10 -> eps) in two MXU
+        # gemms — no factorization (a CholQR variant measured 190 s of
+        # schedule-bound vendor trsm on this toolchain and destroyed
+        # the basis).  The induced residual change is bounded by
+        # |E_ij (w_i - w_j)|, and E is large only where the gap is
+        # small, so the eigen-residual is preserved.
+        # formulated through the SMALL deviation E = Q^T Q - I: the
+        # naive 1.5 Q - 0.5 (Q^T Q) Q cancels two O(1) products and
+        # keeps their full gemm rounding (measured 6.5e-7 absolute on
+        # the chip's emulated f64); E-form keeps the correction term
+        # O(|E|) so the polish arithmetic cannot dominate the answer
+        E = _dot(QT, QT.T) - jnp.eye(n, dtype=dt)
+        QT = QT - 0.5 * _dot(E, QT)
     # single transpose back to column-eigenvector convention
     return w[:n] * scale, QT.T

@@ -1,0 +1,108 @@
+"""Distribution-to-distribution tile re-send over the mesh.
+
+TPU-native redistribute (reference: src/redistribute.cc — per-tile
+MPI sends between two layouts).  The GSPMD element-gather route in
+drivers/aux.py is free to replicate the source; this kernel bounds the
+traffic explicitly with two masked-psum phases, the same primitive the
+pivot row-exchange uses (spmd_trsm.spmd_permute_rows):
+
+1. row phase: every destination element row is fetched from its owner
+   process row with one psum over 'p' (columns stay source-distributed
+   — O(n^2 / q) per process);
+2. column phase: dual over 'q' (rows now destination-distributed —
+   O(n^2 / p) per process).
+
+Both layouts must live on the same process grid (p, q); the driver
+falls back to the recorded gather route otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .grid import COL_AXIS, ROW_AXIS, ProcessGrid
+from .layout import TileLayout
+from .spmd_blas import shard_map
+
+
+def spmd_redistribute(
+    grid: ProcessGrid,
+    TA: jnp.ndarray,
+    layA: TileLayout,
+    layB: TileLayout,
+    out_dtype=None,
+) -> jnp.ndarray:
+    """Return B's (P_B, Q_B, mbB, nbB) tile array holding A's elements."""
+    p, q = grid.p, grid.q
+    assert (layA.p, layA.q) == (p, q) and (layB.p, layB.q) == (p, q)
+    assert (layA.m, layA.n) == (layB.m, layB.n)
+    m, n = layA.m, layA.n
+    mbA, nbA = layA.mb, layA.nb
+    mbB, nbB = layB.mb, layB.nb
+    mtlA, ntlA = layA.mtl, layA.ntl
+    mtlB, ntlB = layB.mtl, layB.ntl
+    out_dtype = out_dtype or TA.dtype
+
+    # static element maps: destination padded element row -> source
+    # (tile-row slot local index, in-tile offset, owner process row)
+    dst_rows = np.minimum(
+        layB.global_rows_np.reshape(-1), m - 1
+    )  # (P_B * mbB,)
+    src_ti = np.minimum(dst_rows // mbA, layA.mt - 1)
+    row_src_local = (src_ti // p).astype(np.int32)  # local tile-row slot
+    row_src_owner = (src_ti % p).astype(np.int32)
+    row_src_off = (dst_rows % mbA).astype(np.int32)
+
+    dst_cols = np.minimum(layB.global_cols_np.reshape(-1), n - 1)
+    src_tj = np.minimum(dst_cols // nbA, layA.nt - 1)
+    col_src_local = (src_tj // q).astype(np.int32)
+    col_src_owner = (src_tj % q).astype(np.int32)
+    col_src_off = (dst_cols % nbA).astype(np.int32)
+
+    rl = jnp.asarray(row_src_local)
+    ro = jnp.asarray(row_src_owner)
+    rf = jnp.asarray(row_src_off)
+    cl = jnp.asarray(col_src_local)
+    co = jnp.asarray(col_src_owner)
+    cf = jnp.asarray(col_src_off)
+
+    def local(ta):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        # -- phase 1: rows -> B distribution (psum over 'p') -----------
+        # vals[d] = A element row for padded destination row d, over
+        # this process's LOCAL source columns
+        vals = jax.vmap(lambda sl, so: ta[sl, :, so, :])(rl, rf)
+        own = (ro == r)[:, None, None]
+        vals = jnp.where(own, vals, 0)
+        vals = lax.psum(vals, ROW_AXIS)  # (P_B*mbB, ntlA, nbA)
+        # keep this process row's destination tile rows
+        vals = vals.reshape(layB.P, mbB, ntlA, nbA)
+        gi = jnp.arange(mtlB) * p + r  # global B tile rows held here
+        slots = (gi % p) * mtlB + gi // p  # storage slots of those rows
+        mine = vals[slots]  # (mtlB, mbB, ntlA, nbA)
+
+        # -- phase 2: columns -> B distribution (psum over 'q') --------
+        flat = mine.reshape(mtlB * mbB, ntlA * nbA)
+        cols = jax.vmap(lambda sl, so: flat[:, sl * nbA + so])(cl, cf)
+        cvals = jnp.where((co == c)[:, None], cols, 0)
+        cvals = lax.psum(cvals, COL_AXIS)  # (Q_B*nbB, mtlB*mbB)
+        cvals = cvals.reshape(layB.Q, nbB, mtlB * mbB)
+        gj = jnp.arange(ntlB) * q + c
+        cslots = (gj % q) * ntlB + gj // q
+        minec = cvals[cslots]  # (ntlB, nbB, mtlB*mbB)
+        out = minec.transpose(2, 0, 1).reshape(mtlB, mbB, ntlB, nbB)
+        out = out.transpose(0, 2, 1, 3)
+        # zero the padding elements of B's layout
+        rm = jnp.asarray(layB.row_mask_np)[slots]  # (mtlB, mbB)
+        cm = jnp.asarray(layB.col_mask_np)[cslots]  # (ntlB, nbB)
+        mask = rm[:, None, :, None] & cm[None, :, None, :]
+        return jnp.where(mask, out, 0).astype(out_dtype)
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    fn = shard_map(local, mesh=grid.mesh, in_specs=(spec,), out_specs=spec)
+    return fn(TA)

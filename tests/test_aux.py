@@ -258,3 +258,18 @@ class TestDebugDumps:
         assert "PartitionSpec" in s or "sharding:" in s
         # ownership map shows the 2x2 cyclic pattern
         assert "0,0" in s and "1,1" in s
+
+
+def test_redistribute_spmd_no_fallback(rng, grid22):
+    """Same-grid distributed redistribute takes the SPMD two-phase
+    re-send (parallel/spmd_redistribute.py) — no recorded gather."""
+    from slate_tpu.enums import Option
+    from slate_tpu.internal import fallbacks
+
+    A0 = rng.standard_normal((70, 52))
+    A = Matrix.from_global(A0, 16, grid=grid22)
+    B = Matrix.from_global(np.zeros((70, 52)), 8, grid=grid22)
+    fallbacks.reset()
+    out = aux.redistribute(A, B, opts={Option.RequireSpmd: True})
+    assert fallbacks.counters() == {}
+    np.testing.assert_allclose(np.asarray(out.to_global()), A0, atol=0)
