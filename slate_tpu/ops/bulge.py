@@ -203,28 +203,46 @@ def unmtr_hb2st(
     def conj(x):
         return jnp.conj(x) if complex_t else x
 
-    Zp = jnp.pad(Z, ((0, b + J1 * b + 8), (0, 0)))  # slice slack
+    def apply_panel(Zp, w):
+        # one Z column panel of width w through ALL sweeps
+        def sweep_apply(k, Zp):
+            s = (n_sweeps - 1 - k) if not trans else k
+            # sweep s's reflector rows s+1+j*b+arange(b) tile the
+            # CONTIGUOUS range [s+1, s+1+J1*b): one dynamic_slice +
+            # update_slice instead of a row gather/scatter pair (the
+            # gather form was the stage-3 wall-clock bottleneck at
+            # n=4096 on-chip).  Rows past n-1 fall in the zero padding
+            # where VS/TAUS are zero, so the update is an exact no-op
+            # there — no masking needed.
+            v = VS[s]  # (J1, b)
+            tau = TAUS[s]  # (J1,)
+            tau = conj(tau) if trans else tau
+            Zr = lax.dynamic_slice(Zp, (s + 1, 0), (J1 * b, w)).reshape(
+                J1, b, w
+            )
+            wrow = jnp.einsum("jb,jbm->jm", conj(v), Zr)
+            Zr = Zr - tau[:, None, None] * v[:, :, None] * wrow[:, None, :]
+            return lax.dynamic_update_slice(
+                Zp, Zr.reshape(-1, w), (s + 1, 0)
+            )
 
-    def sweep_apply(k, Zp):
-        s = (n_sweeps - 1 - k) if not trans else k
-        # sweep s's reflector rows s+1+j*b+arange(b) tile the CONTIGUOUS
-        # range [s+1, s+1+J1*b): one dynamic_slice + update_slice instead
-        # of a row gather/scatter pair (the gather form was the stage-3
-        # wall-clock bottleneck at n=4096 on-chip).  Rows past n-1 fall
-        # in the zero padding where VS/TAUS are zero, so the update is an
-        # exact no-op there — no masking needed.
-        v = VS[s]  # (J1, b)
-        tau = TAUS[s]  # (J1,)
-        tau = conj(tau) if trans else tau
-        Zr = lax.dynamic_slice(Zp, (s + 1, 0), (J1 * b, m)).reshape(
-            J1, b, m
-        )
-        wrow = jnp.einsum("jb,jbm->jm", conj(v), Zr)
-        Zr = Zr - tau[:, None, None] * v[:, :, None] * wrow[:, None, :]
-        return lax.dynamic_update_slice(Zp, Zr.reshape(-1, m), (s + 1, 0))
+        return lax.fori_loop(0, n_sweeps, sweep_apply, Zp)
 
-    Zp = lax.fori_loop(0, n_sweeps, sweep_apply, Zp)
-    return Zp[: Z.shape[0]]
+    pad = b + J1 * b + 8
+    # column blocking: running every sweep over one Z panel before
+    # moving to the next keeps the streamed working set per sweep at
+    # O(J1 b w) instead of O(J1 b m) — measured 50.7 s -> ~33 s for the
+    # full n=4096 back-transform on-chip (tools/profile_unmtr.py)
+    wpan = 512
+    if m <= wpan:
+        Zp = jnp.pad(Z, ((0, pad), (0, 0)))
+        return apply_panel(Zp, m)[: Z.shape[0]]
+    panels = []
+    for c0 in range(0, m, wpan):
+        w = min(wpan, m - c0)  # narrow last panel keeps the blocking
+        Zp = jnp.pad(Z[:, c0 : c0 + w], ((0, pad), (0, 0)))
+        panels.append(apply_panel(Zp, w)[: Z.shape[0]])
+    return jnp.concatenate(panels, axis=1)
 
 
 @partial(jax.jit, static_argnames=("max_iter",))

@@ -144,7 +144,7 @@ def _block_qr(
 
 
 def geqrf_fast(
-    G: jnp.ndarray, nb: int = 512, ib: int = 32, coarse_panels: int = 4
+    G: jnp.ndarray, nb: int = 512, ib: int = 128, coarse_panels: int = 4
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Blocked Householder QR of an (m, n) array, m >= n, n a multiple
     of nb.  Returns (G_factored, taus) in LAPACK geqrf layout — the
@@ -152,6 +152,11 @@ def geqrf_fast(
     rate on the chip."""
     m, n = G.shape
     assert m >= n and n % nb == 0, f"geqrf_fast: bad shape {(m, n)} nb={nb}"
+    # ib=128 is tuned at nb=512 (tools/profile_geqrf_ib.py, n=8192:
+    # 280 -> 346 GF/s over ib=32); smaller-nb fallback panels must keep
+    # at least 4 strips per panel or the strip-level compact-WY applies
+    # degenerate into the slow per-column tail path
+    ib = min(ib, max(nb // 4, 32))
     nt = n // nb
     taus = jnp.zeros((n,), G.dtype)
     if nt <= 1:

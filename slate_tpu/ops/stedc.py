@@ -395,6 +395,10 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         # (x n eps) at n=1024/2048/4096 with a flat 32x factor — the
         # sqrt(n) term holds the 4096 case under the 100x bound while
         # residuals keep ~30x headroom (BENCH_NOTES round 5).
+        # (measured r5: widening the factor further — 64x at n=4096 —
+        # does not move orthogonality; the ~108 n eps at n=4096 comes
+        # from the merge arithmetic's emulation rounding, not from
+        # undeflated noise pairs)
         eps *= 32.0 * max(1.0, float(np.sqrt(n / 2048.0)))
     if n == 1:
         return d, jnp.ones((1, 1), dt)
@@ -442,23 +446,15 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     w = w.reshape(N)
     QT = QT.reshape(N, N)
     QT = QT[:n, :n]
-    if jax.default_backend() != "cpu" and n >= 1024:
-        # one Newton-Schulz orthogonality polish: the f64 emulation's
-        # extra rounding in the secular/Lowner arithmetic accumulates
-        # to ~100 n eps orthogonality loss by n=4096, concentrated in
-        # near-degenerate pairs.  Q <- Q (3I - Q^T Q)/2 contracts the
-        # orthogonality error quadratically (1e-10 -> eps) in two MXU
-        # gemms — no factorization (a CholQR variant measured 190 s of
-        # schedule-bound vendor trsm on this toolchain and destroyed
-        # the basis).  The induced residual change is bounded by
-        # |E_ij (w_i - w_j)|, and E is large only where the gap is
-        # small, so the eigen-residual is preserved.
-        # formulated through the SMALL deviation E = Q^T Q - I: the
-        # naive 1.5 Q - 0.5 (Q^T Q) Q cancels two O(1) products and
-        # keeps their full gemm rounding (measured 6.5e-7 absolute on
-        # the chip's emulated f64); E-form keeps the correction term
-        # O(|E|) so the polish arithmetic cannot dominate the answer
-        E = _dot(QT, QT.T) - jnp.eye(n, dtype=dt)
-        QT = QT - 0.5 * _dot(E, QT)
+    # NOTE on orthogonality at n >= 4096 on-chip: the emulated-f64
+    # rounding inside the merge arithmetic leaves ~116 n eps
+    # orthogonality (residuals/eigenvalues stay ~1 n eps; the k-chunked
+    # hdot keeps the merge back-rotations at this grade).  A final
+    # Newton-Schulz/CholQR polish does NOT help on this toolchain: the
+    # emulation quantizes the polished column norms to exactly 2^-24
+    # (f32 grade) whenever the polish consumes device-resident
+    # deep-computation values — even chunked and as a standalone jit —
+    # so a polish is deliberately absent (measured round 5; BENCH_NOTES
+    # has the table).
     # single transpose back to column-eigenvector convention
     return w[:n] * scale, QT.T
