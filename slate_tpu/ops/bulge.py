@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..internal.precision import hdot as hp
 from .householder import _larfg
 
 
@@ -181,13 +182,12 @@ def hb2st(
 
 
 @partial(jax.jit, static_argnames=("n", "b", "trans"))
-def unmtr_hb2st(
+def _unmtr_hb2st_sweep(
     VS: jnp.ndarray, TAUS: jnp.ndarray, Z: jnp.ndarray, n: int, b: int,
     trans: bool = False,
 ) -> jnp.ndarray:
-    """Apply the hb2st back-transform: Z <- Q Z (trans=False) or Q^H Z
-    (reference: src/unmtr_hb2st.cc), Q = product of all chase reflectors
-    in execution order.
+    """Per-sweep rank-1 hb2st back-transform (the pre-round-5 kernel,
+    kept as the parity reference for the diamond-blocked path below).
 
     Reflectors of one sweep act on pairwise-disjoint row blocks, so each
     sweep is ONE batched block-reflector application; sweeps run in a
@@ -243,6 +243,104 @@ def unmtr_hb2st(
         Zp = jnp.pad(Z[:, c0 : c0 + w], ((0, pad), (0, 0)))
         panels.append(apply_panel(Zp, w)[: Z.shape[0]])
     return jnp.concatenate(panels, axis=1)
+
+
+@partial(jax.jit, static_argnames=("n", "b", "trans"))
+def unmtr_hb2st(
+    VS: jnp.ndarray, TAUS: jnp.ndarray, Z: jnp.ndarray, n: int, b: int,
+    trans: bool = False,
+) -> jnp.ndarray:
+    """Apply the hb2st back-transform: Z <- Q Z (trans=False) or Q^H Z
+    (reference: src/unmtr_hb2st.cc), Q = product of all chase reflectors
+    in execution order.
+
+    Diamond-blocked compact-WY apply (the MAGMA/PLASMA bulge
+    back-transform blocking): the reflectors of ``nbl = b`` consecutive
+    sweeps at the SAME chase step j start on consecutive rows, so they
+    form a trapezoidal (b+nbl-1, nbl) block reflector ("diamond") whose
+    T factor turns nbl rank-1 updates into two GEMMs of arithmetic
+    intensity nbl — the per-sweep kernel above streams all of Z once per
+    sweep (intensity ~1) and was the stage-3 wall-clock ceiling at
+    n=4096 on-chip (~25 s; this path does the same flops at GEMM rate).
+
+    Ordering: same-sweep reflectors act on disjoint rows and commute, so
+    the only constraints are cross-sweep: (s, j) before (s+1, j) [b-1
+    overlapping rows] and (s, j+1) before (s+1, j) [one overlapping
+    row].  Both are satisfied — and every other conflicting pair shown
+    disjoint — by the schedule: sweep-blocks ascending, chase step j
+    DESCENDING within a block, sweeps ascending inside a diamond, for
+    Q^H Z; the exact reverse for Q Z.
+
+    T factors come from the compact-WY identity T^{-1} = diag(1/tau) +
+    striu(V^H V) (one batched gram GEMM + one batched triangular solve)
+    rather than the sequential larft recurrence; tau == 0 padding
+    columns get v = 0 and a placeholder unit diagonal, making them exact
+    identity factors.
+    """
+    n_sweeps, J1, _ = VS.shape
+    # placeholder VS from hb2st's n<=2 / b<=1 early exit: Q == I
+    if n_sweeps < 1 or n <= 2 or b <= 1:
+        return Z
+    m = Z.shape[1]
+    dtype = Z.dtype
+    complex_t = jnp.issubdtype(dtype, jnp.complexfloating)
+
+    def conj(x):
+        return jnp.conj(x) if complex_t else x
+
+    nbl = b
+    nblk = -(-n_sweeps // nbl)
+    ns_pad = nblk * nbl
+    h = b + nbl - 1
+    VSp = jnp.pad(VS, ((0, ns_pad - n_sweeps), (0, 0), (0, 0)))
+    TAUSp = jnp.pad(TAUS, ((0, ns_pad - n_sweeps), (0, 0)))
+    # tau == 0 (padding or H == I) must contribute an exact identity:
+    # zero its v so the T^{-1} identity below holds with a unit diagonal
+    VSp = jnp.where(TAUSp[:, :, None] != 0, VSp, 0)
+    VSb = VSp.reshape(nblk, nbl, J1, b).transpose(0, 2, 1, 3)
+    TB = TAUSp.reshape(nblk, nbl, J1).transpose(0, 2, 1)  # (nblk, J1, nbl)
+    # shift sweep i of a diamond down i rows: out[i, i + r] = in[i, r].
+    # Padding the rows to width h+1 and re-flattening IS that shift
+    # (out flat index i*h + (i+r) == in flat index i*(h+1) + r), so the
+    # trapezoid builds with zero scatters.
+    Vsh = jnp.pad(VSb, ((0, 0), (0, 0), (0, 0), (0, nbl)))
+    Vsh = Vsh.reshape(nblk, J1, nbl * (h + 1))[:, :, : nbl * h]
+    DVt = Vsh.reshape(nblk, J1, nbl, h)  # (.., i, rows)
+    DV = DVt.swapaxes(-1, -2)  # (nblk, J1, h, nbl)
+    # T^{-1} = diag(1/tau) + striu(V^H V); G's contraction length is
+    # h < 4096, safely under the emulation's k-chunk threshold
+    G = jnp.einsum(
+        "kjhi,kjhl->kjil", conj(DV), DV,
+        precision=lax.Precision.HIGHEST,
+    )
+    safe = jnp.where(TB == 0, jnp.ones_like(TB), TB)
+    invtau = jnp.where(TB == 0, jnp.ones_like(TB), 1.0 / safe)
+    Tinv = jnp.triu(G, 1) + invtau[..., None] * jnp.eye(nbl, dtype=dtype)
+    eye = jnp.broadcast_to(jnp.eye(nbl, dtype=dtype), Tinv.shape)
+    Tf = jax.scipy.linalg.solve_triangular(Tinv, eye, lower=False)
+
+    rows_needed = ns_pad + J1 * b + h
+    Zp = jnp.pad(Z, ((0, rows_needed - Z.shape[0]), (0, 0)))
+    total = nblk * J1
+
+    def step(t, Zp):
+        if trans:
+            k = t // J1
+            j = (J1 - 1) - t % J1
+        else:
+            k = (nblk - 1) - t // J1
+            j = t % J1
+        r0 = k * nbl + 1 + j * b
+        V = lax.dynamic_slice(DV, (k, j, 0, 0), (1, 1, h, nbl))[0, 0]
+        Tm = lax.dynamic_slice(Tf, (k, j, 0, 0), (1, 1, nbl, nbl))[0, 0]
+        Tm = conj(Tm).T if trans else Tm  # P^H = I - V T^H V^H
+        S = lax.dynamic_slice(Zp, (r0, 0), (h, m))
+        Y = hp(conj(V).T, S)
+        S = S - hp(V, hp(Tm, Y))
+        return lax.dynamic_update_slice(Zp, S, (r0, 0))
+
+    Zp = lax.fori_loop(0, total, step, Zp)
+    return Zp[: Z.shape[0]]
 
 
 @partial(jax.jit, static_argnames=("max_iter",))

@@ -49,6 +49,46 @@ def test_hb2st_back_transform(rng, dtype):
     assert np.abs(Z.conj().T @ Z - np.eye(n)).max() < 1e-12
 
 
+@pytest.mark.parametrize(
+    "n,b,dtype,trans",
+    [
+        (45, 6, np.float64, False),
+        (45, 6, np.complex128, False),
+        (45, 6, np.float64, True),
+        (64, 16, np.float64, False),  # n_sweeps not divisible by b
+        (37, 5, np.complex128, True),
+        (30, 2, np.float64, False),   # minimal bandwidth
+        (24, 4, np.float64, False),
+    ],
+)
+def test_unmtr_hb2st_diamond_matches_sweep(rng, n, b, dtype, trans):
+    """The diamond-blocked compact-WY apply must agree with the rank-1
+    per-sweep reference kernel on real chase reflectors."""
+    Ab = _band(rng, n, b, dtype)
+    W = bulge.band_to_storage(jnp.asarray(Ab), b, n + 4 * b + 8)
+    _, _, _, VS, TAUS = bulge.hb2st(W, n, b)
+    Z0 = rng.standard_normal((n, 13))
+    if np.dtype(dtype).kind == "c":
+        Z0 = Z0 + 1j * rng.standard_normal((n, 13))
+    Z0 = jnp.asarray(Z0.astype(dtype))
+    ref = np.asarray(bulge._unmtr_hb2st_sweep(VS, TAUS, Z0, n, b, trans=trans))
+    got = np.asarray(bulge.unmtr_hb2st(VS, TAUS, Z0, n, b, trans=trans))
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+
+
+def test_unmtr_hb2st_placeholder_identity(rng):
+    """b<=1 bands skip the chase; the placeholder VS must back-transform
+    as the identity (regression: negative-pad crash in the diamond path)."""
+    n, b = 10, 1
+    Ab = _band(rng, n, b)
+    W = bulge.band_to_storage(jnp.asarray(Ab), b, n + 4 * b + 8)
+    _, _, _, VS, TAUS = bulge.hb2st(W, n, b)
+    Z0 = jnp.asarray(rng.standard_normal((n, 3)))
+    np.testing.assert_array_equal(
+        np.asarray(bulge.unmtr_hb2st(VS, TAUS, Z0, n, b)), np.asarray(Z0)
+    )
+
+
 def test_unmtr_hb2st_trans_inverts(rng):
     n, b = 32, 4
     Ab = _band(rng, n, b)
