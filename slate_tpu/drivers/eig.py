@@ -38,7 +38,7 @@ from ..types import TriangularFactors
 from . import blas3
 
 from ..aux.trace import traced
-from ..internal.precision import accurate_matmul
+from ..internal.precision import accurate_matmul, hdot
 
 
 from ..matrix.base import is_distributed as _is_distributed
@@ -235,10 +235,15 @@ def unmtr_he2hb(
         Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
         Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
         Tm = CC(Tk).T if op != Op.NoTrans else Tk
+        # the V^H C gram contracts over all n rows: at n >= 4096 the
+        # f64 emulation drops its compensation terms on such products
+        # (BENCH_NOTES round-5 cliff) — hdot k-chunks them; this gram
+        # was the WHOLE heev orthogonality budget at n=4096 (107 n eps
+        # from this stage vs 3.4 entering it)
         if side == Side.Left:
-            W = CC(Vk).T @ C2
+            W = hdot(CC(Vk).T, C2)
             return C2 - Vk @ (Tm @ W)
-        W = C2 @ Vk
+        W = hdot(C2, Vk)
         return C2 - (W @ Tm) @ CC(Vk).T
 
     C2 = lax.fori_loop(0, npanels, step, C2)

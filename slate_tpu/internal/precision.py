@@ -74,18 +74,26 @@ _hdot_raw = _functools.partial(jnp.matmul, precision=_lax.Precision.HIGHEST)
 # emulated-f64 matmul with k >= 4096 — the chunk loop is python-static,
 # two extra adds per 8192-contraction, MXU throughput unaffected.
 _KCHUNK = 2048
+KCHUNK = _KCHUNK  # public alias: sites that chunk non-matmul einsums
 _F64 = (jnp.dtype("float64"), jnp.dtype("complex128"))
+
+
+def emulated_f64(dtype) -> bool:
+    """True when `dtype` runs through the TPU f64 emulation (i.e. the
+    k-chunk cliff workaround applies); False on real-f64 backends
+    (CPU, GPU)."""
+    try:
+        return (
+            jnp.dtype(dtype) in _F64
+            and jax.default_backend() not in ("cpu", "gpu")
+        )
+    except TypeError:
+        return False
 
 
 def hdot(a, b, **kw):
     k = a.shape[-1]
-    try:
-        emul64 = (
-            jnp.dtype(a.dtype) in _F64
-            and jax.default_backend() != "cpu"
-        )
-    except TypeError:
-        emul64 = False
+    emul64 = emulated_f64(getattr(a, "dtype", None))
     if not emul64 or k < 2 * _KCHUNK or a.ndim != 2 or b.ndim != 2:
         return _hdot_raw(a, b, **kw)
     acc = None

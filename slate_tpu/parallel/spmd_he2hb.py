@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..internal.precision import KCHUNK, emulated_f64
 from ..ops.householder import geqrf as _geqrf_kernel, larft
 from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
@@ -238,7 +239,36 @@ def spmd_unmtr_he2hb_left(
             V_rows = V_nat[gi]
             Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
             Tm = conj(Tk).T if trans else Tk
-            W = jnp.einsum("iav,ijab->vjb", conj(V_rows), ct)
+            # the V^H C gram is cancellation-heavy; past ~4096 local
+            # rows the chip's f64 emulation drops its compensation
+            # terms on exactly this shape (BENCH_NOTES round-5 cliff;
+            # the gathered-path gram was heev's whole orthogonality
+            # budget at n=4096) — chunk the tile-stack contraction at
+            # <= 2048 rows and accumulate across chunks in f64
+            mtl_l = V_rows.shape[0]
+            tchunk = max(1, KCHUNK // mb)
+            if (
+                emulated_f64(ct.dtype)
+                and mtl_l * mb >= 2 * KCHUNK
+                and mtl_l > tchunk
+            ):
+                W = jnp.einsum(
+                    "iav,ijab->vjb",
+                    conj(V_rows[:tchunk]), ct[:tchunk],
+                    precision=lax.Precision.HIGHEST,
+                )
+                for t0 in range(tchunk, mtl_l, tchunk):
+                    W = W + jnp.einsum(
+                        "iav,ijab->vjb",
+                        conj(V_rows[t0 : t0 + tchunk]),
+                        ct[t0 : t0 + tchunk],
+                        precision=lax.Precision.HIGHEST,
+                    )
+            else:
+                W = jnp.einsum(
+                    "iav,ijab->vjb", conj(V_rows), ct,
+                    precision=lax.Precision.HIGHEST,
+                )
             W = lax.psum(W, ROW_AXIS)  # (nb, ntl_c, nbc)
             upd = jnp.einsum("iav,vw,wjb->ijab", V_rows, Tm, W)
             return ct - upd
