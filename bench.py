@@ -245,7 +245,7 @@ def main():
         import slate_tpu as st
         from slate_tpu.drivers.eig import heev_staged
 
-        for nbig in (2048, 4096):
+        for nbig in (2048, 4096, 8192):
             _progress(f"heev staged n={nbig}")
             try:
                 key = jax.random.PRNGKey(5)

@@ -45,6 +45,27 @@ from ..matrix.base import is_distributed as _is_distributed
 
 
 @accurate_matmul
+def _size_bucket_runs(heights, total, floor=1024):
+    """Group consecutive panel indices by S = pow2ceil(height), floored
+    at min(floor, total) so tiny tails don't multiply compiled bodies.
+    Yields (i0, i1, S) runs; every height in [i0, i1) is <= S."""
+
+    def bucket(h):
+        S = total
+        while S // 2 >= max(h, 1) and S // 2 >= min(floor, total):
+            S //= 2
+        return S
+
+    sizes = [bucket(h) for h in heights]
+    i0 = 0
+    while i0 < len(sizes):
+        i1 = i0
+        while i1 < len(sizes) and sizes[i1] == sizes[i0]:
+            i1 += 1
+        yield i0, i1, sizes[i0]
+        i0 = i1
+
+
 def he2hb(
     A: HermitianMatrix, opts: Optional[Options] = None
 ) -> Tuple[HermitianBandMatrix, Matrix, TriangularFactors]:
@@ -99,58 +120,83 @@ def he2hb(
     def C(x):
         return jnp.conj(x) if complex_t else x
 
-    # static-shape pipeline: every step works on the full padded array
-    # with the active trailing block rolled to the origin — one traced
-    # step body under lax.fori_loop instead of kt unrolled iterations
-    # (the reference's per-panel task loop, he2hb.cc:174-185).
+    # static-shape pipeline: every step works on the padded array with
+    # the active trailing block rolled to the origin — one traced step
+    # body per SIZE BUCKET under lax.fori_loop instead of kt unrolled
+    # iterations (the reference's per-panel task loop,
+    # he2hb.cc:174-185).  Steps whose trailing size h has shrunk crop
+    # the rolled array to S = pow2ceil(h): the full-array version ran
+    # every trailing gemm at n x n regardless of h (3x the true flops
+    # — measured 27 s of he2hb's 32 s at n=8192 on-chip; rolls and
+    # panels are noise).  The update itself uses the LAPACK hetrd W
+    # trick (W = P - V Q2/2, Q2 Hermitian) so the rank-2nb two-sided
+    # update is ONE concat gemm instead of three rank-nb products.
     npad = kt * nb
     Gp = jnp.pad(G, ((0, npad - n), (0, npad - n)))
     Vs0 = jnp.zeros_like(Gp)
     Ts0 = jnp.zeros((max(kt - 1, 1), nb, nb), Gp.dtype)
     rows = jnp.arange(npad)
 
-    def step(k, carry):
-        Gp, Vs, Ts = carry
-        lo = (k + 1) * nb
-        h = n - lo  # active trailing size (may be <= 0 for last steps)
-        # panel: rows lo.., column block k, rolled to the top
-        colblk = lax.dynamic_slice(Gp, (0, k * nb), (npad, nb))
-        pan = jnp.roll(colblk, -lo, axis=0)
-        pan = jnp.where((rows < h)[:, None], pan, jnp.zeros_like(pan))
-        vr, taus = _geqrf_panel(pan)
-        V = materialize_v(vr, offset=0)  # (npad, nb) unit-lower, zero cols
-        Tk = larft(V, taus)
-        R = jnp.triu(vr)
-        # write [R; 0] back into the panel and its Hermitian mirror
-        newcol = jnp.where((rows < h)[:, None], R, jnp.zeros_like(R))
-        newcol = jnp.roll(newcol, lo, axis=0)
-        keep_above = (rows < lo)[:, None]
-        newcol = jnp.where(keep_above, colblk, newcol)
-        Gp = lax.dynamic_update_slice(Gp, newcol, (0, k * nb))
-        mirror = C(newcol).T  # (nb, npad)
-        rowblk = lax.dynamic_slice(Gp, (k * nb, 0), (nb, npad))
-        sel = (rows >= lo)[None, :]
-        Gp = lax.dynamic_update_slice(
-            Gp, jnp.where(sel, mirror, rowblk), (k * nb, 0)
-        )
-        # two-sided trailing update on the rolled A22
-        G22 = jnp.roll(Gp, (-lo, -lo), (0, 1))
-        act = (rows < h)[:, None] & (rows < h)[None, :]
-        A22 = jnp.where(act, G22, jnp.zeros_like(G22))
-        P = A22 @ (V @ Tk)
-        Q2 = C(Tk).T @ (C(V).T @ P)
-        A22n = A22 - V @ C(P).T - P @ C(V).T + V @ Q2 @ C(V).T
-        G22 = jnp.where(act, A22n, G22)
-        Gp = jnp.roll(G22, (lo, lo), (0, 1))
-        # stash reflectors (global row coordinates)
-        Vroll = jnp.roll(
-            jnp.where((rows < h)[:, None], V, jnp.zeros_like(V)), lo, axis=0
-        )
-        Vs = lax.dynamic_update_slice(Vs, Vroll, (0, k * nb))
-        Ts = Ts.at[k].set(Tk)
-        return Gp, Vs, Ts
+    def make_step(S):
+        rows_S = jnp.arange(S)
 
-    Gp, Vs_p, Tstack = lax.fori_loop(0, max(kt - 1, 0), step, (Gp, Vs0, Ts0))
+        def step(k, carry):
+            Gp, Vs, Ts = carry
+            lo = (k + 1) * nb
+            h = n - lo  # active trailing size (<= S; may be <= 0)
+            # panel: rows lo.., column block k, rolled to the top
+            colblk = lax.dynamic_slice(Gp, (0, k * nb), (npad, nb))
+            pan = jnp.roll(colblk, -lo, axis=0)[:S]
+            pan = jnp.where((rows_S < h)[:, None], pan, jnp.zeros_like(pan))
+            vr, taus = _geqrf_panel(pan)
+            V = materialize_v(vr, offset=0)  # (S, nb) unit-lower
+            Tk = larft(V, taus)
+            R = jnp.triu(vr)
+            # write [R; 0] back into the panel and its Hermitian mirror
+            newcol = jnp.zeros((npad, nb), Gp.dtype).at[:S].set(
+                jnp.where((rows_S < h)[:, None], R, 0)
+            )
+            newcol = jnp.roll(newcol, lo, axis=0)
+            keep_above = (rows < lo)[:, None]
+            newcol = jnp.where(keep_above, colblk, newcol)
+            Gp = lax.dynamic_update_slice(Gp, newcol, (0, k * nb))
+            mirror = C(newcol).T  # (nb, npad)
+            rowblk = lax.dynamic_slice(Gp, (k * nb, 0), (nb, npad))
+            sel = (rows >= lo)[None, :]
+            Gp = lax.dynamic_update_slice(
+                Gp, jnp.where(sel, mirror, rowblk), (k * nb, 0)
+            )
+            # two-sided trailing update on the rolled, cropped A22
+            G22 = jnp.roll(Gp, (-lo, -lo), (0, 1))
+            act = (rows_S < h)[:, None] & (rows_S < h)[None, :]
+            A22 = jnp.where(act, G22[:S, :S], 0)
+            P = A22 @ (V @ Tk)
+            Q2 = C(Tk).T @ (C(V).T @ P)
+            W = P - V @ (0.5 * Q2)
+            U1 = jnp.concatenate([V, W], axis=1)  # (S, 2nb)
+            U2 = jnp.concatenate([W, V], axis=1)
+            A22n = A22 - U1 @ C(U2).T
+            G22 = G22.at[:S, :S].set(jnp.where(act, A22n, G22[:S, :S]))
+            Gp = jnp.roll(G22, (lo, lo), (0, 1))
+            # stash reflectors (global row coordinates)
+            Vroll = jnp.roll(
+                jnp.zeros((npad, nb), Gp.dtype).at[:S].set(
+                    jnp.where((rows_S < h)[:, None], V, 0)
+                ),
+                lo,
+                axis=0,
+            )
+            Vs = lax.dynamic_update_slice(Vs, Vroll, (0, k * nb))
+            Ts = Ts.at[k].set(Tk)
+            return Gp, Vs, Ts
+
+        return step
+
+    carry = (Gp, Vs0, Ts0)
+    heights = [n - (k + 1) * nb for k in range(max(kt - 1, 0))]
+    for k0, k1, S in _size_bucket_runs(heights, npad):
+        carry = lax.fori_loop(k0, k1, make_step(S), carry)
+    Gp, Vs_p, Tstack = carry
     G = Gp[:n, :n]
     Vs = Vs_p[:n, :n]
     if kt - 1 <= 0:
@@ -230,23 +276,49 @@ def unmtr_he2hb(
     Vp = jnp.pad(Vg, ((0, 0), (0, max(kt * nb - Vg.shape[1], 0))))
     Ts = T.T
 
-    def step(i, C2):
-        k = i if forward else npanels - 1 - i
-        Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
-        Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
-        Tm = CC(Tk).T if op != Op.NoTrans else Tk
-        # the V^H C gram contracts over all n rows: at n >= 4096 the
-        # f64 emulation drops its compensation terms on such products
-        # (BENCH_NOTES round-5 cliff) — hdot k-chunks them; this gram
-        # was the WHOLE heev orthogonality budget at n=4096 (107 n eps
-        # from this stage vs 3.4 entering it)
-        if side == Side.Left:
-            W = hdot(CC(Vk).T, C2)
-            return C2 - Vk @ (Tm @ W)
-        W = hdot(C2, Vk)
-        return C2 - (W @ Tm) @ CC(Vk).T
+    nrows = C2.shape[0]
 
-    C2 = lax.fori_loop(0, npanels, step, C2)
+    def make_step(S):
+        def step(i, C2):
+            k = i if forward else npanels - 1 - i
+            Tk = lax.dynamic_index_in_dim(Ts, k, 0, keepdims=False)
+            Tm = CC(Tk).T if op != Op.NoTrans else Tk
+            # the V^H C gram contracts over all n rows: at n >= 4096
+            # the f64 emulation drops its compensation terms on such
+            # products (BENCH_NOTES round-5 cliff) — hdot k-chunks
+            # them; this gram was the WHOLE heev orthogonality budget
+            # at n=4096 (107 n eps from this stage vs 3.4 entering it)
+            if side == Side.Left and S < nrows:
+                # V_k lives in rows [lo, n): slice BOTH operands at the
+                # same clamped origin and the panel support stays
+                # aligned — the full-height version ran every product
+                # at n x m regardless of the active height
+                lo = (k + 1) * nb
+                org = jnp.minimum(lo, nrows - S)
+                Vk = lax.dynamic_slice(Vp, (org, k * nb), (S, nb))
+                Cs = lax.dynamic_slice(C2, (org, 0), (S, C2.shape[1]))
+                W = hdot(CC(Vk).T, Cs)
+                Cs = Cs - Vk @ (Tm @ W)
+                return lax.dynamic_update_slice(C2, Cs, (org, 0))
+            Vk = lax.dynamic_slice_in_dim(Vp, k * nb, nb, axis=1)
+            if side == Side.Left:
+                W = hdot(CC(Vk).T, C2)
+                return C2 - Vk @ (Tm @ W)
+            W = hdot(C2, Vk)
+            return C2 - (W @ Tm) @ CC(Vk).T
+
+        return step
+
+    if side == Side.Left:
+        # size buckets over the active height h_k = n - (k+1) nb (the
+        # same pow2ceil grouping as he2hb); loop index i maps to panel
+        # idx[i] (reverse order for Q C)
+        idx = list(range(npanels) if forward else range(npanels - 1, -1, -1))
+        heights = [n - (idx[i] + 1) * nb for i in range(npanels)]
+        for i0, i1, S in _size_bucket_runs(heights, nrows):
+            C2 = lax.fori_loop(i0, i1, make_step(S), C2)
+    else:
+        C2 = lax.fori_loop(0, npanels, make_step(nrows), C2)
     return C_mat._with(data=tiles_from_global(C2.astype(C_mat.dtype), C_mat.layout))
 
 
