@@ -448,10 +448,9 @@ def heev_staged(
     times["he2hb+gather"] = round(_time.time() - t0, 2)
     t0 = _time.time()
     if host_ok:
-        d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n, b)
+        d_h, e_h, VS, TAUS = _native.hb2st_host_device(np.asarray(W), n, b)
         d, e = jnp.asarray(d_h), jnp.asarray(e_h)
         u = jnp.ones((n,), A.dtype)
-        VS, TAUS = jnp.asarray(VS_h), jnp.asarray(TAUS_h)
     else:
         d, e, u, VS, TAUS = _s2_chip(W, n, b)
     jax.block_until_ready((d, e, VS, TAUS))
@@ -543,12 +542,12 @@ def heev(
             and _native.hb2st_available()
         )
         if host_ok:
-            d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n, b)
+            d_h, e_h, VS, TAUS = _native.hb2st_host_device(
+                np.asarray(W), n, b
+            )
             d = jnp.asarray(d_h)
             e = jnp.asarray(e_h)
             u = jnp.ones((n,), A.dtype)
-            VS = jnp.asarray(VS_h)
-            TAUS = jnp.asarray(TAUS_h)
         else:
             d, e, u, VS, TAUS = bulge.hb2st(W, n, b)
         if not vectors:

@@ -208,10 +208,9 @@ def _band_svd_jw(Dg: jnp.ndarray, n: int, b: int, vectors: bool):
         and _native.hb2st_available()
     )
     if host_ok:
-        d_h, e_h, VS_h, TAUS_h = _native.hb2st_host(np.asarray(W), n2, bw)
+        d_h, e_h, VS, TAUS = _native.hb2st_host_device(np.asarray(W), n2, bw)
         d, e = jnp.asarray(d_h), jnp.asarray(e_h)
         u = jnp.ones((n2,), dtype)
-        VS, TAUS = jnp.asarray(VS_h), jnp.asarray(TAUS_h)
     else:
         d, e, u, VS, TAUS = bulge_mod.hb2st(W, n2, bw)
     if not vectors:

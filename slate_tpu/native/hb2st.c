@@ -149,16 +149,21 @@ static void chase_task_d(double *restrict Wt, int64_t ldw, int64_t n_pad,
   }
 }
 
-/* Reduce the band in Wt to tridiagonal.  VS: (n_sweeps, jmax1, b),
- * TAUS: (n_sweeps, jmax1), both zero-initialized by the caller.
- * Returns 0 on success. */
-int slate_hb2st_d(double *restrict Wt, int64_t n, int64_t n_pad, int64_t b,
-                  double *restrict VS, double *restrict TAUS,
-                  int64_t n_sweeps, int64_t jmax1) {
+/* Chase sweeps [s_begin, s_end) of the band in Wt.  VS: (n_sweeps,
+ * jmax1, b), TAUS: (n_sweeps, jmax1), both zero-initialized by the
+ * caller.  Sequential ranged calls over a persistent Wt reproduce the
+ * full chase exactly (the chase state IS the band; sweeps are chased
+ * in order), letting the caller overlap uploads of completed VS/TAUS
+ * rows with the next range's compute.  Returns 0 on success. */
+int slate_hb2st_range_d(double *restrict Wt, int64_t n, int64_t n_pad,
+                        int64_t b, double *restrict VS,
+                        double *restrict TAUS, int64_t n_sweeps,
+                        int64_t jmax1, int64_t s_begin, int64_t s_end) {
   if (n <= 2 || b <= 1) return 0;
   const int64_t ldw = 2 * b + 1;
   const int64_t L = 3 * b + 1;
   if (n_pad < n + 3 * b) return 1;
+  if (s_begin < 0 || s_end > n_sweeps || s_begin > s_end) return 3;
   double *S = (double *)malloc((size_t)(b * L) * sizeof(double));
   double *v = (double *)malloc((size_t)b * sizeof(double));
   double *wvec = (double *)malloc((size_t)L * sizeof(double));
@@ -174,9 +179,8 @@ int slate_hb2st_d(double *restrict Wt, int64_t n, int64_t n_pad, int64_t b,
    * roughly once per BLOCK.  Only disjoint-window tasks are reordered
    * relative to sweep-major, so results are bit-identical. */
   const int64_t NSW = 8;
-  for (int64_t s0 = 0; s0 < n_sweeps; s0 += NSW) {
-    const int64_t smax =
-        (n_sweeps - s0 < NSW) ? n_sweeps - s0 : NSW;
+  for (int64_t s0 = s_begin; s0 < s_end; s0 += NSW) {
+    const int64_t smax = (s_end - s0 < NSW) ? s_end - s0 : NSW;
     const int64_t tmax = 3 * (smax - 1) + jmax1 - 1;
     for (int64_t t = 0; t <= tmax; ++t) {
       for (int64_t i = (t >= jmax1) ? (t - jmax1) / 3 + 1 : 0;
@@ -196,4 +200,12 @@ int slate_hb2st_d(double *restrict Wt, int64_t n, int64_t n_pad, int64_t b,
   }
   free(S); free(v); free(wvec);
   return 0;
+}
+
+/* Whole-chase convenience wrapper (the original entry point). */
+int slate_hb2st_d(double *restrict Wt, int64_t n, int64_t n_pad, int64_t b,
+                  double *restrict VS, double *restrict TAUS,
+                  int64_t n_sweeps, int64_t jmax1) {
+  return slate_hb2st_range_d(Wt, n, n_pad, b, VS, TAUS, n_sweeps, jmax1,
+                             0, n_sweeps);
 }

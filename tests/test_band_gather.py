@@ -152,3 +152,21 @@ def test_heev_spmd_two_stage_gather_free(rng, grid22, monkeypatch):
     w = np.asarray(w)
     err = np.abs(A0 @ Zg - Zg * w[None, :]).max() / (np.abs(A0).max() * n)
     assert err < 1e-12, err
+
+
+def test_native_hb2st_ranged_chunks_match_whole(rng):
+    """Chunked ranged chase + overlapped upload (hb2st_host_device) must
+    be bit-identical to the whole-chase path (the band IS the state)."""
+    if not native.hb2st_available():
+        pytest.skip("no C compiler for the native chaser")
+    n, b = 129, 16
+    G = _lower_band(rng, n, b)
+    Gfull = G + np.tril(G, -1).T
+    n_pad = n + 4 * b + 8
+    W = np.asarray(bulge.band_to_storage(jnp.asarray(Gfull), b, n_pad))
+    d1, e1, VS1, TAUS1 = native.hb2st_host(W, n, b)
+    d2, e2, VS2, TAUS2 = native.hb2st_host_device(W, n, b, chunk_sweeps=17)
+    np.testing.assert_array_equal(d1, np.asarray(d2))
+    np.testing.assert_array_equal(e1, np.asarray(e2))
+    np.testing.assert_array_equal(VS1, np.asarray(VS2))
+    np.testing.assert_array_equal(TAUS1, np.asarray(TAUS2))
