@@ -13,8 +13,22 @@ gemm/potrf/getrf/geqrf/heev): dgemm + f64 factorizations + the two-stage
 heev values path, each with GFLOP/s and seconds.  f32 accurate-mode gemm
 (the product default after the precision policy) is reported alongside
 the fast mode.  See BENCH_NOTES.md for methodology and regression notes.
+
+Time budget (BENCH_r05 died at rc=124 mid-sweep with NO output): every
+entry runs under a deadline (--budget seconds, default 780 — inside the
+driver's typical 900 s timeout).  When the remaining budget dips below
+the reserve, the remaining entries are recorded as {"skipped": "time
+budget"} and the final JSON line still prints, so a partial sweep is a
+diagnosable artifact instead of a dead log.  --quick shrinks sizes and
+trial counts for smoke runs.
+
+Per-entry observability: metrics (slate_tpu.aux.metrics) are ON for the
+whole sweep; each entry runs inside metrics.context(label) and reports
+its jit compilation delta + wall seconds in extra[label]["metrics"].
+Set SLATE_TPU_METRICS=/path/out.jsonl to keep the full event stream.
 """
 
+import argparse
 import json
 import os
 import time
@@ -31,8 +45,18 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
-def _bench(step_fn, warm_args, trials):
-    """Best-of wall time with host readback as the barrier."""
+def _bench(step_fn, warm_args, trials, name=None):
+    """Best-of wall time with host readback as the barrier.  With a name,
+    the step jit is metrics-instrumented: compile vs run split per entry
+    and cost_analysis flops/bytes (capture defaults off on accelerators;
+    SLATE_TPU_METRICS_COST=1 opts in).  Deliberately NOT
+    metrics.measure_best: the steps here carry the trial perturbation IN
+    the jitted signature (t) and chain K dependent ops — re-wrapping them
+    in measure_best's scalarizer would change the measured program."""
+    if name is not None:
+        from slate_tpu.aux import metrics
+
+        step_fn = metrics.instrument_jit(step_fn, name)
     float(step_fn(*warm_args, 0.0))  # compile + warmup
     best = float("inf")
     for trial in range(trials):
@@ -61,7 +85,11 @@ def bench_gemm(jax, jnp, n, nb, dtype, K, trials):
             C = blas3.gemm(1.0, C, B, 0.0, C)
         return C.data.sum()
 
-    best = _bench(step, (A, B), trials)
+    # the name carries mode + K: fast-f32 and accurate-f32 run different
+    # programs of different chain lengths and must not share timers/costs
+    mode = "fast" if os.environ.get("SLATE_TPU_FAST_F32") == "1" else "hi"
+    best = _bench(step, (A, B), trials,
+                  name=f"bench.gemm_{jnp.dtype(dtype).name}_{mode}_n{n}_K{K}")
     return 2.0 * n**3 * K / best / 1e9, best / K
 
 
@@ -78,7 +106,7 @@ def bench_potrf(jax, jnp, n, nb, trials):
         L, info = st.potrf(A._with(data=A.data + t * 1e-14))
         return L.data.sum() + info
 
-    best = _bench(step, (A,), trials)
+    best = _bench(step, (A,), trials, name=f"bench.potrf_n{n}")
     return n**3 / 3.0 / best / 1e9, best
 
 
@@ -94,7 +122,7 @@ def bench_getrf(jax, jnp, n, nb, trials):
         LU, piv, info = st.getrf(A._with(data=A.data + t * 1e-14))
         return LU.data.sum() + info
 
-    best = _bench(step, (A,), trials)
+    best = _bench(step, (A,), trials, name=f"bench.getrf_n{n}")
     return 2.0 * n**3 / 3.0 / best / 1e9, best
 
 
@@ -109,7 +137,7 @@ def bench_geqrf(jax, jnp, n, nb, trials):
         fac, T = st.geqrf(A._with(data=A.data + t * 1e-14))
         return fac.data.sum()
 
-    best = _bench(step, (A,), trials)
+    best = _bench(step, (A,), trials, name=f"bench.geqrf_n{n}")
     return 4.0 * n**3 / 3.0 / best / 1e9, best
 
 
@@ -130,7 +158,7 @@ def bench_heev_vectors(jax, jnp, n, nb, trials):
         w, Z = st.heev(A._with(data=A.data + t * 1e-14), vectors=True)
         return w.sum() + Z.data.ravel()[-1]
 
-    best = _bench(step, (A,), trials)
+    best = _bench(step, (A,), trials, name=f"bench.heev_vectors_n{n}")
     # flop model for the WITH-vectors path: 4n^3/3 reduction + ~4n^3/3
     # D&C vector assembly + 2n^3 hb2st back-transform + 2n^3 he2hb
     # back-transform ~= 20n^3/3 (LAPACK dsyevd-style accounting), so the
@@ -153,7 +181,7 @@ def bench_heev_values(jax, jnp, n, nb, trials):
         w, _ = st.heev(A._with(data=A.data + t * 1e-14), vectors=False)
         return w.sum()
 
-    best = _bench(step, (A,), trials)
+    best = _bench(step, (A,), trials, name=f"bench.heev_values_n{n}")
     return 4.0 * n**3 / 3.0 / best / 1e9, best
 
 
@@ -165,79 +193,131 @@ def _progress(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="bench")
+    ap.add_argument("--budget", type=float, default=780.0,
+                    help="sweep deadline in seconds (0 = unlimited); "
+                         "entries past it are recorded as skipped")
+    ap.add_argument("--reserve", type=float, default=45.0,
+                    help="stop starting entries when less than this many "
+                         "seconds of budget remain")
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU-scale sizes + minimal trials (smoke run)")
+    args = ap.parse_args(argv)
+
     import jax
 
     jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    from slate_tpu.aux import metrics
+
+    metrics.on()
+    # note: cost_analysis capture defaults OFF on accelerators inside the
+    # metrics layer itself (the AOT second compile can wedge the remote-
+    # compile service mid-entry); SLATE_TPU_METRICS_COST=1 opts back in.
+    on_tpu = any(d.platform != "cpu" for d in jax.devices()) and not args.quick
     trials = 5 if on_tpu else 2
     extra = {}
+    start = time.monotonic()
+    deadline = start + args.budget if args.budget > 0 else None
+
+    def run_entry(label, fn):
+        """Run one bench entry under the budget: skipped entries are
+        recorded (a partial sweep stays diagnosable — BENCH_r05 rc=124),
+        each entry carries its wall seconds + jit compilation delta."""
+        if deadline is not None and time.monotonic() > deadline - args.reserve:
+            _progress(f"{label}: SKIPPED (time budget)")
+            extra[label] = {"skipped": "time budget"}
+            return None
+        _progress(label)
+        c0 = metrics.counters().get("jit.compilations", 0)
+        t0 = time.monotonic()
+        with metrics.context(label):
+            try:
+                entry = fn()
+            except Exception as e:  # noqa: BLE001 — the JSON line must print
+                entry = {"error": str(e)[:120]}
+        entry["metrics"] = {
+            "wall_s": round(time.monotonic() - t0, 2),
+            "compilations": metrics.counters().get("jit.compilations", 0) - c0,
+        }
+        extra[label] = entry
+        return entry
 
     # -- headline: fast-f32 sgemm (BENCH_r01's mode) ----------------------
-    _progress("sgemm fast-f32")
-    os.environ["SLATE_TPU_FAST_F32"] = "1"
     n = 8192 if on_tpu else 512
-    gf_fast, sec = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
-                              jnp.float32, 8 if on_tpu else 2, trials)
-    extra["sgemm_fast_f32"] = {"n": n, "gflops": round(gf_fast, 1)}
+
+    def entry_sgemm_fast():
+        os.environ["SLATE_TPU_FAST_F32"] = "1"
+        gf, sec = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+                             jnp.float32, 8 if on_tpu else 2, trials)
+        return {"n": n, "gflops": round(gf, 1)}
+
+    e = run_entry("sgemm_fast_f32", entry_sgemm_fast)
+    gf_fast = e.get("gflops", 0.0) if e else 0.0
 
     # -- accurate-mode f32 gemm (product default) -------------------------
-    _progress("sgemm accurate")
-    os.environ["SLATE_TPU_FAST_F32"] = "0"
-    gf_acc, _ = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+    def entry_sgemm_accurate():
+        os.environ["SLATE_TPU_FAST_F32"] = "0"
+        gf, _ = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
                            jnp.float32, 4 if on_tpu else 2, trials)
-    extra["sgemm_accurate"] = {"n": n, "gflops": round(gf_acc, 1)}
+        return {"n": n, "gflops": round(gf, 1)}
+
+    run_entry("sgemm_accurate", entry_sgemm_accurate)
 
     # -- dgemm (the north-star dtype).  n stays 4096: the n=8192 f64
     # chain compile wedges the tunnel's remote-compile service (>2 h,
     # host idle); the honest n=8192 denominator (1,927 GF/s) is
     # measured out-of-band by tools/profile_factor.py and recorded in
     # BENCH_NOTES.md's ceiling analysis
-    _progress("dgemm f64")
-    nd = 4096 if on_tpu else 256
-    gf_d, _ = bench_gemm(jax, jnp, nd, 512 if on_tpu else 128,
-                         jnp.float64, 4 if on_tpu else 2, trials)
-    extra["dgemm"] = {"n": nd, "gflops": round(gf_d, 1)}
+    def entry_dgemm():
+        nd = 4096 if on_tpu else 256
+        gf, _ = bench_gemm(jax, jnp, nd, 512 if on_tpu else 128,
+                           jnp.float64, 4 if on_tpu else 2, trials)
+        return {"n": nd, "gflops": round(gf, 1)}
+
+    run_entry("dgemm", entry_dgemm)
 
     # -- f64 factorizations ------------------------------------------------
-    _progress("dpotrf")
-    nf = 8192 if on_tpu else 256
-    gf, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
-    extra["dpotrf"] = {"n": nf, "gflops": round(gf, 1), "seconds": round(sec, 3)}
-    _progress("dgetrf")
-    nl = 8192 if on_tpu else 128
-    gf, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
-    extra["dgetrf"] = {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
-    _progress("dgeqrf")
-    nq = 8192 if on_tpu else 128
-    gf, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
-    extra["dgeqrf"] = {"n": nq, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+    def entry_dpotrf():
+        nf = 8192 if on_tpu else 256
+        gf, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
+        return {"n": nf, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    run_entry("dpotrf", entry_dpotrf)
+
+    def entry_dgetrf():
+        nl = 8192 if on_tpu else 128
+        gf, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
+        return {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    run_entry("dgetrf", entry_dgetrf)
+
+    def entry_dgeqrf():
+        nq = 8192 if on_tpu else 128
+        gf, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
+        return {"n": nq, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    run_entry("dgeqrf", entry_dgeqrf)
 
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
-    _progress("heev values")
     nh = 1024 if on_tpu else 96
-    try:
+
+    def entry_heev_values():
         gf, sec = bench_heev_values(jax, jnp, nh, 64 if on_tpu else 8,
                                     max(2, trials - 3))
-        extra["dheev_values_two_stage"] = {
-            "n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)
-        }
-    except Exception as e:  # noqa: BLE001 — bench must still emit its line
-        extra["dheev_values_two_stage"] = {"error": str(e)[:120]}
+        return {"n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    run_entry("dheev_values_two_stage", entry_heev_values)
 
     # -- two-stage heev with vectors (+ native stedc D&C) -----------------
-    _progress("heev vectors")
-    nv = 1024 if on_tpu else 96
-    try:
-        gf, sec = bench_heev_vectors(jax, jnp, nv, 64 if on_tpu else 8,
+    def entry_heev_vectors():
+        gf, sec = bench_heev_vectors(jax, jnp, nh, 64 if on_tpu else 8,
                                      max(2, trials - 3))
-        extra["dheev_vectors_two_stage"] = {
-            "n": nv, "gflops": round(gf, 1), "seconds": round(sec, 3)
-        }
-    except Exception as e:  # noqa: BLE001 — bench must still emit its line
-        extra["dheev_vectors_two_stage"] = {"error": str(e)[:120]}
+        return {"n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+
+    run_entry("dheev_vectors_two_stage", entry_heev_vectors)
 
     # -- large-n heev with vectors, stage-split (the flagship path;
     # machine-readable stage seconds — verdict r4 weak #5) ---------------
@@ -245,29 +325,29 @@ def main():
         import slate_tpu as st
         from slate_tpu.drivers.eig import heev_staged
 
+        def entry_heev_staged(nbig):
+            key = jax.random.PRNGKey(5)
+            G = jax.random.normal(key, (nbig, nbig), jnp.float64)
+            S = (G + G.T) / 2
+            Ah = st.HermitianMatrix.from_global(S, 128, uplo=st.Uplo.Lower)
+            heev_staged(Ah, vectors=True)  # compile + warm
+            Ah2 = Ah._with(data=Ah.data + 1e-14)
+            t0 = time.perf_counter()
+            w, Z, stage_t = heev_staged(Ah2, vectors=True)
+            sec = time.perf_counter() - t0
+            return {
+                "n": nbig, "seconds": round(sec, 2),
+                "gflops": round(20.0 * nbig**3 / 3.0 / sec / 1e9, 1),
+                "stages": stage_t,
+            }
+
         for nbig in (2048, 4096, 8192):
-            _progress(f"heev staged n={nbig}")
-            try:
-                key = jax.random.PRNGKey(5)
-                G = jax.random.normal(key, (nbig, nbig), jnp.float64)
-                S = (G + G.T) / 2
-                Ah = st.HermitianMatrix.from_global(
-                    S, 128, uplo=st.Uplo.Lower
-                )
-                heev_staged(Ah, vectors=True)  # compile + warm
-                Ah2 = Ah._with(data=Ah.data + 1e-14)
-                t0 = time.perf_counter()
-                w, Z, stage_t = heev_staged(Ah2, vectors=True)
-                sec = time.perf_counter() - t0
-                extra[f"dheev_vectors_staged_n{nbig}"] = {
-                    "n": nbig, "seconds": round(sec, 2),
-                    "gflops": round(20.0 * nbig**3 / 3.0 / sec / 1e9, 1),
-                    "stages": stage_t,
-                }
-            except Exception as e:  # noqa: BLE001
-                extra[f"dheev_vectors_staged_n{nbig}"] = {
-                    "error": str(e)[:120]
-                }
+            run_entry(f"dheev_vectors_staged_n{nbig}",
+                      lambda nbig=nbig: entry_heev_staged(nbig))
+
+    _progress("metrics summary\n" + metrics.report())
+    if os.environ.get("SLATE_TPU_METRICS"):
+        metrics.dump()
 
     baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
     print(

@@ -1,6 +1,9 @@
 """Auxiliary subsystems (reference: src/auxiliary/ — Trace, Debug).
 
 - aux.trace: RAII phase tracing + SVG timeline + jax.profiler hook.
+- aux.metrics: counters/gauges/timers registry, compile-vs-execute
+  split, cost_analysis FLOP attribution, JSONL export
+  (SLATE_TPU_METRICS=/path/out.jsonl).
 """
 
-from . import trace  # noqa: F401
+from . import metrics, trace  # noqa: F401

@@ -18,6 +18,12 @@ import numpy as np
 from . import lapack as lp
 
 
+try:  # jax >= 0.6 top-level spelling; 0.4.x keeps it in experimental
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover - older spelling
+    from jax.experimental import enable_x64 as _enable_x64
+
+
 def _with_x64(fn):
     """Run a bridge call with x64 enabled, scoped to the call: the C ABI
     traffics in doubles, but a host Python process that dlopens the
@@ -25,7 +31,7 @@ def _with_x64(fn):
 
     @functools.wraps(fn)
     def wrapper(*a, **kw):
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             return fn(*a, **kw)
 
     return wrapper

@@ -32,7 +32,10 @@ from ..parallel.layout import tiles_from_global
 from ..types import Pivots
 from . import blas3, chol, lu
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("gbmm")
 def gbmm(alpha, A: BandMatrix, B: Matrix, beta, C: Matrix, opts=None) -> Matrix:
     """C = alpha op(A) B + beta C with band A (reference: src/gbmm.cc)."""
     Ag = A._with(op=Op.NoTrans)
@@ -41,6 +44,7 @@ def gbmm(alpha, A: BandMatrix, B: Matrix, beta, C: Matrix, opts=None) -> Matrix:
     return blas3.gemm(alpha, Am, B, beta, C, opts)
 
 
+@instrumented("hbmm")
 def hbmm(side: Side, alpha, A: HermitianBandMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C with Hermitian band A (reference:
@@ -79,6 +83,7 @@ def _band_narrow(kd: int, n: int) -> bool:
     return kd < n // 4
 
 
+@instrumented("tbsm")
 def tbsm(
     side: Side,
     alpha,
@@ -154,6 +159,7 @@ def tbsm(
     return blas3.trsm(side, alpha, Top, Bm, opts)
 
 
+@instrumented("gbtrf")
 def gbtrf(
     A: BandMatrix, opts: Optional[Options] = None
 ) -> Tuple[BandMatrix, Pivots, jnp.ndarray]:
@@ -197,6 +203,7 @@ def gbtrf(
     return out, piv, info
 
 
+@instrumented("gbtrs")
 def gbtrs(LU: BandMatrix, pivots: Pivots, B: Matrix, opts=None) -> Matrix:
     """(reference: src/gbtrs.cc).
 
@@ -228,6 +235,7 @@ def gbtrs(LU: BandMatrix, pivots: Pivots, B: Matrix, opts=None) -> Matrix:
     return lu.getrs(Matrix(LU.data, LU.layout, grid=LU.grid), pivots, B, opts)
 
 
+@instrumented("gbsv")
 def gbsv(
     A: BandMatrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, BandMatrix, Pivots, jnp.ndarray]:
@@ -237,6 +245,7 @@ def gbsv(
     return X, LU, piv, info
 
 
+@instrumented("pbtrf")
 def pbtrf(
     A: HermitianBandMatrix, opts: Optional[Options] = None
 ) -> Tuple[TriangularBandMatrix, jnp.ndarray]:
@@ -278,6 +287,7 @@ def pbtrf(
     return Lb, info
 
 
+@instrumented("pbtrs")
 def pbtrs(L: TriangularBandMatrix, B: Matrix, opts=None) -> Matrix:
     """(reference: src/pbtrs.cc): two windowed band solves on narrow
     bands, dense trsm sweeps otherwise."""
@@ -302,6 +312,7 @@ def pbtrs(L: TriangularBandMatrix, B: Matrix, opts=None) -> Matrix:
     return chol.potrs(Lt, B, opts)
 
 
+@instrumented("pbsv")
 def pbsv(
     A: HermitianBandMatrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularBandMatrix, jnp.ndarray]:

@@ -40,7 +40,7 @@ from ..parallel.layout import eye_splice, tiles_from_global
 
 from ..internal.precision import accurate_matmul
 
-from ..aux.trace import traced
+from ..aux.metrics import instrumented
 
 
 from ..matrix.base import is_distributed as _is_distributed
@@ -63,7 +63,7 @@ def _repack_like(C_new_2d: jnp.ndarray, C: BaseMatrix) -> BaseMatrix:
 
 
 @accurate_matmul
-@traced("gemm")
+@instrumented("gemm")
 def gemm(
     alpha,
     A: Matrix,
@@ -124,6 +124,7 @@ def gemm(
 
 
 @accurate_matmul
+@instrumented("symm")
 def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A symmetric (reference: src/symm.cc)."""
@@ -144,6 +145,7 @@ def symm(side: Side, alpha, A: SymmetricMatrix, B: Matrix, beta, C: Matrix,
 
 
 @accurate_matmul
+@instrumented("hemm")
 def hemm(side: Side, alpha, A: HermitianMatrix, B: Matrix, beta, C: Matrix,
          opts=None) -> Matrix:
     """C = alpha A B + beta C, A Hermitian (reference: src/hemm.cc,
@@ -297,6 +299,7 @@ def _herk_like(alpha, A, beta, C, conj: bool, rank2=False, B=None, opts=None):
 
 
 @accurate_matmul
+@instrumented("syrk")
 def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha op(A) op(A)^T + beta C (reference: src/syrk.cc)."""
     if A.m != C.m:
@@ -305,6 +308,7 @@ def syrk(alpha, A: Matrix, beta, C: SymmetricMatrix, opts=None):
 
 
 @accurate_matmul
+@instrumented("herk")
 def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha op(A) op(A)^H + beta C (reference: src/herk.cc)."""
     if A.m != C.m:
@@ -313,6 +317,7 @@ def herk(alpha, A: Matrix, beta, C: HermitianMatrix, opts=None):
 
 
 @accurate_matmul
+@instrumented("syr2k")
 def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
     """C = alpha (A B^T + B A^T) + beta C (reference: src/syr2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
@@ -321,6 +326,7 @@ def syr2k(alpha, A: Matrix, B: Matrix, beta, C: SymmetricMatrix, opts=None):
 
 
 @accurate_matmul
+@instrumented("her2k")
 def her2k(alpha, A: Matrix, B: Matrix, beta, C: HermitianMatrix, opts=None):
     """C = alpha A B^H + conj(alpha) B A^H + beta C (reference: src/her2k.cc)."""
     if A.m != C.m or B.m != C.m or A.n != B.n:
@@ -350,6 +356,7 @@ def _trmm_spmd_ok(side: Side, A: TriangularMatrix, B: Matrix) -> bool:
 
 
 @accurate_matmul
+@instrumented("trmm")
 def trmm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
     """B = alpha op(A) B or alpha B op(A) (reference: src/trmm.cc ->
     work::trmm pipeline, src/work/work_trmm.cc).
@@ -395,7 +402,7 @@ def _trsm_spmd_ok(side: Side, A: TriangularMatrix, B: Matrix) -> bool:
     )
 
 
-@traced("trsm")
+@instrumented("trsm")
 def trsm(side: Side, alpha, A: TriangularMatrix, B: Matrix, opts=None) -> Matrix:
     """Solve op(A) X = alpha B (or right) (reference: src/trsm.cc ->
     trsmA/trsmB work pipelines, src/work/work_trsm.cc).

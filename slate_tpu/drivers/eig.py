@@ -37,17 +37,20 @@ from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 from . import blas3
 
-from ..aux.trace import traced
+from ..aux import metrics
+from ..aux.metrics import instrumented
 from ..internal.precision import accurate_matmul, hdot
 
 
 from ..matrix.base import is_distributed as _is_distributed
 
 
-@accurate_matmul
 def _size_bucket_runs(heights, total, floor=1024):
-    """Group consecutive panel indices by S = pow2ceil(height), floored
-    at min(floor, total) so tiny tails don't multiply compiled bodies.
+    """Group consecutive panel indices into size buckets: each height is
+    assigned S = total / 2^m, the smallest halving of `total` that still
+    covers it, floored at min(floor, total) so tiny tails don't multiply
+    compiled bodies.  (Buckets are halvings of `total`, NOT pow2ceil(h):
+    for total=6144 a height of 2500 buckets to 3072, not 4096.)
     Yields (i0, i1, S) runs; every height in [i0, i1) is <= S."""
 
     def bucket(h):
@@ -66,6 +69,8 @@ def _size_bucket_runs(heights, total, floor=1024):
         i0 = i1
 
 
+@accurate_matmul
+@instrumented("he2hb")
 def he2hb(
     A: HermitianMatrix, opts: Optional[Options] = None
 ) -> Tuple[HermitianBandMatrix, Matrix, TriangularFactors]:
@@ -125,7 +130,8 @@ def he2hb(
     # body per SIZE BUCKET under lax.fori_loop instead of kt unrolled
     # iterations (the reference's per-panel task loop,
     # he2hb.cc:174-185).  Steps whose trailing size h has shrunk crop
-    # the rolled array to S = pow2ceil(h): the full-array version ran
+    # the rolled array to the _size_bucket_runs size S (the smallest
+    # npad/2^m covering h): the full-array version ran
     # every trailing gemm at n x n regardless of h (3x the true flops
     # — measured 27 s of he2hb's 32 s at n=8192 on-chip; rolls and
     # panels are noise).  The update itself uses the LAPACK hetrd W
@@ -209,6 +215,7 @@ def he2hb(
 
 
 @accurate_matmul
+@instrumented("unmtr_he2hb")
 def unmtr_he2hb(
     side: Side,
     op: Op,
@@ -311,8 +318,8 @@ def unmtr_he2hb(
 
     if side == Side.Left:
         # size buckets over the active height h_k = n - (k+1) nb (the
-        # same pow2ceil grouping as he2hb); loop index i maps to panel
-        # idx[i] (reverse order for Q C)
+        # same halving-of-total grouping as he2hb); loop index i maps to
+        # panel idx[i] (reverse order for Q C)
         idx = list(range(npanels) if forward else range(npanels - 1, -1, -1))
         heights = [n - (idx[i] + 1) * nb for i in range(npanels)]
         for i0, i1, S in _size_bucket_runs(heights, nrows):
@@ -341,6 +348,7 @@ def _gathered_band_eig(
 _STAGED_CACHE: dict = {}
 
 
+@instrumented("heev_staged")
 def heev_staged(
     A: HermitianMatrix,
     opts: Optional[Options] = None,
@@ -358,8 +366,6 @@ def heev_staged(
     stages across calls of the same shape.
 
     Returns (w, Z-or-None, stage_seconds)."""
-    import time as _time
-
     import jax
 
     from .. import native as _native
@@ -396,10 +402,12 @@ def heev_staged(
     if stages is None:
         # closures capture only scalars/layout/grid + opts — never the
         # input matrix (a captured A would pin its device buffers for
-        # the cache's lifetime)
+        # the cache's lifetime).  Each stage jit carries the f32/c64
+        # precision policy (accurate_matmul applies during tracing) and
+        # is metrics-instrumented: compile-vs-run split + cost_analysis
+        # flops per stage under "heev.s*" names.
 
-        @jax.jit
-        def _s1(A):
+        def _s1_fn(A):
             band, V, T = he2hb(A, opts)
             if use_spmd_gather:
                 W = spmd_band_storage(band.grid, band.data, band.layout, n_pad)
@@ -407,10 +415,7 @@ def heev_staged(
                 W = band_storage_tiles(band.data, band.layout, n_pad)
             return W, V.data, T.T
 
-        _s2_chip = jax.jit(bulge.hb2st, static_argnames=("n", "b"))
-
-        @jax.jit
-        def _s3(d, e, u, VS, TAUS):
+        def _s3_fn(d, e, u, VS, TAUS):
             wv, ZT = steqr(d, e, vectors=True)
             Z2 = bulge.unmtr_hb2st(
                 VS=VS, TAUS=TAUS, Z=(u[:, None] * ZT).astype(adtype),
@@ -418,12 +423,7 @@ def heev_staged(
             )
             return wv, Z2
 
-        @jax.jit
-        def _s3v(d, e):
-            return bulge.tridiag_eigvals_bisect(d, e)
-
-        @jax.jit
-        def _s4(Vd, Ts, Zd):
+        def _s4_fn(Vd, Ts, Zd):
             Z = unmtr_he2hb(
                 Side.Left,
                 Op.NoTrans,
@@ -434,44 +434,62 @@ def heev_staged(
             )
             return Z.data
 
-        @jax.jit
-        def _pack(Z2):
-            return tiles_from_global(Z2, lay)
+        _s1 = metrics.instrument_jit(
+            jax.jit(accurate_matmul(_s1_fn)), "heev.s1_he2hb_gather"
+        )
+        _s2_chip = metrics.instrument_jit(
+            jax.jit(accurate_matmul(bulge.hb2st), static_argnames=("n", "b")),
+            "heev.s2_hb2st",
+        )
+        _s3 = metrics.instrument_jit(
+            jax.jit(accurate_matmul(_s3_fn)), "heev.s3_stedc_unmtr_hb2st"
+        )
+        _s3v = metrics.instrument_jit(
+            jax.jit(bulge.tridiag_eigvals_bisect), "heev.s3v_eigvals"
+        )
+        _s4 = metrics.instrument_jit(
+            jax.jit(accurate_matmul(_s4_fn)), "heev.s4_unmtr_he2hb"
+        )
+        _pack = metrics.instrument_jit(
+            jax.jit(lambda Z2: tiles_from_global(Z2, lay)), "heev.pack"
+        )
 
         stages = (_s1, _s2_chip, _s3, _s3v, _s4, _pack)
         _STAGED_CACHE[key] = stages
     _s1, _s2_chip, _s3, _s3v, _s4, _pack = stages
 
     times = {}
-    t0 = _time.time()
-    W, Vd, Ts = jax.block_until_ready(_s1(A))
-    times["he2hb+gather"] = round(_time.time() - t0, 2)
-    t0 = _time.time()
-    if host_ok:
-        d_h, e_h, VS, TAUS = _native.hb2st_host_device(np.asarray(W), n, b)
-        d, e = jnp.asarray(d_h), jnp.asarray(e_h)
-        u = jnp.ones((n,), A.dtype)
-    else:
-        d, e, u, VS, TAUS = _s2_chip(W, n, b)
-    jax.block_until_ready((d, e, VS, TAUS))
-    times["hb2st"] = round(_time.time() - t0, 2)
+    with metrics.phase("heev.he2hb+gather", always=True) as ph:
+        W, Vd, Ts = jax.block_until_ready(_s1(A))
+    times["he2hb+gather"] = round(ph.seconds, 2)
+    with metrics.phase("heev.hb2st", always=True) as ph:
+        if host_ok:
+            W_h = np.asarray(W)
+            metrics.inc("transfer.d2h_bytes", W_h.nbytes)
+            d_h, e_h, VS, TAUS = _native.hb2st_host_device(W_h, n, b)
+            d, e = jnp.asarray(d_h), jnp.asarray(e_h)
+            u = jnp.ones((n,), A.dtype)
+        else:
+            d, e, u, VS, TAUS = _s2_chip(W, n, b)
+        jax.block_until_ready((d, e, VS, TAUS))
+    times["hb2st"] = round(ph.seconds, 2)
     if not vectors:
-        t0 = _time.time()
-        w = jax.block_until_ready(_s3v(d, e))
-        times["eigvals"] = round(_time.time() - t0, 2)
+        with metrics.phase("heev.eigvals", always=True) as ph:
+            w = jax.block_until_ready(_s3v(d, e))
+        times["eigvals"] = round(ph.seconds, 2)
         return w, None, times
-    t0 = _time.time()
-    wv, Z2 = jax.block_until_ready(_s3(d, e, u, VS, TAUS))
-    times["stedc+unmtr_hb2st"] = round(_time.time() - t0, 2)
-    t0 = _time.time()
-    Zd = jax.block_until_ready(_s4(Vd, Ts, _pack(Z2)))
-    times["unmtr_he2hb"] = round(_time.time() - t0, 2)
+    with metrics.phase("heev.stedc+unmtr_hb2st", always=True) as ph:
+        wv, Z2 = jax.block_until_ready(_s3(d, e, u, VS, TAUS))
+    times["stedc+unmtr_hb2st"] = round(ph.seconds, 2)
+    with metrics.phase("heev.unmtr_he2hb", always=True) as ph:
+        Zd = jax.block_until_ready(_s4(Vd, Ts, _pack(Z2)))
+    times["unmtr_he2hb"] = round(ph.seconds, 2)
     Z = Matrix(Zd, lay, grid=A.grid)
     return wv, Z, times
 
 
 @accurate_matmul
-@traced("heev")
+@instrumented("heev")
 def heev(
     A: HermitianMatrix,
     opts: Optional[Options] = None,
@@ -542,9 +560,9 @@ def heev(
             and _native.hb2st_available()
         )
         if host_ok:
-            d_h, e_h, VS, TAUS = _native.hb2st_host_device(
-                np.asarray(W), n, b
-            )
+            W_h = np.asarray(W)
+            metrics.inc("transfer.d2h_bytes", W_h.nbytes)
+            d_h, e_h, VS, TAUS = _native.hb2st_host_device(W_h, n, b)
             d = jnp.asarray(d_h)
             e = jnp.asarray(e_h)
             u = jnp.ones((n,), A.dtype)
@@ -572,6 +590,7 @@ def heev(
     return w, Z
 
 
+@instrumented("sterf")
 def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
     """Eigenvalues of a symmetric tridiagonal matrix, no vectors
     (reference: src/sterf.cc QL/QR iteration) — bisection with
@@ -582,6 +601,7 @@ def sterf(d: jnp.ndarray, e: jnp.ndarray) -> jnp.ndarray:
     return tridiag_eigvals_bisect(jnp.real(d), jnp.real(e))
 
 
+@instrumented("steqr")
 def steqr(
     d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True,
     method: str = "dc",
@@ -607,6 +627,7 @@ def steqr(
     return stedc(d, e, vectors=True)
 
 
+@instrumented("stedc")
 def stedc(
     d: jnp.ndarray, e: jnp.ndarray, vectors: bool = True
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
@@ -627,6 +648,7 @@ def stedc(
 
 
 @accurate_matmul
+@instrumented("hegst")
 def hegst(
     itype: int,
     A: HermitianMatrix,
@@ -694,6 +716,7 @@ def hegst(
 
 
 @accurate_matmul
+@instrumented("hegv")
 def hegv(
     itype: int,
     A: HermitianMatrix,

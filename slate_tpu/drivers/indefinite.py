@@ -30,6 +30,8 @@ from ..parallel.layout import tiles_from_global
 from . import lu as lu_mod
 from .lu import _apply_butterfly, _butterfly_diags
 
+from ..aux.metrics import instrumented
+
 
 # Breakdown thresholds for the pivot-free pass.  Partial pivoting keeps
 # |L| <= 1; without pivoting a near-singular leading minor shows up as
@@ -70,6 +72,7 @@ def _ldl_nopiv(Af: jnp.ndarray, mb: int, grid, opts):
     return L, d, info
 
 
+@instrumented("hetrf")
 def hetrf(
     A: HermitianMatrix, opts: Optional[Options] = None,
     method: str = "auto",
@@ -156,6 +159,7 @@ def hetrf(
     return Lr, dr, info_r
 
 
+@instrumented("hetrs")
 def hetrs(
     L: TriangularMatrix, d: jnp.ndarray, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:
@@ -204,6 +208,7 @@ def hetrs(
     return B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
 
 
+@instrumented("hesv")
 def hesv(
     A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray, jnp.ndarray]:

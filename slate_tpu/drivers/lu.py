@@ -31,11 +31,19 @@ from ..types import Pivots
 from . import blas3
 from .aux import norm as _norm
 
-from ..aux.trace import traced
+from ..aux import metrics
+from ..aux.metrics import instrumented
 
 
 from ..matrix.base import is_distributed as _is_distributed
 from ..internal import fallbacks
+
+# metrics-gated jitted kernel: attributes the eager global LU's
+# compile/run split + cost_analysis to "getrf.kernel" (unjitted original
+# call with metrics off)
+_lu_global_kernel = metrics.gated_jit(
+    lu_kernels.lu_global, "getrf.kernel", static_argnums=(1,)
+)
 
 
 def _padded_global(A: BaseMatrix, splice_diag=True) -> jnp.ndarray:
@@ -89,7 +97,7 @@ def _udiag_info(LU: Matrix, lay) -> jnp.ndarray:
     return jnp.where(jnp.any(bad & dmask), 1, 0).astype(jnp.int32)
 
 
-@traced("getrf")
+@instrumented("getrf")
 def getrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Pivots, jnp.ndarray]:
@@ -145,13 +153,14 @@ def getrf(
         # vendor LU when the backend supports the dtype (TPU: f32/c64
         # only), else the native blocked right-looking kernel
         # (ops/lu_kernels.py; reference: src/getrf.cc:85-214)
-        lu2d, perm = lu_kernels.lu_global(Gp, lay.nb)
+        lu2d, perm = _lu_global_kernel(Gp, lay.nb)
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
         m_valid = lay.m
 
     return LU, Pivots(perm), _udiag_info(LU, lay)
 
 
+@instrumented("getrf_nopiv")
 def getrf_nopiv(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, jnp.ndarray]:
@@ -223,7 +232,7 @@ def _nopiv_block(a: jnp.ndarray) -> jnp.ndarray:
     return lax.fori_loop(0, nb, body, a)
 
 
-@traced("getrs")
+@instrumented("getrs")
 def getrs(
     LU: Matrix,
     pivots: Optional[Pivots],
@@ -281,7 +290,7 @@ def getrs_nopiv(LU: Matrix, B: Matrix, opts=None) -> Matrix:
     return getrs(LU, None, B, opts)
 
 
-@traced("gesv")
+@instrumented("gesv")
 def gesv(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Matrix, Pivots, jnp.ndarray]:
@@ -387,6 +396,7 @@ def gerbt(
     return out, du, dv
 
 
+@instrumented("gesv_rbt")
 def gesv_rbt(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, Matrix, Pivots, jnp.ndarray]:
@@ -426,6 +436,7 @@ def gesv_rbt(
 # ---------------------------------------------------------------------------
 
 
+@instrumented("getri")
 def getri(LU: Matrix, pivots: Pivots, opts: Optional[Options] = None) -> Matrix:
     """Matrix inverse from LU factors (reference: src/getri.cc /
     getriOOP.cc): A^-1 = U^-1 L^-1 P."""
@@ -468,6 +479,7 @@ def ir_refine_while(A2, B2, solve_lo, tol, anorm, max_it):
     return X, iters, converged
 
 
+@instrumented("gesv_mixed")
 def gesv_mixed(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, jnp.ndarray, int]:
@@ -579,6 +591,7 @@ def gmres_ir_solve(
     return X, info, iters
 
 
+@instrumented("gesv_mixed_gmres")
 def gesv_mixed_gmres(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, jnp.ndarray, int]:
@@ -616,6 +629,7 @@ def gesv_mixed_gmres(
     )
 
 
+@instrumented("gecondest")
 def gecondest(
     LU: Matrix, pivots: Pivots, anorm, norm_type: Norm = Norm.One, opts=None
 ):

@@ -35,10 +35,16 @@ from . import blas3, chol
 
 from ..internal.precision import accurate_matmul
 
-from ..aux.trace import traced
+from ..aux import metrics
+from ..aux.metrics import instrumented
 
 
 from ..matrix.base import is_distributed as _is_distributed
+
+# metrics-gated jitted kernel: attributes the eager panel-QR's
+# compile/run split + cost_analysis to "geqrf.kernel" (unjitted original
+# call with metrics off)
+_geqrf_global_kernel = metrics.gated_jit(_geqrf_kernel, "geqrf.kernel")
 
 
 def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
@@ -53,7 +59,7 @@ def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
 
 
 @accurate_matmul
-@traced("geqrf")
+@instrumented("geqrf")
 def geqrf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularFactors]:
@@ -72,7 +78,7 @@ def geqrf(
         return A._with(data=Td), TriangularFactors(Tstack)
 
     Gp = _padded_global_splice(A)
-    vr, taus = _geqrf_kernel(Gp)
+    vr, taus = _geqrf_global_kernel(Gp)
     m_pad = Gp.shape[0]
     Ts = []
     for k in range(kt):
@@ -102,6 +108,7 @@ def _vt_panels(fac: Matrix):
 
 
 @accurate_matmul
+@instrumented("unmqr")
 def unmqr(
     side: Side,
     op: Op,
@@ -155,6 +162,7 @@ def ungqr(
 
 
 @accurate_matmul
+@instrumented("gelqf")
 def gelqf(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularFactors]:
@@ -188,6 +196,7 @@ def unmlq(
 
 
 @accurate_matmul
+@instrumented("cholqr")
 def cholqr(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[Matrix, TriangularMatrix, jnp.ndarray]:
@@ -210,7 +219,7 @@ def cholqr(
 
 
 @accurate_matmul
-@traced("gels")
+@instrumented("gels")
 def gels(
     A: Matrix, B: Matrix, opts: Optional[Options] = None
 ) -> Matrix:

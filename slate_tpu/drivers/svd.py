@@ -26,7 +26,7 @@ from ..ops.jacobi import svd_accurate
 from ..parallel.layout import TileLayout, tiles_from_global
 from ..types import TriangularFactors
 
-from ..aux.trace import traced
+from ..aux.metrics import instrumented
 from ..internal.precision import accurate_matmul
 
 
@@ -34,6 +34,7 @@ from ..matrix.base import is_distributed as _is_distributed
 
 
 @accurate_matmul
+@instrumented("ge2tb")
 def ge2tb(
     A: Matrix, opts: Optional[Options] = None
 ) -> Tuple[TriangularBandMatrix, Matrix, TriangularFactors, Matrix, TriangularFactors]:
@@ -229,6 +230,7 @@ def _band_svd_jw(Dg: jnp.ndarray, n: int, b: int, vectors: bool):
 
 
 @accurate_matmul
+@instrumented("tb2bd")
 def tb2bd(band: TriangularBandMatrix):
     """Band -> bidiagonal (reference: src/tb2bd.cc bulge chasing).
 
@@ -256,6 +258,7 @@ def tb2bd(band: TriangularBandMatrix):
 
 
 @accurate_matmul
+@instrumented("bdsqr")
 def bdsqr(d: jnp.ndarray, e: jnp.ndarray, vectors: bool = False):
     """Singular values of a real bidiagonal matrix (reference:
     src/bdsqr.cc QR iteration): the Golub-Kahan tridiagonal
@@ -287,7 +290,7 @@ def bdsqr(d: jnp.ndarray, e: jnp.ndarray, vectors: bool = False):
 
 
 @accurate_matmul
-@traced("svd")
+@instrumented("svd")
 def svd(
     A: Matrix,
     opts: Optional[Options] = None,
@@ -381,6 +384,7 @@ def svd(
 
 
 @accurate_matmul
+@instrumented("unmbr_ge2tb_left")
 def unmbr_ge2tb_left(
     UVm: Matrix,
     UT: TriangularFactors,
@@ -447,6 +451,7 @@ def unmbr_ge2tb_left(
 
 
 @accurate_matmul
+@instrumented("unmbr_ge2tb_right")
 def unmbr_ge2tb_right(
     VVm: Matrix,
     VT: TriangularFactors,

@@ -33,10 +33,15 @@ _COUNTS: Counter = Counter()
 def record(route: str, opts=None, detail: str = "") -> None:
     """Note that `route` fell back to a gathered global evaluation for a
     distributed operand; raise if the caller demanded SPMD execution."""
+    from ..aux import metrics
     from ..enums import Option
     from ..options import get_option
 
     _COUNTS[route] += 1
+    # mirror into the metrics registry (no-op when metrics are off):
+    # `fallbacks.gathered` is the aggregate the multichip dryrun greps for
+    metrics.inc("fallbacks.gathered")
+    metrics.inc(f"fallbacks.{route}")
     if get_option(opts, Option.RequireSpmd, False):
         from ..exceptions import DistributedException
 

@@ -41,17 +41,27 @@ def fast_f32() -> bool:
 
 def accurate_matmul(fn):
     """Decorator: run the driver under default_matmul_precision('highest')
-    when any argument (or matrix argument's data) is f32/c64."""
+    when any argument (or matrix argument's data) is f32/c64.
+
+    Each activation bumps the ``precision.accurate_matmul_activations``
+    metrics counter (a no-op with metrics off), so a displaced decorator
+    — an f32 driver silently running at bf16-pass precision, the round-5
+    eig.py regression — is visible as a missing count."""
 
     @functools.wraps(fn)
     def wrapper(*args, **kw):
         if not fast_f32() and any(
             _has32(a) for a in list(args) + list(kw.values())
         ):
+            from ..aux import metrics
+
+            metrics.inc("precision.accurate_matmul_activations")
             with jax.default_matmul_precision("highest"):
                 return fn(*args, **kw)
         return fn(*args, **kw)
 
+    # marker so tests can assert the policy is attached to a driver
+    wrapper._accurate_matmul = True
     return wrapper
 
 

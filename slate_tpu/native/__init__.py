@@ -152,6 +152,8 @@ def hb2st_host_device(W, n: int, b: int, chunk_sweeps: int = 1024):
     jmax1 = (n - 3) // b + 2 if n > 2 else 1
     VS = np.zeros((n_sweeps, jmax1, b), np.float64)
     TAUS = np.zeros((n_sweeps, jmax1), np.float64)
+    from ..aux import metrics
+
     vs_parts, tau_parts = [], []
     if n > 2 and b >= 2:
         for s0 in range(0, n_sweeps, chunk_sweeps):
@@ -165,9 +167,23 @@ def hb2st_host_device(W, n: int, b: int, chunk_sweeps: int = 1024):
             )
             if rc != 0:
                 raise RuntimeError(f"slate_hb2st_range_d failed rc={rc}")
-            # rows [s0, s1) are final; the next range writes rows >= s1
+            # OVERLAP CONTRACT (pairs with the VS memcpy in hb2st.c's
+            # chase loop): slate_hb2st_range_d writes reflector rows only
+            # for sweeps s in [s_begin, s_end), so rows [s0, s1) are
+            # final here and the async upload below can drain while the
+            # NEXT range computes rows >= s1.  Guard the contract on the
+            # cheap TAUS proxy: any nonzero tau at a sweep >= s1 means
+            # the C kernel wrote outside its range and the uploaded VS
+            # rows may be racing the chase.
+            assert s1 >= n_sweeps or not TAUS[s1:].any(), (
+                "hb2st range contract violated: tau written beyond "
+                f"sweep {s1}"
+            )
             vs_parts.append(jax.device_put(VS[s0:s1]))
             tau_parts.append(jax.device_put(TAUS[s0:s1]))
+            metrics.inc(
+                "transfer.h2d_bytes", VS[s0:s1].nbytes + TAUS[s0:s1].nbytes
+            )
     if not vs_parts:
         VSd, TAUSd = jnp.asarray(VS), jnp.asarray(TAUS)
     elif len(vs_parts) == 1:

@@ -193,6 +193,11 @@ int slate_hb2st_range_d(double *restrict Wt, int64_t n, int64_t n_pad,
         const int64_t r0 = (j == 0) ? 1 : b;
         double tau;
         chase_task_d(Wt, ldw, n_pad, b, w0, r0, S, v, wvec, &tau);
+        /* OVERLAP CONTRACT (pairs with the assertion at the async
+         * device_put in native/__init__.py): s ranges over
+         * [s_begin, s_end) only, so this memcpy writes only VS/TAUS
+         * rows of sweeps in [s_begin, s_end) — rows of earlier sweeps
+         * are final and may be uploading concurrently. */
         memcpy(VS + (s * jmax1 + j) * b, v, (size_t)b * sizeof(double));
         TAUS[s * jmax1 + j] = tau;
       }

@@ -51,6 +51,37 @@ _BISECT = 18  # geometric bisection phase: localizes to ~2e-4 relative
 _NEWTON = 10  # hybrid Newton/geometric phase: eps from there
 
 
+_barrier_warned = False
+
+
+def _opt_barrier(xs):
+    """lax.optimization_barrier, degrading to identity where the
+    toolchain lacks its batching rule (the merges are vmapped; some jax
+    versions cannot batch the barrier).  The barrier only defends the
+    chip's f64-emulated reductions against log->sum fusion — on real-f64
+    backends dropping it is numerically harmless; on emulated-f64
+    backends the degradation is surfaced via a one-time warning and the
+    `stedc.barrier_dropped` metrics counter."""
+    global _barrier_warned
+    try:
+        return lax.optimization_barrier(xs)
+    except NotImplementedError:
+        from ..aux import metrics as _metrics
+
+        _metrics.inc("stedc.barrier_dropped")
+        if not _barrier_warned and jax.default_backend() not in ("cpu", "gpu"):
+            import warnings
+
+            warnings.warn(
+                "optimization_barrier unsupported under vmap on this jax; "
+                "stedc's emulated-f64 fusion guard is dropped — "
+                "eigenvector orthogonality may degrade (BENCH_NOTES r5)",
+                stacklevel=2,
+            )
+            _barrier_warned = True
+        return xs
+
+
 def _secular_roots(D, z2, rho, nondefl, nxt_idx, gap_hi):
     """Vectorized secular roots with nearest-pole shifting (the laed4
     numerics, reference: src/stedc_merge.cc:23-31 / LAPACK dlaed4).
@@ -339,7 +370,7 @@ def _assemble_u(D, z, nondefl, kshift, sgn, x):
     # norm-sum barriers moved nothing).  Forcing materialization keeps
     # the jitted tree at eager-grade accuracy for ~16 MB of extra HBM
     # traffic per merge.
-    logmag, logden = lax.optimization_barrier((logmag, logden))
+    logmag, logden = _opt_barrier((logmag, logden))
     logzhat = 0.5 * (logmag.sum(axis=0) - logden.sum(axis=0))
     zsign = jnp.where(z < 0, -1.0, 1.0).astype(dt)
 

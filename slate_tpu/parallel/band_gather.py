@@ -29,6 +29,8 @@ from .grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from .layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
+
 
 def _band_rowidx(nb: int) -> np.ndarray:
     """(nb+1, nb) row indices: stacked[rowidx[d, c], c] = A[c+d, c] for
@@ -44,6 +46,7 @@ def _assemble_w(E: jnp.ndarray, layout: TileLayout, n_pad: int) -> jnp.ndarray:
     return jnp.pad(Wtop, ((0, nb), (0, n_pad - n)))
 
 
+@instrumented("spmd.band_storage_tiles")
 def band_storage_tiles(
     T: jnp.ndarray, layout: TileLayout, n_pad: int
 ) -> jnp.ndarray:
@@ -66,6 +69,7 @@ def band_storage_tiles(
     return _assemble_w(E, layout, n_pad)
 
 
+@instrumented("spmd.band_storage")
 def spmd_band_storage(
     grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout, n_pad: int
 ) -> jnp.ndarray:

@@ -31,11 +31,13 @@ from jax.sharding import PartitionSpec as P
 from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 
+from ..aux.metrics import instrumented
+
 try:  # jax >= 0.4.35 spells it jax.shard_map
     from jax import shard_map as _shard_map_mod  # noqa: F401
 
     _shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older spelling
+except (ImportError, AttributeError):  # pragma: no cover - older spelling
     from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
 
 
@@ -59,6 +61,7 @@ def _acc_dtype(dt):
     return jnp.promote_types(dt, jnp.float32)
 
 
+@instrumented("spmd.summa_gemm")
 def summa_gemm(
     grid: ProcessGrid,
     alpha,
@@ -180,6 +183,7 @@ def gemm_reduce_a(
     return fn(TA, TB, TC)
 
 
+@instrumented("spmd.herk")
 def spmd_herk(
     grid: ProcessGrid,
     alpha,
@@ -342,6 +346,7 @@ def spmd_herk(
     return fn(*args)
 
 
+@instrumented("spmd.trmm")
 def spmd_trmm(
     grid: ProcessGrid,
     side_left: bool,
@@ -472,6 +477,7 @@ def spmd_trmm(
     return fn(TA, TB)
 
 
+@instrumented("spmd.hemm")
 def spmd_hemm(
     grid: ProcessGrid,
     side_left: bool,

@@ -37,7 +37,10 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("spmd.potrf_lower")
 def spmd_potrf_lower(
     grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout
 ) -> jnp.ndarray:

@@ -35,6 +35,8 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
+
 
 def _resize_rows(x: jnp.ndarray, rows: int) -> jnp.ndarray:
     if x.shape[0] == rows:
@@ -44,6 +46,7 @@ def _resize_rows(x: jnp.ndarray, rows: int) -> jnp.ndarray:
     return jnp.pad(x, ((0, rows - x.shape[0]), (0, 0)))
 
 
+@instrumented("spmd.ge2tb")
 def spmd_ge2tb(
     grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout, v_layout: TileLayout
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -223,6 +226,7 @@ def spmd_ge2tb(
     return fn(T)
 
 
+@instrumented("spmd.unmbr_ge2tb_left")
 def spmd_unmbr_ge2tb_left(
     grid: ProcessGrid,
     UV_tiles: jnp.ndarray,
@@ -279,6 +283,7 @@ def spmd_unmbr_ge2tb_left(
     return fn(UV_tiles, UT, C_tiles)
 
 
+@instrumented("spmd.unmbr_ge2tb_right")
 def spmd_unmbr_ge2tb_right(
     grid: ProcessGrid,
     VV_tiles: jnp.ndarray,

@@ -43,7 +43,10 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("spmd.he2hb")
 def spmd_he2hb(
     grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -183,6 +186,7 @@ def spmd_he2hb(
     return fn(T)
 
 
+@instrumented("spmd.unmtr_he2hb_left")
 def spmd_unmtr_he2hb_left(
     grid: ProcessGrid,
     V_tiles: jnp.ndarray,

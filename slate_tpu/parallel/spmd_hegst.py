@@ -29,6 +29,8 @@ from .layout import TileLayout, eye_splice
 from .spmd_blas import _resize_rows_3d, shard_map
 from .spmd_trsm import spmd_trsm_left, spmd_trsm_right
 
+from ..aux.metrics import instrumented
+
 
 def spmd_hermitian_full(
     grid: ProcessGrid,
@@ -110,6 +112,7 @@ def spmd_hermitian_full(
     return fn(TA)
 
 
+@instrumented("spmd.hegst_itype1")
 def spmd_hegst_itype1(
     grid: ProcessGrid,
     TA: jnp.ndarray,

@@ -43,6 +43,8 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
+
 
 def _fetch_rows(tl, row_idx, p, r, mb):
     """Fetch global rows `row_idx` (traced, (S,)) of the local column
@@ -79,6 +81,7 @@ def _write_rows(tl, row_idx, vals, p, r, mb):
     return tl.at[li_w, :, off, :].set(vals, mode="drop")
 
 
+@instrumented("spmd.getrf")
 def spmd_getrf(
     grid: ProcessGrid,
     T: jnp.ndarray,
@@ -202,6 +205,7 @@ def spmd_getrf(
     return fn(T)
 
 
+@instrumented("spmd.getrf_tntpiv")
 def spmd_getrf_tntpiv(
     grid: ProcessGrid,
     T: jnp.ndarray,

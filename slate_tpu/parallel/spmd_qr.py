@@ -32,7 +32,10 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("spmd.geqrf")
 def spmd_geqrf(
     grid: ProcessGrid, T: jnp.ndarray, layout: TileLayout
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -28,7 +28,10 @@ from .grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from .layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("spmd.redistribute")
 def spmd_redistribute(
     grid: ProcessGrid,
     TA: jnp.ndarray,

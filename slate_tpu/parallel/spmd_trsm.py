@@ -39,7 +39,10 @@ from ..parallel.grid import COL_AXIS, ROW_AXIS, ProcessGrid
 from ..parallel.layout import TileLayout
 from .spmd_blas import shard_map
 
+from ..aux.metrics import instrumented
 
+
+@instrumented("spmd.trsm_left")
 def spmd_trsm_left(
     grid: ProcessGrid,
     TT: jnp.ndarray,
@@ -152,6 +155,7 @@ def spmd_trsm_left(
     return fn(TT, TB)
 
 
+@instrumented("spmd.permute_rows")
 def spmd_permute_rows(
     grid: ProcessGrid,
     TB: jnp.ndarray,
@@ -194,6 +198,7 @@ def spmd_permute_rows(
     return fn(TB, perm.astype(jnp.int32))
 
 
+@instrumented("spmd.trsm_right")
 def spmd_trsm_right(
     grid: ProcessGrid,
     TT: jnp.ndarray,

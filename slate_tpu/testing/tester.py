@@ -11,6 +11,12 @@ driver, and accepts on the reference's norm-scaled residual bound
 (error <= tol_factor * eps; test_gemm.cc:192-207).  Timing is wall-clock
 around the blocked driver call (first call includes compile, a repeat
 measures steady state).
+
+--metrics (or SLATE_TPU_METRICS=/path/out.jsonl) turns on the
+observability layer: each sweep entry runs inside
+metrics.context(label) and prints its per-entry compilation/fallback/
+precision-activation deltas, with the full metrics.report() table (and
+the JSONL dump when the env var is set) after the sweep.
 """
 
 from __future__ import annotations
@@ -740,7 +746,19 @@ def run(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--check", default="y", choices=["y", "n"])
     ap.add_argument("--xml", default=None, help="write JUnit XML here")
     ap.add_argument("--target", default="d", help="accepted for parity (h/t/b/d)")
+    ap.add_argument(
+        "--metrics", action="store_true",
+        help="per-entry metrics: print compilations/fallbacks per sweep "
+             "entry and a final summary table (implied by SLATE_TPU_METRICS)",
+    )
     args = ap.parse_args(argv)
+
+    import os as _os
+
+    from ..aux import metrics
+    metrics_on = args.metrics or bool(_os.environ.get("SLATE_TPU_METRICS"))
+    if metrics_on:
+        metrics.on()
 
     routines = sorted(ROUTINES) if "all" in args.routines else args.routines
     p, q = (int(x) for x in args.grid.split("x"))
@@ -772,8 +790,10 @@ def run(argv: Optional[List[str]] = None) -> int:
                         p=p, q=q, seed=args.seed, check=args.check == "y",
                     )
                     label = f"{routine}_{tc}_m{m}n{n}k{k}nb{nb}_{p}x{q}"
+                    c_before = metrics.counters() if metrics_on else {}
                     try:
-                        dt, gflops, err = fn(pr)
+                        with metrics.context(label):
+                            dt, gflops, err = fn(pr)
                         tol = TOL_FACTOR.get(routine, 100) * _eps(dtype)
                         ok = (err <= tol) if pr.check else True
                         results.append(
@@ -790,9 +810,23 @@ def run(argv: Optional[List[str]] = None) -> int:
                             Result(routine, label, 0, 0, float("inf"), False, str(e))
                         )
                         print(f"{routine:10} {tc:4} {label}: ERROR {e}")
+                    if metrics_on:
+                        c_now = metrics.counters()
+                        delta = {
+                            k2: c_now.get(k2, 0) - c_before.get(k2, 0)
+                            for k2 in ("jit.compilations", "fallbacks.gathered",
+                                       "precision.accurate_matmul_activations")
+                            if c_now.get(k2, 0) != c_before.get(k2, 0)
+                        }
+                        if delta:
+                            print(f"           metrics: {delta}")
 
     npass = sum(r.passed for r in results)
     print(f"\n{npass} / {len(results)} passed")
+    if metrics_on:
+        print("\n" + metrics.report())
+        if _os.environ.get("SLATE_TPU_METRICS"):
+            metrics.dump()
     if args.xml:
         _write_junit(args.xml, results)
         print(f"wrote {args.xml}")

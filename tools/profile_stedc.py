@@ -8,6 +8,12 @@ the largest levels times each merge phase (setup/sort, deflation
 while_loop, secular roots, Lowner assembly, back-rotation gemm)
 separately.
 
+Thin wrapper over the shared measurement layer: the steady-state
+host-readback-barrier timing (with the tunnel retry loop) lives in
+slate_tpu.aux.metrics.measure_steady; every level/phase lands in the
+metrics registry, so SLATE_TPU_METRICS=/path/out.jsonl keeps the full
+event stream.
+
 Run: python tools/profile_stedc.py --n 2048 4096
 """
 
@@ -15,7 +21,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault(
@@ -37,44 +42,17 @@ def main() -> int:
     jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
 
+    from slate_tpu.aux import metrics
     from slate_tpu.ops import stedc as M
+
+    metrics.on()
 
     print(f"device: {jax.devices()[0]}", flush=True)
     rng = np.random.default_rng(0)
     out = {}
 
-    def timed(fn, *a):
-        """Steady-state time with HOST READBACK as the barrier
-        (block_until_ready is not a reliable execution barrier over
-        this tunnel — bench.py methodology): compile+run once, rerun on
-        perturbed input (the tunnel caches identical dispatches), read
-        one scalar back.  The tunnel's remote-compile service
-        sporadically drops connections; retry a few times."""
-
-        def run(args):
-            out = fn(*args)
-            s = jax.tree.leaves(out)[0].ravel()[-1]
-            float(np.asarray(s))
-            return out
-
-        last = None
-        for attempt in range(4):
-            try:
-                o = run(a)
-                break
-            except Exception as e:  # transient tunnel failure
-                last = e
-                print(f"  [retry {attempt + 1}: {type(e).__name__}]",
-                      flush=True)
-                time.sleep(10.0 * (attempt + 1))
-        else:
-            raise last
-        a2 = jax.tree.map(
-            lambda x: x + jnp.asarray(1e-14, x.dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, a)
-        t0 = time.time()
-        o = run(a2)
-        return time.time() - t0, o
+    def timed(name, fn, *a):
+        return metrics.measure_steady(fn, *a, name=f"profile_stedc.{name}")
 
     for n in args.n:
         print(f"\n=== n={n} ===", flush=True)
@@ -110,6 +88,7 @@ def main() -> int:
             Q_pairs = QT.reshape(nm, 2, s, s)
             e_r = epad[s - 1 :: 2 * s][:nm]
             tsec, (w, QT) = timed(
+                f"level_{2 * s}",
                 lambda a, b, c, dd, ee: merge_b(a, b, c, dd, ee, eps),
                 w_pairs[:, 0], Q_pairs[:, 0], w_pairs[:, 1], Q_pairs[:, 1],
                 e_r,
@@ -125,16 +104,19 @@ def main() -> int:
                     jax.vmap(M._merge_setup, in_axes=(0, 0, 0, 0, 0, None)),
                     static_argnums=(5,))
                 t_set, (D, z, QTm, rho, tol) = timed(
+                    f"setup_{n2}",
                     lambda a, b, c, dd, ee: setup(a, b, c, dd, ee, eps),
                     w_pairs[:, 0], Q_pairs[:, 0], w_pairs[:, 1],
                     Q_pairs[:, 1], e_r)
                 defl = jax.jit(jax.vmap(M._deflate))
-                t_def, (D2, z2, QT2, nd) = timed(defl, D, z, QTm, rho, tol)
+                t_def, (D2, z2, QT2, nd) = timed(
+                    f"deflate_{n2}", defl, D, z, QTm, rho, tol)
                 secu = jax.jit(jax.vmap(M._solve_secular))
                 t_sec, (ks, sg, xx, lam) = timed(
-                    secu, D2, z2, rho, nd, tol)
+                    f"secular_{n2}", secu, D2, z2, rho, nd, tol)
                 asse = jax.jit(jax.vmap(M._assemble_u))
-                t_ass, Ur = timed(asse, D2, z2, nd, ks, sg, xx)
+                t_ass, Ur = timed(
+                    f"assemble_{n2}", asse, D2, z2, nd, ks, sg, xx)
 
                 @jax.jit
                 def rot(Ur, QT2, lam):
@@ -144,7 +126,7 @@ def main() -> int:
                     return jnp.take_along_axis(
                         Qo, o2[:, :, None], axis=1)
 
-                t_rot, _ = timed(rot, Ur, QT2, lam)
+                t_rot, _ = timed(f"rotate_{n2}", rot, Ur, QT2, lam)
                 ndefl_frac = float(nd.mean())
                 print(f"  phases: setup {t_set:.3f}s  deflate {t_def:.3f}s"
                       f"  secular {t_sec:.3f}s  assemble {t_ass:.3f}s"
@@ -158,13 +140,16 @@ def main() -> int:
             s *= 2
 
         # end-to-end single-jit stedc for the headline number
-        t_e2e, (wfull, Qfull) = timed(jax.jit(M.stedc),
-                                      jnp.asarray(rng.standard_normal(n)),
-                                      jnp.asarray(rng.standard_normal(n - 1)))
+        t_e2e, (wfull, Qfull) = timed(
+            "end_to_end", jax.jit(M.stedc),
+            jnp.asarray(rng.standard_normal(n)),
+            jnp.asarray(rng.standard_normal(n - 1)))
         print(f"stedc end-to-end (one jit): {t_e2e:.2f}s", flush=True)
         levels["end_to_end"] = round(t_e2e, 3)
         out[n] = levels
 
+    if os.environ.get("SLATE_TPU_METRICS"):
+        metrics.dump()
     print(json.dumps({"profile_stedc": out}))
     return 0
 
