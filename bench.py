@@ -45,6 +45,26 @@ os.environ.setdefault(
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
+def _gflops(name, hand_flops, best_s):
+    """GFLOP/s with the numerator from the captured ``cost_analysis()``
+    record when one exists (metrics.costs(); the BENCH_NOTES demand —
+    measured program, not a derived formula), keeping the hand formula
+    as a cross-check.  XLA reports -1 for unknowable costs (e.g. CPU
+    while loops): that is "no data", never zero, so the model numerator
+    is used and the source is labeled."""
+    from slate_tpu.aux import metrics
+
+    out = {"gflops_model": round(hand_flops / best_s / 1e9, 1)}
+    xla = metrics.costs().get(name, {}).get("flops", -1.0)
+    if xla is not None and xla > 0:
+        out["gflops"] = round(xla / best_s / 1e9, 1)
+        out["flops_source"] = "xla_cost_analysis"
+    else:
+        out["gflops"] = out["gflops_model"]
+        out["flops_source"] = "model"
+    return out
+
+
 def _bench(step_fn, warm_args, trials, name=None):
     """Best-of wall time with host readback as the barrier.  With a name,
     the step jit is metrics-instrumented: compile vs run split per entry
@@ -88,9 +108,11 @@ def bench_gemm(jax, jnp, n, nb, dtype, K, trials):
     # the name carries mode + K: fast-f32 and accurate-f32 run different
     # programs of different chain lengths and must not share timers/costs
     mode = "fast" if os.environ.get("SLATE_TPU_FAST_F32") == "1" else "hi"
-    best = _bench(step, (A, B), trials,
-                  name=f"bench.gemm_{jnp.dtype(dtype).name}_{mode}_n{n}_K{K}")
-    return 2.0 * n**3 * K / best / 1e9, best / K
+    name = f"bench.gemm_{jnp.dtype(dtype).name}_{mode}_n{n}_K{K}"
+    best = _bench(step, (A, B), trials, name=name)
+    # hand model 2n^3 per gemm x K chained; the xla numerator covers the
+    # same whole step (K gemms + the reduction), so both rate the step
+    return _gflops(name, 2.0 * n**3 * K, best), best / K
 
 
 def bench_potrf(jax, jnp, n, nb, trials):
@@ -106,8 +128,9 @@ def bench_potrf(jax, jnp, n, nb, trials):
         L, info = st.potrf(A._with(data=A.data + t * 1e-14))
         return L.data.sum() + info
 
-    best = _bench(step, (A,), trials, name=f"bench.potrf_n{n}")
-    return n**3 / 3.0 / best / 1e9, best
+    name = f"bench.potrf_n{n}"
+    best = _bench(step, (A,), trials, name=name)
+    return _gflops(name, n**3 / 3.0, best), best
 
 
 def bench_getrf(jax, jnp, n, nb, trials):
@@ -122,8 +145,9 @@ def bench_getrf(jax, jnp, n, nb, trials):
         LU, piv, info = st.getrf(A._with(data=A.data + t * 1e-14))
         return LU.data.sum() + info
 
-    best = _bench(step, (A,), trials, name=f"bench.getrf_n{n}")
-    return 2.0 * n**3 / 3.0 / best / 1e9, best
+    name = f"bench.getrf_n{n}"
+    best = _bench(step, (A,), trials, name=name)
+    return _gflops(name, 2.0 * n**3 / 3.0, best), best
 
 
 def bench_geqrf(jax, jnp, n, nb, trials):
@@ -137,8 +161,9 @@ def bench_geqrf(jax, jnp, n, nb, trials):
         fac, T = st.geqrf(A._with(data=A.data + t * 1e-14))
         return fac.data.sum()
 
-    best = _bench(step, (A,), trials, name=f"bench.geqrf_n{n}")
-    return 4.0 * n**3 / 3.0 / best / 1e9, best
+    name = f"bench.geqrf_n{n}"
+    best = _bench(step, (A,), trials, name=name)
+    return _gflops(name, 4.0 * n**3 / 3.0, best), best
 
 
 def bench_heev_vectors(jax, jnp, n, nb, trials):
@@ -158,12 +183,13 @@ def bench_heev_vectors(jax, jnp, n, nb, trials):
         w, Z = st.heev(A._with(data=A.data + t * 1e-14), vectors=True)
         return w.sum() + Z.data.ravel()[-1]
 
-    best = _bench(step, (A,), trials, name=f"bench.heev_vectors_n{n}")
+    name = f"bench.heev_vectors_n{n}"
+    best = _bench(step, (A,), trials, name=name)
     # flop model for the WITH-vectors path: 4n^3/3 reduction + ~4n^3/3
     # D&C vector assembly + 2n^3 hb2st back-transform + 2n^3 he2hb
     # back-transform ~= 20n^3/3 (LAPACK dsyevd-style accounting), so the
     # rate is comparable across entries (ADVICE r3)
-    return 20.0 * n**3 / 3.0 / best / 1e9, best
+    return _gflops(name, 20.0 * n**3 / 3.0, best), best
 
 
 def bench_heev_values(jax, jnp, n, nb, trials):
@@ -181,8 +207,9 @@ def bench_heev_values(jax, jnp, n, nb, trials):
         w, _ = st.heev(A._with(data=A.data + t * 1e-14), vectors=False)
         return w.sum()
 
-    best = _bench(step, (A,), trials, name=f"bench.heev_values_n{n}")
-    return 4.0 * n**3 / 3.0 / best / 1e9, best
+    name = f"bench.heev_values_n{n}"
+    best = _bench(step, (A,), trials, name=name)
+    return _gflops(name, 4.0 * n**3 / 3.0, best), best
 
 
 def _progress(msg):
@@ -250,9 +277,9 @@ def main(argv=None):
 
     def entry_sgemm_fast():
         os.environ["SLATE_TPU_FAST_F32"] = "1"
-        gf, sec = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
-                             jnp.float32, 8 if on_tpu else 2, trials)
-        return {"n": n, "gflops": round(gf, 1)}
+        rep, sec = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+                              jnp.float32, 8 if on_tpu else 2, trials)
+        return {"n": n, **rep}
 
     e = run_entry("sgemm_fast_f32", entry_sgemm_fast)
     gf_fast = e.get("gflops", 0.0) if e else 0.0
@@ -260,9 +287,9 @@ def main(argv=None):
     # -- accurate-mode f32 gemm (product default) -------------------------
     def entry_sgemm_accurate():
         os.environ["SLATE_TPU_FAST_F32"] = "0"
-        gf, _ = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
-                           jnp.float32, 4 if on_tpu else 2, trials)
-        return {"n": n, "gflops": round(gf, 1)}
+        rep, _ = bench_gemm(jax, jnp, n, 1024 if on_tpu else 128,
+                            jnp.float32, 4 if on_tpu else 2, trials)
+        return {"n": n, **rep}
 
     run_entry("sgemm_accurate", entry_sgemm_accurate)
 
@@ -273,31 +300,31 @@ def main(argv=None):
     # BENCH_NOTES.md's ceiling analysis
     def entry_dgemm():
         nd = 4096 if on_tpu else 256
-        gf, _ = bench_gemm(jax, jnp, nd, 512 if on_tpu else 128,
-                           jnp.float64, 4 if on_tpu else 2, trials)
-        return {"n": nd, "gflops": round(gf, 1)}
+        rep, _ = bench_gemm(jax, jnp, nd, 512 if on_tpu else 128,
+                            jnp.float64, 4 if on_tpu else 2, trials)
+        return {"n": nd, **rep}
 
     run_entry("dgemm", entry_dgemm)
 
     # -- f64 factorizations ------------------------------------------------
     def entry_dpotrf():
         nf = 8192 if on_tpu else 256
-        gf, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
-        return {"n": nf, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+        rep, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
+        return {"n": nf, **rep, "seconds": round(sec, 3)}
 
     run_entry("dpotrf", entry_dpotrf)
 
     def entry_dgetrf():
         nl = 8192 if on_tpu else 128
-        gf, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
-        return {"n": nl, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+        rep, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
+        return {"n": nl, **rep, "seconds": round(sec, 3)}
 
     run_entry("dgetrf", entry_dgetrf)
 
     def entry_dgeqrf():
         nq = 8192 if on_tpu else 128
-        gf, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
-        return {"n": nq, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+        rep, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
+        return {"n": nq, **rep, "seconds": round(sec, 3)}
 
     run_entry("dgeqrf", entry_dgeqrf)
 
@@ -305,17 +332,17 @@ def main(argv=None):
     nh = 1024 if on_tpu else 96
 
     def entry_heev_values():
-        gf, sec = bench_heev_values(jax, jnp, nh, 64 if on_tpu else 8,
-                                    max(2, trials - 3))
-        return {"n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+        rep, sec = bench_heev_values(jax, jnp, nh, 64 if on_tpu else 8,
+                                     max(2, trials - 3))
+        return {"n": nh, **rep, "seconds": round(sec, 3)}
 
     run_entry("dheev_values_two_stage", entry_heev_values)
 
     # -- two-stage heev with vectors (+ native stedc D&C) -----------------
     def entry_heev_vectors():
-        gf, sec = bench_heev_vectors(jax, jnp, nh, 64 if on_tpu else 8,
-                                     max(2, trials - 3))
-        return {"n": nh, "gflops": round(gf, 1), "seconds": round(sec, 3)}
+        rep, sec = bench_heev_vectors(jax, jnp, nh, 64 if on_tpu else 8,
+                                      max(2, trials - 3))
+        return {"n": nh, **rep, "seconds": round(sec, 3)}
 
     run_entry("dheev_vectors_two_stage", entry_heev_vectors)
 
@@ -337,7 +364,10 @@ def main(argv=None):
             sec = time.perf_counter() - t0
             return {
                 "n": nbig, "seconds": round(sec, 2),
+                # staged path compiles per stage — no single cost record
+                # covers the chain, so this one stays on the hand model
                 "gflops": round(20.0 * nbig**3 / 3.0 / sec / 1e9, 1),
+                "flops_source": "model",
                 "stages": stage_t,
             }
 

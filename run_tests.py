@@ -10,7 +10,11 @@ Usage:
 
 import argparse
 import os
+import re
+import subprocess
 import sys
+import threading
+import time
 
 PRESETS = {
     "quick": {"dim": "32,50", "nb": "16", "type": "d"},
@@ -19,8 +23,63 @@ PRESETS = {
 }
 
 
+# The ROADMAP tier-1 contract, verbatim: command shape, 870 s timeout
+# (kill 10 s after terminate), and DOTS_PASSED accounting over the
+# progress lines.  `python run_tests.py --tier1` replaces hand-pasting.
+TIER1_TIMEOUT = 870.0
+TIER1_KILL_GRACE = 10.0
+_DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+
+def tier1() -> int:
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+        "--continue-on-collection-errors", "-p", "no:cacheprovider",
+        "-p", "no:xdist", "-p", "no:randomly",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+    timed_out = False
+
+    def _watchdog():
+        nonlocal timed_out
+        try:
+            proc.wait(timeout=TIER1_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.terminate()
+            try:
+                proc.wait(timeout=TIER1_KILL_GRACE)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    w = threading.Thread(target=_watchdog, daemon=True)
+    w.start()
+    dots = 0
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+        if _DOTS_RE.match(line.rstrip("\n")):
+            dots += line.count(".")
+    rc = proc.wait()
+    w.join()
+    if timed_out:
+        rc = 124  # the driver's `timeout` convention
+    print(f"DOTS_PASSED={dots}")
+    print(f"tier1: rc={rc} wall={time.monotonic() - t0:.0f}s")
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--tier1", action="store_true",
+                    help="run the exact ROADMAP tier-1 gate (870 s timeout, "
+                         "DOTS_PASSED accounting) and exit")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -28,6 +87,9 @@ def main() -> int:
     ap.add_argument("--target", default="d")
     ap.add_argument("--type", default=None)
     args = ap.parse_args()
+
+    if args.tier1:
+        return tier1()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -91,6 +91,9 @@ from .matgen.generate import generate_matrix
 # simplified verb API (reference: include/slate/simplified_api.hh)
 from . import simplified
 
+# serving layer (lazy package: costs nothing until the first request)
+from . import serve
+
 __version__ = "0.1.0"
 
 __all__ = [name for name in dir() if not name.startswith("_")]

@@ -229,6 +229,35 @@ class context:
         return False
 
 
+class deltas:
+    """Counter-delta window: snapshot on enter, ``d.get(name)`` reads the
+    live increment since.  The serving tests/bench use it to assert
+    "no compiles in steady state" without global resets::
+
+        with metrics.deltas() as d:
+            ...
+        assert d.get("jit.compilations") == 0
+    """
+
+    def __enter__(self):
+        self._before = counters()
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def get(self, name: str) -> float:
+        return counters().get(name, 0) - self._before.get(name, 0)
+
+    def all(self) -> Dict[str, float]:
+        now = counters()
+        keys = set(now) | set(self._before)
+        out = {
+            k: now.get(k, 0) - self._before.get(k, 0) for k in sorted(keys)
+        }
+        return {k: v for k, v in out.items() if v}
+
+
 def instrumented(name: str) -> Callable:
     """Decorator: record one phase per driver call (wall time, both
     timelines).  With metrics AND tracing off, the overhead is one bool

@@ -51,22 +51,15 @@ def _size_bucket_runs(heights, total, floor=1024):
     covers it, floored at min(floor, total) so tiny tails don't multiply
     compiled bodies.  (Buckets are halvings of `total`, NOT pow2ceil(h):
     for total=6144 a height of 2500 buckets to 3072, not 4096.)
-    Yields (i0, i1, S) runs; every height in [i0, i1) is <= S."""
+    Yields (i0, i1, S) runs; every height in [i0, i1) is <= S.
 
-    def bucket(h):
-        S = total
-        while S // 2 >= max(h, 1) and S // 2 >= min(floor, total):
-            S //= 2
-        return S
+    The canonical implementation lives in serve/buckets.py — the
+    serving layer's request buckets are the same halving lattice, so
+    the rule is defined once (serve's __init__ is lazy; this import
+    pulls only the pure buckets module, no cycle)."""
+    from ..serve.buckets import size_bucket_runs
 
-    sizes = [bucket(h) for h in heights]
-    i0 = 0
-    while i0 < len(sizes):
-        i1 = i0
-        while i1 < len(sizes) and sizes[i1] == sizes[i0]:
-            i1 += 1
-        yield i0, i1, sizes[i0]
-        i0 = i1
+    return size_bucket_runs(heights, total, floor)
 
 
 @accurate_matmul

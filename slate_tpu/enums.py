@@ -264,6 +264,10 @@ class Option(enum.Enum):
     MaxUnrolledTiles = "max_unrolled_tiles"  # unroll k-loop below this nt
     UseShardMap = "use_shard_map"  # explicit SPMD fast path vs GSPMD
     RequireSpmd = "require_spmd"  # error instead of gathered fallback
+    # serving layer (serve/)
+    ServeQueueLimit = "serve_queue_limit"  # admission bound (-> Rejected)
+    ServeBatchMax = "serve_batch_max"  # coalesced batch point per bucket
+    ServeBatchWindow = "serve_batch_window"  # coalescing linger, seconds
 
 
 # Marker constants kept for API parity (reference: enums.hh:531-534).

@@ -36,6 +36,9 @@ _DEFAULTS = {
     Option.MaxUnrolledTiles: 256,
     Option.UseShardMap: True,
     Option.RequireSpmd: False,
+    Option.ServeQueueLimit: 128,
+    Option.ServeBatchMax: 8,
+    Option.ServeBatchWindow: 0.002,
 }
 
 

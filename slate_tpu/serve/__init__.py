@@ -1,0 +1,41 @@
+"""slate_tpu.serve — batching solver service above the drivers.
+
+Shape-bucketed dispatch (`buckets`), an executable cache with a
+persistent warmup manifest (`cache`, ``SLATE_TPU_WARMUP=/path.json``),
+a deadline-aware batching service (`service`), and thin sync wrappers
+(`api`): ``serve.gesv/posv/gels``, ``serve.submit``, ``serve.warmup``.
+
+Attribute access is lazy (PEP 562): importing ``slate_tpu.serve`` (or
+``serve.buckets`` from the drivers) never pulls the driver stack, so
+``drivers/eig.py -> serve.buckets`` stays acyclic and module import
+costs nothing until the first request.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_API = (
+    "gesv", "posv", "gels", "submit", "warmup", "configure", "shutdown",
+    "get_service", "get_cache",
+)
+_SERVICE = ("SolverService", "Rejected", "DeadlineExceeded")
+_CACHE = ("ExecutableCache", "direct_call", "WARMUP_ENV")
+_BUCKETS = (
+    "BucketKey", "bucket_for", "bucket_dim", "halving_bucket",
+    "size_bucket_runs", "batch_bucket",
+)
+
+__all__ = list(_API + _SERVICE + _CACHE + _BUCKETS) + ["api", "buckets"]
+
+
+def __getattr__(name: str):
+    if name in _API:
+        return getattr(importlib.import_module(".api", __name__), name)
+    if name in _SERVICE:
+        return getattr(importlib.import_module(".service", __name__), name)
+    if name in _CACHE:
+        return getattr(importlib.import_module(".cache", __name__), name)
+    if name in _BUCKETS:
+        return getattr(importlib.import_module(".buckets", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,141 @@
+"""Thin sync serving API over one process-wide :class:`SolverService`.
+
+Usage::
+
+    from slate_tpu import serve
+
+    serve.warmup("warmup.json")        # pre-compile the manifest's buckets
+    X = serve.gesv(A, B)               # sync; pads/crops + batches under the hood
+    fut = serve.submit("posv", S, B, deadline=0.2, retries=1)
+    ...
+    X2 = fut.result()
+
+Semantics:
+
+* Inputs are plain (m, n)/(m, nrhs) host or device arrays — the serving
+  boundary is arrays, not Matrix objects (clients shouldn't know the
+  tile layout; the bucket decides it).
+* ``gesv``/``posv`` require square A; ``posv`` solves with the LOWER
+  triangle of A (SPD).  ``gels`` with m < n is served by the direct
+  driver (minimum-norm path is not vmap-batched).
+* A nonzero driver ``info`` raises NumericalError from ``.result()`` /
+  the sync wrapper; deadline misses raise DeadlineExceeded; a full
+  queue raises Rejected from ``submit`` itself.
+* Graceful degradation: when a bucket's batched executable keeps
+  failing, its requests transparently fall back to the direct driver
+  (counted in ``serve.fallbacks``; the bucket is marked degraded after
+  ``degrade_after`` consecutive failures and stops being batched).
+
+The default service reads :class:`~slate_tpu.enums.Option` defaults
+(``ServeQueueLimit``, ``ServeBatchMax``, ``ServeBatchWindow``) through
+``options.get_option``; ``configure()`` overrides them per process.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from ..enums import Option
+from ..options import Options, get_option
+from .cache import ExecutableCache
+from .service import DeadlineExceeded, Rejected, SolverService  # noqa: F401
+
+_lock = threading.Lock()
+_service: Optional[SolverService] = None
+
+
+def get_service() -> SolverService:
+    """The process-wide service (lazily started on first use)."""
+    global _service
+    with _lock:
+        if _service is None:
+            _service = _make_service(None)
+        return _service
+
+
+def _make_service(opts: Optional[Options], **kw) -> SolverService:
+    cfg = dict(
+        max_queue=int(get_option(opts, Option.ServeQueueLimit)),
+        batch_max=int(get_option(opts, Option.ServeBatchMax)),
+        batch_window_s=float(get_option(opts, Option.ServeBatchWindow)),
+    )
+    cfg.update(kw)
+    return SolverService(**cfg)
+
+
+def configure(opts: Optional[Options] = None, **kw) -> SolverService:
+    """Rebuild the process service (stops the old one).  ``kw`` are
+    :class:`SolverService` arguments; ``opts`` resolves the Serve*
+    options.  Returns the new service."""
+    global _service
+    with _lock:
+        if _service is not None:
+            _service.stop()
+        _service = _make_service(opts, **kw)
+        return _service
+
+
+def shutdown() -> None:
+    """Stop the process service (idempotent; a later call re-creates)."""
+    global _service
+    with _lock:
+        if _service is not None:
+            _service.stop()
+            _service = None
+
+
+def warmup(
+    path: Optional[str] = None, verbose: bool = False
+) -> int:
+    """Pre-compile the warmup manifest's executables (``path`` or the
+    service cache's configured ``SLATE_TPU_WARMUP`` manifest).  Returns
+    the number compiled.  After this, requests whose buckets are in the
+    manifest are steady-state compile-free."""
+    svc = get_service()
+    return svc.cache.warmup(
+        path=path, batch_max=svc.batch_max, verbose=verbose
+    )
+
+
+def submit(
+    routine: str,
+    A,
+    B,
+    deadline: Optional[float] = None,
+    retries: int = 0,
+) -> Future:
+    """Async entry: enqueue and return the Future (see
+    :meth:`SolverService.submit`)."""
+    return get_service().submit(routine, A, B, deadline=deadline, retries=retries)
+
+
+def _sync(routine, A, B, deadline, retries) -> np.ndarray:
+    fut = submit(routine, A, B, deadline=deadline, retries=retries)
+    # no result-timeout: the worker resolves every admitted future
+    # (deadline expiry included), so blocking here cannot hang
+    return fut.result()
+
+
+def gesv(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+    """Solve A X = B (square, LU with partial pivoting) through the
+    service; returns X (n x nrhs)."""
+    return _sync("gesv", A, B, deadline, retries)
+
+
+def posv(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+    """Solve SPD A X = B (Cholesky, lower triangle referenced)."""
+    return _sync("posv", A, B, deadline, retries)
+
+
+def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+    """Least-squares solve min ||A X - B|| (m >= n batched; m < n direct)."""
+    return _sync("gels", A, B, deadline, retries)
+
+
+def get_cache() -> ExecutableCache:
+    """The process service's executable cache (manifest control)."""
+    return get_service().cache

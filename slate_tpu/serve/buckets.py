@@ -1,0 +1,244 @@
+"""Canonical shape/dtype bucketing for the serving layer.
+
+Unbounded user shapes must map onto a BOUNDED executable set or every
+new (m, n, nrhs) pays a cold XLA trace+compile (minutes for the staged
+paths, per BENCH_NOTES).  The scheme is the halving-bucket rule already
+proven inside ``drivers/eig.py::_size_bucket_runs``: a size h is
+assigned the smallest S = total / 2^m that still covers it, floored so
+tiny sizes don't multiply compiled bodies.  For serving there is no
+fixed ``total`` — buckets double up from ``floor`` instead, which is the
+same lattice (``halving_bucket(h, total=2^k floor, floor)`` for k large
+enough), so a dimension n lands on the unique power-of-two multiple of
+``floor`` covering it.
+
+Requests are padded up to their bucket and results cropped back:
+
+* square systems (gesv/posv): A sits in the top-left corner and the
+  trailing diagonal block is the identity, so the padded system is
+  block-diagonal ``[[A, 0], [0, I]]`` — partial pivoting never selects a
+  pad row for a real column (those entries are 0), Cholesky of the pad
+  block is the identity, and the cropped solution equals the direct one.
+* least squares (gels, m >= n): zero pad rows plus unit columns
+  ``A_pad[m+i, n+i] = 1`` keep full column rank; the pad columns have
+  support only in pad rows where B is zero, so the cropped X is the
+  original LS solution.  ``bucket_mn`` bumps the row bucket when the
+  column padding would not fit below the real rows.
+* right-hand sides: zero columns, cropped back exactly.
+
+This module is pure (stdlib + numpy only, no jax, no driver imports) so
+``drivers/eig.py`` can share ``size_bucket_runs`` without an import
+cycle through the lazy ``serve`` package.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Iterator, Sequence, Tuple
+
+import numpy as np
+
+DIM_FLOOR = 64
+NRHS_FLOOR = 8
+
+
+def halving_bucket(h: int, total: int, floor: int = 1) -> int:
+    """Smallest S = total / 2^m with S >= h, floored at min(floor, total)
+    (the drivers' bucket rule: for total=6144, h=2500 buckets to 3072,
+    not pow2ceil's 4096)."""
+    S = total
+    while S // 2 >= max(h, 1) and S // 2 >= min(floor, total):
+        S //= 2
+    return S
+
+
+def size_bucket_runs(
+    heights: Sequence[int], total: int, floor: int = 1024
+) -> Iterator[Tuple[int, int, int]]:
+    """Group consecutive indices into runs of equal ``halving_bucket``
+    size: yields (i0, i1, S) with every height in [i0, i1) <= S.  The
+    canonical implementation behind ``drivers/eig._size_bucket_runs``."""
+    sizes = [halving_bucket(h, total, floor) for h in heights]
+    i0 = 0
+    while i0 < len(sizes):
+        i1 = i0
+        while i1 < len(sizes) and sizes[i1] == sizes[i0]:
+            i1 += 1
+        yield i0, i1, sizes[i0]
+        i0 = i1
+
+
+def bucket_dim(n: int, floor: int = DIM_FLOOR) -> int:
+    """Bucket one dimension: the power-of-two multiple of ``floor``
+    covering n (the doubling view of the halving lattice)."""
+    if n <= 0:
+        raise ValueError(f"dimension must be positive, got {n}")
+    S = floor
+    while S < n:
+        S *= 2
+    return S
+
+
+def bucket_mn(m: int, n: int, floor: int = DIM_FLOOR) -> Tuple[int, int]:
+    """Bucket a tall (m >= n) shape so the gels unit pad columns fit:
+    needs Mb - m >= Nb - n (each pad column carries a 1 in its own pad
+    row)."""
+    Nb = bucket_dim(n, floor)
+    Mb = bucket_dim(m, floor)
+    if Mb - m < Nb - n:
+        Mb = bucket_dim(m + (Nb - n), floor)
+    return Mb, Nb
+
+
+@dataclass(frozen=True)
+class BucketKey:
+    """Identity of one compiled executable: (routine, bucket shape,
+    dtype, nb, options tag).  Hashable cache key, JSON round-trippable
+    for the warmup manifest."""
+
+    routine: str
+    m: int  # row bucket
+    n: int  # column bucket
+    nrhs: int  # rhs bucket
+    dtype: str  # canonical numpy name, e.g. "float64"
+    nb: int  # tile size the executable was built with
+    tag: str = ""  # options fingerprint (empty = defaults)
+
+    @property
+    def label(self) -> str:
+        """Metric-name fragment: serve.<routine>.<label>.b<batch>.run"""
+        return f"{self.routine}.{self.m}x{self.n}x{self.nrhs}.{self.dtype}" + (
+            f".{self.tag}" if self.tag else ""
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "routine": self.routine, "m": self.m, "n": self.n,
+            "nrhs": self.nrhs, "dtype": self.dtype, "nb": self.nb,
+            "tag": self.tag,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "BucketKey":
+        return BucketKey(
+            routine=str(d["routine"]), m=int(d["m"]), n=int(d["n"]),
+            nrhs=int(d["nrhs"]), dtype=str(d["dtype"]), nb=int(d["nb"]),
+            tag=str(d.get("tag", "")),
+        )
+
+
+def _serve_nb(S: int) -> int:
+    """Tile size for a serving executable: one MXU-friendly tile up to
+    64, then the drivers' blocked paths take over."""
+    return min(64, S)
+
+
+def bucket_for(
+    routine: str,
+    m: int,
+    n: int,
+    nrhs: int,
+    dtype,
+    floor: int = DIM_FLOOR,
+    nrhs_floor: int = NRHS_FLOOR,
+    tag: str = "",
+) -> BucketKey:
+    """Map one request onto its BucketKey.  gesv/posv are square
+    (m == n); gels buckets rows and columns independently (m >= n —
+    underdetermined systems are served by the direct path, see api)."""
+    dt = np.dtype(dtype).name
+    rb = bucket_dim(nrhs, nrhs_floor)
+    if routine in ("gesv", "posv"):
+        if m != n:
+            raise ValueError(f"{routine} requires square A, got {m}x{n}")
+        S = bucket_dim(n, floor)
+        return BucketKey(routine, S, S, rb, dt, _serve_nb(S), tag)
+    if routine == "gels":
+        if m < n:
+            raise ValueError("gels serving path requires m >= n")
+        Mb, Nb = bucket_mn(m, n, floor)
+        return BucketKey(routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag)
+    raise ValueError(f"unknown serving routine: {routine!r}")
+
+
+def batch_bucket(count: int, batch_max: int) -> int:
+    """Two batch points per key — 1 (lone request) and batch_max
+    (coalesced) — so steady state touches exactly the executables
+    warmup compiled, regardless of arrival timing."""
+    return 1 if count <= 1 else batch_max
+
+
+# ---------------------------------------------------------------------------
+# pad / crop
+# ---------------------------------------------------------------------------
+
+
+def pad_square(A: np.ndarray, S: int) -> np.ndarray:
+    """Top-left embed with identity trailing block (gesv/posv)."""
+    n = A.shape[0]
+    out = np.zeros((S, S), dtype=A.dtype)
+    out[:n, :n] = A
+    if S > n:
+        idx = np.arange(n, S)
+        out[idx, idx] = 1
+    return out
+
+
+def pad_tall(A: np.ndarray, Mb: int, Nb: int) -> np.ndarray:
+    """Zero row pad + unit pad columns in pad rows (gels, m >= n)."""
+    m, n = A.shape
+    out = np.zeros((Mb, Nb), dtype=A.dtype)
+    out[:m, :n] = A
+    for i in range(Nb - n):
+        out[m + i, n + i] = 1
+    return out
+
+
+def pad_rhs(B: np.ndarray, rows: int, nrhs_b: int) -> np.ndarray:
+    out = np.zeros((rows, nrhs_b), dtype=B.dtype)
+    out[: B.shape[0], : B.shape[1]] = B
+    return out
+
+
+def pad_request(key: BucketKey, A: np.ndarray, B: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad one request's (A, B) to the key's bucket shapes."""
+    if key.routine == "gels":
+        return pad_tall(A, key.m, key.n), pad_rhs(B, key.m, key.nrhs)
+    return pad_square(A, key.n), pad_rhs(B, key.n, key.nrhs)
+
+
+def crop_result(key: BucketKey, X: np.ndarray, n: int, nrhs: int) -> np.ndarray:
+    """Crop a padded solution back to the request's true (n, nrhs)."""
+    return X[:n, :nrhs]
+
+
+def pad_waste(key: BucketKey, m: int, n: int, nrhs: int) -> int:
+    """Padded-minus-true element count of one request's operands (the
+    ``serve.bucket_pad_waste`` counter unit)."""
+    true = m * n + m * nrhs
+    padded = key.m * key.n + key.m * key.nrhs
+    return max(padded - true, 0)
+
+
+def manifest_dumps(entries) -> str:
+    """Serialize [(BucketKey, batch), ...] as the warmup manifest JSON."""
+    return json.dumps(
+        {
+            "version": 1,
+            "entries": sorted(
+                ({**k.to_json(), "batch": int(b)} for k, b in entries),
+                key=lambda e: (e["routine"], e["m"], e["n"], e["nrhs"],
+                               e["dtype"], e["tag"], e["batch"]),
+            ),
+        },
+        indent=1,
+    )
+
+
+def manifest_loads(text: str):
+    """Parse a warmup manifest back into [(BucketKey, batch), ...]."""
+    doc = json.loads(text)
+    out = []
+    for e in doc.get("entries", []):
+        out.append((BucketKey.from_json(e), int(e.get("batch", 1))))
+    return out

@@ -1,0 +1,265 @@
+"""Executable cache + on-disk warmup manifest.
+
+The cache maps ``(BucketKey, batch)`` to a compiled, metrics-
+instrumented executable over padded global arrays.  Executables are
+built lazily on first use; every build is appended to the warmup
+manifest (``SLATE_TPU_WARMUP=/path.json`` or an explicit path), so a
+deployment's steady-state bucket set accumulates across runs and
+``warmup()`` can pre-compile the whole set at startup — after which a
+stream of requests in warmed buckets is compile-free (the
+``jit.compilations`` counter stays flat).
+
+Executable shape: ``fn(A_batch, B_batch) -> (X_batch, info_batch)``
+with ``A: (batch, Mb, Nb)``, ``B: (batch, Mb, nrhs_b)`` — the drivers
+vmapped over the leading axis (Matrix construction from the padded
+globals happens inside the trace; tile layouts are static per bucket).
+Only two batch points exist per key (1 and batch_max, see
+``buckets.batch_bucket``), so the executable set stays bounded and
+deterministic.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..aux import metrics
+from ..exceptions import NumericalError
+from .buckets import BucketKey, manifest_dumps, manifest_loads
+
+WARMUP_ENV = "SLATE_TPU_WARMUP"
+
+
+def _build_core(key: BucketKey) -> Callable:
+    """The unbatched core over padded globals for one bucket.  Driver
+    imports are local: serve must stay importable before drivers are
+    (the lazy ``serve/__init__`` keeps ``drivers/eig -> serve.buckets``
+    acyclic)."""
+    from ..drivers import chol as _chol
+    from ..drivers import lu as _lu
+    from ..drivers import qr as _qr
+    from ..enums import Uplo
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    nb = key.nb
+
+    if key.routine == "gesv":
+
+        def core(Ag, Bg):
+            A = Matrix.from_global(Ag, nb)
+            B = Matrix.from_global(Bg, nb)
+            X, _LU, _piv, info = _lu.gesv(A, B)
+            return X.to_global(), info
+
+        return core
+
+    if key.routine == "posv":
+
+        def core(Ag, Bg):
+            A = HermitianMatrix.from_global(Ag, nb, uplo=Uplo.Lower)
+            B = Matrix.from_global(Bg, nb)
+            X, _L, info = _chol.posv(A, B)
+            return X.to_global(), info
+
+        return core
+
+    if key.routine == "gels":
+        import jax.numpy as jnp
+
+        def core(Ag, Bg):
+            A = Matrix.from_global(Ag, nb)
+            B = Matrix.from_global(Bg, nb)
+            X = _qr.gels(A, B)
+            return X.to_global(), jnp.zeros((), jnp.int32)
+
+        return core
+
+    raise ValueError(f"unknown serving routine: {key.routine!r}")
+
+
+def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Unpadded, unbatched driver call — the reference result and the
+    graceful-degradation fallback path.  Raises NumericalError on a
+    nonzero info."""
+    from ..drivers import chol as _chol
+    from ..drivers import lu as _lu
+    from ..drivers import qr as _qr
+    from ..enums import Uplo
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    nb = min(64, A.shape[1])
+    if routine == "gesv":
+        Bm = Matrix.from_global(B, nb)
+        X, _LU, _piv, info = _lu.gesv(Matrix.from_global(A, nb), Bm)
+        if int(info) != 0:
+            raise NumericalError(f"gesv: singular U({int(info)})", int(info))
+        return np.asarray(X.to_global())
+    if routine == "posv":
+        Bm = Matrix.from_global(B, nb)
+        X, _L, info = _chol.posv(
+            HermitianMatrix.from_global(A, nb, uplo=Uplo.Lower), Bm
+        )
+        if int(info) != 0:
+            raise NumericalError(f"posv: not SPD at {int(info)}", int(info))
+        return np.asarray(X.to_global())
+    if routine == "gels":
+        nbm = min(64, max(A.shape))
+        X = _qr.gels(Matrix.from_global(A, nbm), Matrix.from_global(B, nbm))
+        return np.asarray(X.to_global())
+    raise ValueError(f"unknown serving routine: {routine!r}")
+
+
+def _warm_inputs(key: BucketKey, batch: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Well-conditioned dummy operands for a warmup compile: identity A
+    (SPD, pivot-free, full rank) and zero B."""
+    dt = np.dtype(key.dtype)
+    A = np.zeros((batch, key.m, key.n), dtype=dt)
+    d = min(key.m, key.n)
+    A[:, np.arange(d), np.arange(d)] = 1
+    B = np.zeros((batch, key.m, key.nrhs), dtype=dt)
+    return A, B
+
+
+class ExecutableCache:
+    """(BucketKey, batch) -> compiled executable, with manifest
+    persistence.  Thread-safe: the service worker and warmup() may race
+    on first build."""
+
+    def __init__(self, manifest_path: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._exes: Dict[Tuple[BucketKey, int], Callable] = {}
+        self._entries: Set[Tuple[BucketKey, int]] = set()
+        self.manifest_path = (
+            manifest_path
+            if manifest_path is not None
+            else os.environ.get(WARMUP_ENV) or None
+        )
+        if self.manifest_path and os.path.exists(self.manifest_path):
+            try:
+                with open(self.manifest_path) as f:
+                    self._entries.update(manifest_loads(f.read()))
+            except (OSError, ValueError, KeyError):
+                pass  # a corrupt manifest must never block serving
+
+    # -- manifest ----------------------------------------------------------
+
+    def entries(self) -> List[Tuple[BucketKey, int]]:
+        with self._lock:
+            return sorted(self._entries, key=lambda e: (e[0].label, e[1]))
+
+    def _record(self, key: BucketKey, batch: int) -> None:
+        with self._lock:
+            if (key, batch) in self._entries:
+                return
+            self._entries.add((key, batch))
+            self._flush_locked()
+
+    def ensure_manifest(self, key: BucketKey, batches) -> None:
+        """Record every batch point of a bucket's working set (the
+        service registers both 1 and batch_max on first traffic, so a
+        manifest captured after ANY dispatch warms both — lone and
+        coalesced steady state alike)."""
+        with self._lock:
+            new = [b for b in batches if (key, int(b)) not in self._entries]
+            if not new:
+                return
+            for b in new:
+                self._entries.add((key, int(b)))
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self.manifest_path:
+            return
+        tmp = f"{self.manifest_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(manifest_dumps(self._entries) + "\n")
+            os.replace(tmp, self.manifest_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def save_manifest(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the current bucket set to ``path`` (or the configured
+        manifest path).  Returns the path written."""
+        with self._lock:
+            if path is not None:
+                self.manifest_path = path
+            self._flush_locked()
+            return self.manifest_path
+
+    # -- executables -------------------------------------------------------
+
+    def executable(self, key: BucketKey, batch: int) -> Callable:
+        """Get (building + recording on miss) the compiled executable."""
+        with self._lock:
+            exe = self._exes.get((key, batch))
+            if exe is not None:
+                return exe
+        import jax
+
+        core = _build_core(key)
+        name = f"serve.{key.label}.b{batch}"
+        # capture_cost=False: the AOT second compile would double every
+        # warmup (metrics still splits compile-vs-run wall per bucket)
+        exe = metrics.instrument_jit(
+            jax.jit(jax.vmap(core)), name, capture_cost=False
+        )
+        with self._lock:
+            exe = self._exes.setdefault((key, batch), exe)
+        self._record(key, batch)
+        return exe
+
+    def run(self, key: BucketKey, A_batch: np.ndarray, B_batch: np.ndarray):
+        """Execute one padded batch; returns host (X_batch, info_batch)."""
+        import jax.numpy as jnp
+
+        exe = self.executable(key, A_batch.shape[0])
+        X, info = exe(jnp.asarray(A_batch), jnp.asarray(B_batch))
+        return np.asarray(X), np.atleast_1d(np.asarray(info))
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(
+        self,
+        path: Optional[str] = None,
+        batch_max: Optional[int] = None,
+        verbose: bool = False,
+    ) -> int:
+        """Pre-compile every manifest entry (plus ``path``'s entries if
+        given).  Returns the number of executables compiled.  Per-bucket
+        compile walls land in the ``serve.<bucket>.b<batch>.compile``
+        timers; the whole pass under the ``serve.warmup`` timer."""
+        with self._lock:  # the worker may add entries concurrently
+            todo = list(self._entries)
+        if path is not None and os.path.exists(path):
+            with open(path) as f:
+                for e in manifest_loads(f.read()):
+                    if e not in todo:
+                        todo.append(e)
+        compiled = 0
+        with metrics.phase("serve.warmup", always=True) as ph:
+            for key, batch in sorted(todo, key=lambda e: (e[0].label, e[1])):
+                if batch_max is not None and batch > batch_max:
+                    continue
+                with self._lock:
+                    if (key, batch) in self._exes:
+                        continue
+                t0 = time.perf_counter()
+                A, B = _warm_inputs(key, batch)
+                X, info = self.run(key, A, B)
+                compiled += 1
+                if verbose:
+                    print(
+                        f"[serve.warmup] {key.label} b{batch}: "
+                        f"{time.perf_counter() - t0:.2f}s"
+                    )
+        metrics.gauge("serve.warmup_s", ph.seconds)
+        metrics.inc("serve.warmup_compiles", compiled)
+        return compiled

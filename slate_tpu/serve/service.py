@@ -1,0 +1,393 @@
+"""SolverService: bounded request queue, worker thread, same-bucket
+batch coalescing, deadlines, retries, and graceful degradation.
+
+Execution model (one worker, deliberately simple — the architectural
+seam later scaling PRs widen into multi-host dispatch / priority
+tiers / admission control):
+
+* ``submit()`` buckets the request (`buckets.bucket_for`), pads nothing
+  yet, and enqueues.  A full queue rejects IMMEDIATELY with
+  :class:`Rejected` — backpressure belongs at admission, not at a
+  timeout deep in the pipeline.
+* The worker pops the oldest request, waits up to ``batch_window_s``
+  for company, then extracts every queued request with the SAME
+  BucketKey (up to ``batch_max``) into one coalesced batch.  Batches
+  are padded to the fixed ``batch_max`` point (`buckets.batch_bucket`)
+  by repeating the first request, so only two executables exist per
+  bucket and warmed steady state never compiles.
+* Deadlines: a request whose deadline passes while still QUEUED is
+  cancelled with :class:`DeadlineExceeded` (counted in
+  ``serve.deadline_miss``) — it never starts.  A request that finishes
+  past its deadline still delivers its result (XLA dispatches cannot be
+  cancelled mid-flight) but also counts a miss.
+* Failures: an executable exception re-enqueues the batch's requests
+  while they have ``retries`` left; after that each request falls back
+  to the direct driver (``serve.fallbacks``).  A bucket whose batched
+  path fails ``degrade_after`` consecutive times is degraded — routed
+  straight to the direct driver from then on (the api.py graceful-
+  degradation contract).  A nonzero per-item ``info`` raises
+  :class:`~slate_tpu.exceptions.NumericalError` on that item's future
+  only (no retry: the failure is deterministic).
+
+Metrics: ``serve.queue_depth`` gauge, ``serve.requests``,
+``serve.batched`` (coalesced batches), ``serve.batched_requests``,
+``serve.batch_pad`` (repeat-padding), ``serve.bucket_pad_waste``
+(elements), ``serve.deadline_miss``, ``serve.rejected``,
+``serve.fallbacks``, ``serve.degraded``; per-bucket compile/run split
+via the cache's instrumented executables.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..aux import metrics
+from ..exceptions import NumericalError, SlateError
+from . import buckets as _bk
+from .cache import ExecutableCache, direct_call
+
+
+class Rejected(SlateError):
+    """Queue-full backpressure: the request was never admitted."""
+
+
+class DeadlineExceeded(SlateError):
+    """The request's deadline passed before execution started."""
+
+
+@dataclass
+class _Request:
+    routine: str
+    key: Optional[_bk.BucketKey]  # None => direct-only (e.g. gels m < n)
+    A: np.ndarray
+    B: np.ndarray
+    m: int
+    n: int
+    nrhs: int
+    future: Future = field(default_factory=Future)
+    deadline: Optional[float] = None  # absolute time.monotonic()
+    retries: int = 0
+    t_submit: float = field(default_factory=time.monotonic)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (
+            self.deadline is not None
+            and (now if now is not None else time.monotonic()) > self.deadline
+        )
+
+
+class SolverService:
+    """Batching solver service over the driver stack.
+
+    Parameters
+    ----------
+    cache: shared :class:`ExecutableCache` (one per process is the
+        point — executables amortize across services); built from
+        ``SLATE_TPU_WARMUP`` when omitted.
+    max_queue: admission limit; ``submit`` past it raises Rejected.
+    batch_max: coalesced batch point (and per-key executable batch).
+    batch_window_s: how long the worker lingers for coalescable
+        arrivals after popping a lone request.
+    dim_floor / nrhs_floor: bucket lattice floors (buckets.py).
+    degrade_after: consecutive batched-path failures of one bucket
+        before it is permanently routed to the direct driver.
+    start: set False to build paused (tests; call :meth:`start`).
+    """
+
+    def __init__(
+        self,
+        cache: Optional[ExecutableCache] = None,
+        max_queue: Optional[int] = None,
+        batch_max: Optional[int] = None,
+        batch_window_s: Optional[float] = None,
+        dim_floor: int = _bk.DIM_FLOOR,
+        nrhs_floor: int = _bk.NRHS_FLOOR,
+        degrade_after: int = 2,
+        start: bool = True,
+    ):
+        # None -> the Serve* Option defaults (one source of truth with
+        # options.py; api._make_service resolves per-call opts the same way)
+        from ..enums import Option
+        from ..options import get_option
+
+        self.cache = cache if cache is not None else ExecutableCache()
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else get_option(None, Option.ServeQueueLimit)
+        )
+        self.batch_max = int(
+            batch_max if batch_max is not None
+            else get_option(None, Option.ServeBatchMax)
+        )
+        self.batch_window_s = float(
+            batch_window_s if batch_window_s is not None
+            else get_option(None, Option.ServeBatchWindow)
+        )
+        self.dim_floor = int(dim_floor)
+        self.nrhs_floor = int(nrhs_floor)
+        self.degrade_after = int(degrade_after)
+        self._q: Deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._stopped = False  # stop() called; submit() rejects until start()
+        self._thread: Optional[threading.Thread] = None
+        self._fail_streak: Dict[_bk.BucketKey, int] = {}
+        self._degraded: set = set()
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SolverService":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+            self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name="slate-serve-worker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the worker; unstarted/leftover requests resolve with
+        Rejected (futures never hang)."""
+        with self._cond:
+            self._running = False
+            self._stopped = True
+            leftovers = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for r in leftovers:
+            _resolve_exc(r.future, Rejected("service stopped"))
+        metrics.gauge("serve.queue_depth", 0)
+
+    def __enter__(self) -> "SolverService":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self,
+        routine: str,
+        A,
+        B,
+        deadline: Optional[float] = None,
+        retries: int = 0,
+    ) -> Future:
+        """Enqueue one solve; returns a Future resolving to the cropped
+        solution X (n x nrhs ndarray).
+
+        ``deadline`` is seconds from now; ``retries`` re-runs the
+        batched path on executable failure before falling back.
+        Raises :class:`Rejected` when the queue is full."""
+        A = np.asarray(A)
+        B = np.asarray(B)
+        if B.ndim == 1:
+            B = B[:, None]
+        if A.ndim != 2 or B.ndim != 2 or A.shape[0] != B.shape[0]:
+            raise ValueError(
+                f"{routine}: bad shapes A{A.shape} B{B.shape}"
+            )
+        m, n = A.shape
+        nrhs = B.shape[1]
+        key: Optional[_bk.BucketKey] = None
+        if not (routine == "gels" and m < n):
+            key = _bk.bucket_for(
+                routine, m, n, nrhs, A.dtype,
+                floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
+            )
+        req = _Request(
+            routine=routine, key=key, A=A, B=B, m=m, n=n, nrhs=nrhs,
+            deadline=(
+                time.monotonic() + deadline if deadline is not None else None
+            ),
+            retries=int(retries),
+        )
+        with self._cond:
+            if self._stopped:
+                # a stopped service has no worker to ever resolve the
+                # future (a paused-but-never-started one does: start());
+                # admitting here would hang the sync wrappers
+                metrics.inc("serve.rejected")
+                raise Rejected("service stopped; configure() a new one")
+            if len(self._q) >= self.max_queue:
+                metrics.inc("serve.rejected")
+                raise Rejected(
+                    f"queue full ({self.max_queue}); retry with backoff"
+                )
+            self._q.append(req)
+            depth = len(self._q)
+            self._cond.notify_all()
+        metrics.inc("serve.requests")
+        metrics.gauge("serve.queue_depth", depth)
+        return req.future
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # -- worker ------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            if batch:
+                self._execute(batch)
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Pop the oldest live request plus every same-key request (up
+        to batch_max).  None => stopped; [] => only expired requests
+        were popped this round."""
+        with self._cond:
+            while self._running and not self._q:
+                self._cond.wait(0.05)
+            if not self._running:
+                # resolve anything the failure path re-enqueued after
+                # stop() drained the queue — futures must never strand
+                leftovers = list(self._q)
+                self._q.clear()
+                for r in leftovers:
+                    _resolve_exc(r.future, Rejected("service stopped"))
+                return None
+            first = self._q.popleft()
+            metrics.gauge("serve.queue_depth", len(self._q))
+        if first.expired():
+            self._miss(first)
+            return []
+        if first.key is None:
+            return [first]
+        if self.batch_max > 1 and self.batch_window_s > 0:
+            with self._cond:
+                if not any(r.key == first.key for r in self._q):
+                    self._cond.wait(self.batch_window_s)
+        batch = [first]
+        with self._cond:
+            keep: Deque[_Request] = deque()
+            while self._q and len(batch) < self.batch_max:
+                r = self._q.popleft()
+                if r.key == first.key:
+                    batch.append(r)
+                else:
+                    keep.append(r)
+            keep.extend(self._q)
+            self._q = keep
+            metrics.gauge("serve.queue_depth", len(self._q))
+        live = []
+        for r in batch:
+            if r.expired():
+                self._miss(r)
+            else:
+                live.append(r)
+        return live
+
+    def _miss(self, req: _Request) -> None:
+        metrics.inc("serve.deadline_miss")
+        _resolve_exc(
+            req.future,
+            DeadlineExceeded(
+                f"{req.routine} {req.m}x{req.n}: deadline passed after "
+                f"{time.monotonic() - req.t_submit:.3f}s in queue"
+            ),
+        )
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, batch: List[_Request]) -> None:
+        key = batch[0].key
+        if key is None or key in self._degraded:
+            for r in batch:
+                self._direct(r)
+            return
+        try:
+            self._execute_batched(key, batch)
+            self._fail_streak[key] = 0
+        except Exception as e:  # noqa: BLE001 — futures carry the error
+            retryable = [r for r in batch if r.retries > 0]
+            rest = [r for r in batch if r.retries <= 0]
+            streak = self._fail_streak.get(key, 0) + 1
+            self._fail_streak[key] = streak
+            if streak >= self.degrade_after:
+                self._degraded.add(key)
+                metrics.inc("serve.degraded")
+            if retryable:
+                with self._cond:
+                    for r in reversed(retryable):
+                        r.retries -= 1
+                        self._q.appendleft(r)
+                    self._cond.notify_all()
+            for r in rest:
+                self._direct(r, batched_error=e)
+
+    def _execute_batched(self, key: _bk.BucketKey, batch: List[_Request]) -> None:
+        self.cache.ensure_manifest(key, (1, self.batch_max))
+        bb = _bk.batch_bucket(len(batch), self.batch_max)
+        pads = [_bk.pad_request(key, r.A, r.B) for r in batch]
+        while len(pads) < bb:  # repeat-pad to the fixed batch point
+            pads.append(pads[0])
+            metrics.inc("serve.batch_pad")
+        A_b = np.stack([p[0] for p in pads])
+        B_b = np.stack([p[1] for p in pads])
+        X_b, info_b = self.cache.run(key, A_b, B_b)
+        now = time.monotonic()
+        for i, r in enumerate(batch):
+            metrics.inc(
+                "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
+            )
+            if r.deadline is not None and now > r.deadline:
+                metrics.inc("serve.deadline_miss")  # finished late; still delivered
+            info = int(info_b[i]) if i < len(info_b) else 0
+            if info != 0:
+                _resolve_exc(
+                    r.future,
+                    NumericalError(f"{r.routine}: info={info}", info),
+                )
+            else:
+                _resolve(r.future, _bk.crop_result(key, X_b[i], r.n, r.nrhs))
+        if len(batch) > 1:
+            metrics.inc("serve.batched")
+            metrics.inc("serve.batched_requests", len(batch))
+
+    def _direct(self, req: _Request, batched_error: Optional[Exception] = None) -> None:
+        if req.key is not None:
+            metrics.inc("serve.fallbacks")  # degradation, not routing
+        else:
+            metrics.inc("serve.direct_only")  # e.g. underdetermined gels
+        try:
+            with metrics.phase(f"serve.direct.{req.routine}"):
+                X = direct_call(req.routine, req.A, req.B)
+        except Exception as e:  # noqa: BLE001 — futures carry the error
+            if batched_error is not None:
+                e.__context__ = batched_error
+            _resolve_exc(req.future, e)
+            return
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            metrics.inc("serve.deadline_miss")
+        _resolve(req.future, X)
+
+
+def _resolve(fut: Future, value) -> None:
+    if not fut.cancelled():
+        fut.set_result(value)
+
+
+def _resolve_exc(fut: Future, exc: Exception) -> None:
+    if not fut.cancelled():
+        fut.set_exception(exc)

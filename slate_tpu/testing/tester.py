@@ -660,6 +660,47 @@ def _test_steqr(pr: Params):
     return dt, 0.0, max(err, res)
 
 
+def _test_serve(pr: Params):
+    """Serving layer end-to-end: a private SolverService (so the sweep
+    never perturbs the process singleton) coalescing mixed-shape
+    gesv/posv traffic; error = worst scaled solve residual across the
+    stream (the padded-and-cropped results must meet the same bound as
+    the direct drivers)."""
+    from ..serve.cache import ExecutableCache
+    from ..serve.service import SolverService
+    from .checks import solve_residual
+
+    n = pr.n
+    n2 = max(n // 2, 4)
+    rng = np.random.default_rng(pr.seed)
+    dt_ = pr.dtype if pr.dtype in (np.float32, np.float64) else np.float64
+    A1 = rng.standard_normal((n, n)).astype(dt_) + n * np.eye(n, dtype=dt_)
+    G = rng.standard_normal((n2, n2)).astype(dt_)
+    A2 = (G @ G.T + n2 * np.eye(n2, dtype=dt_)).astype(dt_)
+    B1 = rng.standard_normal((n, max(pr.k, 1))).astype(dt_)
+    B2 = rng.standard_normal((n2, max(pr.k, 1))).astype(dt_)
+    svc = SolverService(
+        cache=ExecutableCache(manifest_path=None), batch_max=4,
+        dim_floor=min(32, pr.nb * 2), start=False,
+    )
+    t0 = time.perf_counter()
+    futs = []
+    for i in range(3):
+        futs.append(("gesv", A1 + i * 0.01 * np.eye(n, dtype=dt_), B1))
+    futs.append(("posv", A2, B2))
+    futs = [(r, A, B, svc.submit(r, A, B)) for r, A, B in futs]
+    svc.start()
+    try:
+        worst = 0.0
+        for r, A, B, f in futs:
+            X = f.result(timeout=600)
+            worst = max(worst, solve_residual(A, X, B))
+    finally:
+        svc.stop()
+    dt = time.perf_counter() - t0
+    return dt, 0.0, worst
+
+
 ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "gemm": _test_gemm,
     "posv": _test_posv,
@@ -691,6 +732,7 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "condest": _test_condest,
     "steqr": _test_steqr,
     "sterf": _test_sterf,
+    "serve": _test_serve,
 }
 
 # Reference-style tolerance factors per routine class.  The reference
@@ -731,7 +773,7 @@ TOL_FACTOR = {
     "cholqr": 50000,
     "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
     "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 500, "condest": 1,
-    "steqr": 50, "sterf": 50,
+    "steqr": 50, "sterf": 50, "serve": 50,
 }
 
 

@@ -260,6 +260,7 @@ class TestDebugDumps:
         assert "0,0" in s and "1,1" in s
 
 
+@pytest.mark.slow
 def test_redistribute_spmd_no_fallback(rng, grid22):
     """Same-grid distributed redistribute takes the SPMD two-phase
     re-send (parallel/spmd_redistribute.py) — no recorded gather."""

@@ -32,7 +32,10 @@ def _lower_band(rng, n, nb):
     return np.tril(np.triu(np.tril(A), -nb))
 
 
-@pytest.mark.parametrize("n,nb", [(96, 16), (100, 16), (64, 32)])
+@pytest.mark.parametrize(
+    "n,nb",
+    [(96, 16), (100, 16), pytest.param(64, 32, marks=pytest.mark.slow)],
+)
 def test_band_storage_tiles_matches_dense(rng, n, nb):
     lay = TileLayout(n, n, nb, nb, 1, 1)
     G = _lower_band(rng, n, nb)
@@ -112,6 +115,7 @@ def test_native_hb2st_matches_wavefront(rng, n, b):
     )
 
 
+@pytest.mark.slow
 def test_heev_native_path_residual(rng):
     """heev eagerly routes stage 2 through the native chaser (real f64);
     the full driver keeps LAPACK-grade residuals."""

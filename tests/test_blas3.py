@@ -195,6 +195,7 @@ def test_trsm_unit_diag(rng):
     np.testing.assert_allclose(np.asarray(X.to_global()), ref, rtol=1e-9, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_herk_distributed_spmd(rng, grid22):
     n, k, nb = 64, 48, 16
     A0 = rng.standard_normal((n, k))
@@ -207,6 +208,7 @@ def test_herk_distributed_spmd(rng, grid22):
     )
 
 
+@pytest.mark.slow
 def test_her2k_distributed_complex(rng, grid22):
     n, k, nb = 48, 32, 16
     A0 = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))

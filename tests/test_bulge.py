@@ -121,6 +121,7 @@ def test_bisection_clustered(rng):
     np.testing.assert_allclose(w, ref, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_bdsqr_values_and_vectors(rng):
     from slate_tpu.drivers.svd import bdsqr
 
@@ -152,6 +153,7 @@ def test_heev_two_stage_vs_dense_agreement(rng):
     assert res < 1e-12 * np.abs(A0).max() * n, res
 
 
+@pytest.mark.slow
 def test_svd_jw_band_path(rng):
     import slate_tpu as st
 

@@ -50,8 +50,8 @@ def test_chol_fori(n, nb):
     "n,nb",
     [
         (512, 128),     # single-level panels
-        (1280, 128),    # coarse recursion, 2 levels
-        (1536, 256),    # coarse with uneven last panel
+        pytest.param(1280, 128, marks=pytest.mark.slow),    # coarse recursion, 2 levels
+        pytest.param(1536, 256, marks=pytest.mark.slow),    # coarse, uneven last panel
     ],
 )
 def test_blocked_potrf(n, nb):

@@ -81,6 +81,7 @@ def test_heev_matgen_spectrum(rng):
     )
 
 
+@pytest.mark.slow
 def test_sterf_steqr_stedc(rng):
     n = 32
     d = rng.standard_normal(n)
@@ -153,6 +154,7 @@ def test_ge2tb_band_structure(rng):
     )
 
 
+@pytest.mark.slow
 def test_bdsqr(rng):
     n = 16
     d = rng.standard_normal(n)
@@ -193,6 +195,7 @@ def test_svd_ragged(rng, m, n, nb):
     assert np.abs(rec - A0).max() < 1e-8, np.abs(rec - A0).max()
 
 
+@pytest.mark.slow
 def test_heev_distributed_inputs(rng, grid22):
     """heev executes with mesh-sharded inputs (two-stage path under
     GSPMD; the back-transforms repack onto the grid)."""
@@ -207,6 +210,7 @@ def test_heev_distributed_inputs(rng, grid22):
     assert res < 1e-11 * np.abs(A0).max() * n, res
 
 
+@pytest.mark.slow
 def test_svd_distributed_inputs(rng, grid22):
     m, n, nb = 100, 60, 4
     A0 = rng.standard_normal((m, n))

@@ -137,6 +137,7 @@ def test_herk_mixed_op_records(rng, grid22):
         blas3.syrk(1.0, conj_transpose(A), 0.0, C, opts=REQ)
 
 
+@pytest.mark.slow
 def test_herk_transposed_grid_spmd(rng, grid42):
     """herk/syrk on a non-square mesh must NOT fall back (the old SUMMA
     route resolved A^H onto the transposed grid and gathered)."""
@@ -168,6 +169,7 @@ def test_herk_trans_view_spmd(rng, grid22):
     np.testing.assert_allclose(got, want, atol=1e-11 * n)
 
 
+@pytest.mark.slow
 def test_her2k_spmd_no_fallback(rng, grid22):
     n, k, nb = 48, 32, 16
     A0 = rng.standard_normal((n, k))
@@ -220,6 +222,7 @@ def test_counters_reset():
     assert fallbacks.counters() == {}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["svd_geo", "svd_arith"])
 def test_calu_distributed_illconditioned_parity(rng, grid22, kind):
     """Mesh-tournament CALU matches partial pivoting's solve quality on

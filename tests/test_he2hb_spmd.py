@@ -186,7 +186,10 @@ def test_ge2tb_spmd_gather_free(rng, grid22, monkeypatch):
     assert calls["n"] == 1, "distributed ge2tb must run the shard_map pipeline"
 
 
-@pytest.mark.parametrize("gridname", ["grid22", "grid42"])
+@pytest.mark.parametrize(
+    "gridname",
+    ["grid22", pytest.param("grid42", marks=pytest.mark.slow)],
+)
 def test_svd_spmd_vectors_residual(rng, gridname, request):
     grid = request.getfixturevalue(gridname)
     m, n, nb = 80, 64, 16

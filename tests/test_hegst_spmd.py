@@ -46,7 +46,10 @@ def test_spmd_hermitian_full(rng, grid22, n, nb, dtype):
 
 
 @pytest.mark.parametrize("n,nb", [(64, 16), (50, 16)])
-@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize(
+    "dtype",
+    [np.float64, pytest.param(np.complex128, marks=pytest.mark.slow)],
+)
 def test_hegst_spmd_matches_gathered(rng, grid22, n, nb, dtype):
     A0 = _herm(rng, n, dtype)
     B0 = _spd(rng, n, dtype)
@@ -63,6 +66,7 @@ def test_hegst_spmd_matches_gathered(rng, grid22, n, nb, dtype):
     assert err < 1e-13, err
 
 
+@pytest.mark.slow
 def test_hegv_spmd_gather_free(rng, grid22, monkeypatch):
     """hegv end-to-end on the mesh under RequireSpmd: no gathered
     fallback records, no global materialization."""

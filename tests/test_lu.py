@@ -52,6 +52,7 @@ def test_getrf_distributed(rng, grid22, n, nb):
     assert checks.passed(err, np.float64, factor=30), err
 
 
+@pytest.mark.slow
 def test_getrf_spmd_matches_lapack_pivoting(rng, grid22):
     """Distributed pivots must genuinely pivot: make the natural diagonal
     tiny so no-pivot LU would blow up."""
@@ -87,6 +88,7 @@ def test_gesv(rng):
     assert checks.passed(err, np.float64, factor=30), err
 
 
+@pytest.mark.slow
 def test_gesv_distributed(rng, grid22):
     n, nrhs = 96, 16
     A0 = _mk(rng, n, n)
@@ -124,6 +126,7 @@ def test_gesv_nopiv(rng):
     assert checks.passed(err, np.float64, factor=30), err
 
 
+@pytest.mark.slow
 def test_gesv_rbt(rng):
     n, nrhs = 40, 4
     A0 = _mk(rng, n, n)
@@ -239,6 +242,7 @@ def test_gesv_calu(rng):
     assert np.abs(np.tril(np.asarray(LU.to_global()), -1)).max() < 4.0
 
 
+@pytest.mark.slow
 def test_gesv_calu_distributed(rng, grid22):
     from slate_tpu.enums import MethodLU, Option
 

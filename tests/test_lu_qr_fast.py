@@ -22,10 +22,10 @@ from slate_tpu.ops.householder import (
     "n,nb,ib",
     [
         (256, 128, 16),
-        (384, 128, 32),
+        pytest.param(384, 128, 32, marks=pytest.mark.slow),
         # n > coarse_panels*nb exercises the multi-panel fori_loop path
         # (W > nb) that the bench sizes hit (ADVICE r3)
-        (1280, 128, 32),
+        pytest.param(1280, 128, 32, marks=pytest.mark.slow),
     ],
 )
 def test_lu_fast_vs_scipy(n, nb, ib):
@@ -62,9 +62,9 @@ def test_lu_fast_singularish():
     "m,n,nb,ib",
     [
         (256, 256, 128, 16),
-        (384, 256, 128, 32),
+        pytest.param(384, 256, 128, 32, marks=pytest.mark.slow),
         # multi-panel W > nb path (see test_lu_fast_vs_scipy)
-        (1280, 1280, 128, 32),
+        pytest.param(1280, 1280, 128, 32, marks=pytest.mark.slow),
     ],
 )
 def test_qr_fast(m, n, nb, ib):

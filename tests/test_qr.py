@@ -33,7 +33,11 @@ def test_geqrf_single(rng, dtype, m, n, nb):
     assert checks.passed(err, dtype, factor=30), err
 
 
-@pytest.mark.parametrize("m,n,nb", [(96, 96, 16), (96, 64, 16), (64, 64, 8), (90, 70, 16), (75, 75, 8)])
+@pytest.mark.parametrize(
+    "m,n,nb",
+    [(96, 96, 16), (96, 64, 16), (64, 64, 8), (90, 70, 16),
+     pytest.param(75, 75, 8, marks=pytest.mark.slow)],
+)
 def test_geqrf_distributed(rng, grid22, m, n, nb):
     A0 = _mk(rng, m, n)
     A = Matrix.from_global(A0, nb, grid=grid22)
@@ -161,6 +165,7 @@ def test_larft_matches_recurrence(rng):
     assert np.allclose(T[2, :], 0) and np.allclose(T[:, 2], 0)
 
 
+@pytest.mark.slow
 def test_geqrf_blocked_own_implementation(rng):
     """Our blocked Householder geqrf (used when XLA's primitive is
     unavailable) must match LAPACK semantics."""

@@ -1,0 +1,374 @@
+"""Serving layer tests: slate_tpu/serve (buckets, cache, service, api).
+
+A module-scoped ExecutableCache is shared across tests so each
+(bucket, batch) executable compiles once for the whole file; services
+are built per test (cheap — one thread) against small bucket floors.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import metrics
+from slate_tpu.exceptions import NumericalError
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache, direct_call
+from slate_tpu.serve.service import DeadlineExceeded, Rejected, SolverService
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    """Serving metrics are part of the contract under test."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+@pytest.fixture
+def svc(shared_cache):
+    s = SolverService(
+        cache=shared_cache, batch_max=4, batch_window_s=0.005,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+    )
+    yield s
+    s.stop()
+
+
+def _tol(dtype):
+    # padded-then-cropped must match the direct driver within a few
+    # driver-tolerance units; the ops themselves are identical modulo
+    # the identity pad block
+    return 200 * np.finfo(np.dtype(dtype)).eps
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+
+def test_halving_bucket_matches_doubling_lattice():
+    for n in (1, 3, 16, 17, 64, 65, 100, 1000):
+        got = bk.bucket_dim(n, floor=16)
+        # same lattice as the drivers' halving rule under a pow2 cap
+        assert got == bk.halving_bucket(n, total=4096, floor=16)
+        assert got >= n and (got == 16 or got // 2 < n)
+    with pytest.raises(ValueError):
+        bk.bucket_dim(0)
+
+
+def test_size_bucket_runs_matches_eig():
+    from slate_tpu.drivers.eig import _size_bucket_runs
+
+    heights = [100, 90, 60, 40, 10, 5]
+    assert list(_size_bucket_runs(heights, 128, floor=16)) == list(
+        bk.size_bucket_runs(heights, 128, floor=16)
+    )
+    # the documented non-pow2 case: halvings of total, not pow2ceil
+    assert bk.halving_bucket(2500, 6144, floor=1024) == 3072
+
+
+def test_bucket_mn_keeps_room_for_unit_pad_columns():
+    Mb, Nb = bk.bucket_mn(16, 13, floor=16)
+    # pad columns (3) would not fit below m=16 rows at Mb=16
+    assert Mb - 16 >= Nb - 13
+
+
+def test_bucketkey_manifest_roundtrip(tmp_path):
+    k1 = bk.bucket_for("gesv", 50, 50, 3, np.float64, floor=FLOOR)
+    k2 = bk.bucket_for("gels", 70, 30, 2, np.float32, floor=FLOOR)
+    text = bk.manifest_dumps([(k1, 4), (k2, 1)])
+    back = bk.manifest_loads(text)
+    assert (k1, 4) in back and (k2, 1) in back
+    assert k1 == bk.BucketKey.from_json(k1.to_json())
+
+
+# ---------------------------------------------------------------------------
+# pad correctness: padded-then-cropped == direct driver (ISSUE satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("n,nrhs", [(10, 2), (20, 3)])
+def test_pad_correctness_gesv(svc, dtype, n, nrhs):
+    rng = np.random.default_rng(n)
+    A = rng.standard_normal((n, n)).astype(dtype) + n * np.eye(n, dtype=dtype)
+    B = rng.standard_normal((n, nrhs)).astype(dtype)
+    got = svc.submit("gesv", A, B).result(timeout=120)
+    ref = direct_call("gesv", A, B)
+    assert got.shape == (n, nrhs) and got.dtype == A.dtype
+    denom = max(np.abs(ref).max(), 1.0)
+    assert np.abs(got - ref).max() / denom < _tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_pad_correctness_posv(svc, dtype):
+    n, nrhs = 20, 3
+    rng = np.random.default_rng(7)
+    G = rng.standard_normal((n, n))
+    A = (G @ G.T + n * np.eye(n)).astype(dtype)
+    B = rng.standard_normal((n, nrhs)).astype(dtype)
+    got = svc.submit("posv", A, B).result(timeout=120)
+    ref = direct_call("posv", A, B)
+    denom = max(np.abs(ref).max(), 1.0)
+    assert np.abs(got - ref).max() / denom < _tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("m,n", [(24, 24), (40, 12)])
+def test_pad_correctness_gels(svc, dtype, m, n):
+    """Square and tall least squares across f32/f64."""
+    rng = np.random.default_rng(m + n)
+    A = rng.standard_normal((m, n)).astype(dtype)
+    B = rng.standard_normal((m, 2)).astype(dtype)
+    got = svc.submit("gels", A, B).result(timeout=120)
+    ref = np.linalg.lstsq(
+        A.astype(np.float64), B.astype(np.float64), rcond=None
+    )[0]
+    assert got.shape == (n, 2)
+    assert np.abs(got - ref).max() < 1e4 * np.finfo(np.dtype(dtype)).eps
+
+
+def test_gels_underdetermined_direct(svc):
+    """m < n takes the direct driver (minimum-norm), counted as
+    direct-only routing, not as a fallback."""
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((10, 30))
+    B = rng.standard_normal((10, 2))
+    with metrics.deltas() as d:
+        got = svc.submit("gels", A, B).result(timeout=120)
+    ref = np.linalg.lstsq(A, B, rcond=None)[0]
+    assert np.abs(got - ref).max() < 1e-8
+    assert d.get("serve.direct_only") == 1
+    assert d.get("serve.fallbacks") == 0
+
+
+# ---------------------------------------------------------------------------
+# coalescing + steady-state compile-free serving (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_compile_free_after_warmup(shared_cache, tmp_path):
+    rng = np.random.default_rng(0)
+    n1, n2 = 10, 20
+    A1 = rng.standard_normal((n1, n1)) + n1 * np.eye(n1)
+    B1 = rng.standard_normal((n1, 2))
+    G = rng.standard_normal((n2, n2))
+    A2 = G @ G.T + n2 * np.eye(n2)
+    B2 = rng.standard_normal((n2, 3))
+
+    # phase 1: drive traffic through a paused-then-started service so
+    # batches coalesce; capture the manifest it grew
+    manifest = str(tmp_path / "warmup.json")
+    s1 = SolverService(
+        cache=shared_cache, batch_max=4, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    futs = [s1.submit("gesv", A1 + i * 0.01 * np.eye(n1), B1) for i in range(4)]
+    futs += [s1.submit("posv", A2, B2)]
+    s1.start()
+    for f in futs:
+        f.result(timeout=120)
+    s1.stop()
+    shared_cache.save_manifest(manifest)
+
+    # phase 2: fresh cache + service; warmup the manifest, then a mixed
+    # stream of >= 20 requests must not compile anything new
+    cache2 = ExecutableCache(manifest_path=None)
+    s2 = SolverService(
+        cache=cache2, batch_max=4, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    compiled = cache2.warmup(manifest, batch_max=4)
+    assert compiled >= 4  # both batch points of both buckets
+    with metrics.deltas() as d:
+        futs = []
+        for i in range(8):
+            futs.append(s2.submit("gesv", A1 + i * 1e-3 * np.eye(n1), B1))
+            futs.append(s2.submit("posv", A2 + i * 1e-3 * np.eye(n2), B2))
+        s2.start()
+        for f in futs:
+            f.result(timeout=120)
+        for i in range(6):  # lone sequential requests hit the b1 point
+            got = s2.submit("gesv", A1, B1).result(timeout=120)
+        assert d.get("serve.requests") >= 20
+        assert d.get("jit.compilations") == 0, "steady state must not compile"
+        assert d.get("serve.batched") >= 1
+        assert d.get("serve.bucket_pad_waste") > 0
+    ref = direct_call("gesv", A1, B1)
+    assert np.abs(got - ref).max() < _tol(np.float64) * np.abs(ref).max()
+    s2.stop()
+
+
+def test_coalescing_batches_same_bucket(svc, shared_cache):
+    rng = np.random.default_rng(1)
+    n = 10
+    B = rng.standard_normal((n, 2))
+    mats = [rng.standard_normal((n, n)) + n * np.eye(n) for _ in range(6)]
+    svc.stop()
+    s = SolverService(
+        cache=shared_cache, batch_max=4, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    with metrics.deltas() as d:
+        futs = [s.submit("gesv", A, B) for A in mats]
+        s.start()
+        out = [f.result(timeout=120) for f in futs]
+    assert d.get("serve.batched") >= 1
+    assert d.get("serve.batched_requests") >= 4
+    for A, X in zip(mats, out):
+        assert np.abs(A @ X - B).max() < 1e-9
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# deadlines, backpressure, failures
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_miss_cancels_queued_request(shared_cache):
+    rng = np.random.default_rng(2)
+    n = 10
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, 1))
+    s = SolverService(
+        cache=shared_cache, batch_max=2, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    with metrics.deltas() as d:
+        fut = s.submit("gesv", A, B, deadline=0.01)
+        time.sleep(0.05)  # expires while the worker is paused
+        s.start()
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=120)
+        assert d.get("serve.deadline_miss") == 1
+    s.stop()
+
+
+def test_queue_full_rejected(shared_cache):
+    rng = np.random.default_rng(4)
+    n = 10
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, 1))
+    s = SolverService(
+        cache=shared_cache, max_queue=2, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    f1 = s.submit("gesv", A, B)
+    f2 = s.submit("gesv", A, B)
+    with metrics.deltas() as d:
+        with pytest.raises(Rejected):
+            s.submit("gesv", A, B)
+        assert d.get("serve.rejected") == 1
+    s.start()
+    assert f1.result(timeout=120).shape == (n, 1)
+    assert f2.result(timeout=120).shape == (n, 1)
+    s.stop()
+
+
+def test_stop_resolves_pending_futures(shared_cache):
+    rng = np.random.default_rng(5)
+    n = 10
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, 1))
+    s = SolverService(cache=shared_cache, dim_floor=FLOOR,
+                      nrhs_floor=NRHS_FLOOR, start=False)
+    fut = s.submit("gesv", A, B)
+    s.stop()
+    with pytest.raises(Rejected):
+        fut.result(timeout=10)
+
+
+def test_retry_then_fallback_and_degrade(shared_cache):
+    """A failing batched path retries per policy, falls back to the
+    direct driver, and degrades the bucket after repeated failures."""
+
+    class FlakyCache(ExecutableCache):
+        def __init__(self):
+            super().__init__(manifest_path=None)
+            self.fails = 0
+
+        def run(self, key, A_batch, B_batch):
+            self.fails += 1
+            raise RuntimeError("injected executable failure")
+
+    rng = np.random.default_rng(6)
+    n = 10
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, 1))
+    fc = FlakyCache()
+    s = SolverService(
+        cache=fc, batch_max=2, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+        degrade_after=2,
+    )
+    with metrics.deltas() as d:
+        X = s.submit("gesv", A, B, retries=1).result(timeout=120)
+        assert np.abs(A @ X - B).max() < 1e-9  # fallback result is real
+        assert fc.fails == 2  # first try + one retry
+        assert d.get("serve.fallbacks") == 1
+        assert d.get("serve.degraded") == 1  # streak hit degrade_after
+        # degraded bucket goes straight to the direct driver now
+        X2 = s.submit("gesv", A, B).result(timeout=120)
+        assert fc.fails == 2
+        assert d.get("serve.fallbacks") == 2
+        assert np.abs(A @ X2 - B).max() < 1e-9
+    s.stop()
+
+
+def test_posv_not_spd_raises_numerical(svc):
+    n = 10
+    A = -np.eye(n)
+    B = np.ones((n, 1))
+    with pytest.raises(NumericalError):
+        svc.submit("posv", A, B).result(timeout=120)
+
+
+def test_bad_shapes_rejected_at_submit(svc):
+    with pytest.raises(ValueError):
+        svc.submit("gesv", np.ones((4, 5)), np.ones((4, 1)))
+    with pytest.raises(ValueError):
+        svc.submit("gesv", np.ones((4, 4)), np.ones((3, 1)))
+
+
+# ---------------------------------------------------------------------------
+# warmup manifest env + api surface
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_env_manifest_records(tmp_path, monkeypatch):
+    path = str(tmp_path / "m.json")
+    monkeypatch.setenv("SLATE_TPU_WARMUP", path)
+    c = ExecutableCache()  # picks the env path up
+    assert c.manifest_path == path
+    key = bk.bucket_for("gesv", 10, 10, 1, np.float64, floor=FLOOR)
+    c.ensure_manifest(key, (1,))
+    assert os.path.exists(path)
+    c2 = ExecutableCache(manifest_path=path)
+    assert (key, 1) in c2.entries()
+
+
+def test_api_singleton_and_options(monkeypatch):
+    from slate_tpu import serve
+    from slate_tpu.enums import Option
+
+    svc = serve.configure(
+        {Option.ServeQueueLimit: 7}, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR
+    )
+    try:
+        assert svc.max_queue == 7
+        assert serve.get_service() is svc
+        assert serve.get_cache() is svc.cache
+    finally:
+        serve.shutdown()

@@ -91,6 +91,7 @@ def test_trsm_unit_diag_distributed(rng, grid22):
     )
 
 
+@pytest.mark.slow
 def test_spmd_permute_rows(rng, grid22):
     n, nb = 50, 16
     B0 = rng.standard_normal((n, 8))
@@ -105,6 +106,7 @@ def test_spmd_permute_rows(rng, grid22):
     np.testing.assert_allclose(got, B0[perm[:n]], atol=0)
 
 
+@pytest.mark.slow
 def test_getrs_distributed_no_gather(rng, grid22, monkeypatch):
     """gesv distributed must not gather LU or B to global in the solve."""
     n, nb = 96, 16
@@ -129,6 +131,7 @@ def test_getrs_distributed_no_gather(rng, grid22, monkeypatch):
     assert checks.passed(err, np.float64, factor=30), err
 
 
+@pytest.mark.slow
 def test_posv_distributed_spmd_solve(rng, grid22, monkeypatch):
     n, nb = 96, 16
     A0 = rng.standard_normal((n, n))
@@ -152,6 +155,7 @@ def test_posv_distributed_spmd_solve(rng, grid22, monkeypatch):
     assert checks.passed(err, np.float64, factor=30), err
 
 
+@pytest.mark.slow
 def test_gesv_distributed_ragged(rng, grid42):
     n, nb = 90, 16  # ragged last tile across a 4x2 grid
     M0 = rng.standard_normal((n, n)) + n * np.eye(n)

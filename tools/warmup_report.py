@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Print a warmup-manifest / serving bucket table from a metrics JSONL.
+
+    python tools/warmup_report.py out.jsonl [--manifest warmup.json]
+
+Rows come from the ``serve.<routine>.<MxNxR>.<dtype>[.tag].b<batch>``
+compile/run timers that the serving cache's instrumented executables
+record (slate_tpu/serve/cache.py); with ``--manifest`` the table is
+joined against the warmup manifest so buckets that were never compiled
+in this JSONL (stale manifest entries) and compiles missing from the
+manifest (warmup gap — the next cold start pays them) are both flagged.
+
+Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
+serving workload (examples/ex16_serving.py shows the whole loop).
+"""
+
+import argparse
+import json
+import re
+import sys
+
+_BUCKET_RE = re.compile(r"^serve\.(?P<bucket>.+)\.b(?P<batch>\d+)\.(?P<kind>compile|run)$")
+
+
+def load_jsonl(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def bucket_rows(records):
+    """{(bucket, batch): {compiles, compile_s, runs, run_s}} from timer rows."""
+    rows = {}
+    for r in records:
+        if r.get("type") != "timer":
+            continue
+        m = _BUCKET_RE.match(r.get("name", ""))
+        if not m:
+            continue
+        key = (m.group("bucket"), int(m.group("batch")))
+        row = rows.setdefault(
+            key, {"compiles": 0, "compile_s": 0.0, "runs": 0, "run_s": 0.0}
+        )
+        if m.group("kind") == "compile":
+            row["compiles"] += int(r.get("count", 0))
+            row["compile_s"] += float(r.get("total_s", 0.0))
+        else:
+            row["runs"] += int(r.get("count", 0))
+            row["run_s"] += float(r.get("total_s", 0.0))
+    return rows
+
+
+def manifest_keys(path):
+    with open(path) as f:
+        doc = json.load(f)
+    keys = set()
+    for e in doc.get("entries", []):
+        bucket = f"{e['routine']}.{e['m']}x{e['n']}x{e['nrhs']}.{e['dtype']}"
+        if e.get("tag"):
+            bucket += f".{e['tag']}"
+        keys.add((bucket, int(e.get("batch", 1))))
+    return keys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="warmup_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS output)")
+    ap.add_argument("--manifest", default=None,
+                    help="warmup manifest JSON to join against")
+    args = ap.parse_args(argv)
+
+    records = load_jsonl(args.jsonl)
+    rows = bucket_rows(records)
+    mkeys = manifest_keys(args.manifest) if args.manifest else None
+
+    all_keys = sorted(set(rows) | (mkeys or set()))
+    if not all_keys:
+        print("(no serve.* bucket timers in this JSONL)")
+        return 0
+
+    hdr = (f"{'bucket':44} {'batch':>5} {'compiles':>8} {'compile(s)':>11} "
+           f"{'runs':>6} {'mean_run(ms)':>13} {'note':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    for key in all_keys:
+        bucket, batch = key
+        row = rows.get(key)
+        note = ""
+        if mkeys is not None:
+            if key not in mkeys:
+                note = "unlisted"  # compiled here, missing from manifest
+            elif row is None or row["compiles"] == 0:
+                note = "stale?"  # in manifest, never compiled in this JSONL
+        if row is None:
+            print(f"{bucket:44} {batch:5d} {0:8d} {'-':>11} {0:6d} "
+                  f"{'-':>13} {note:>10}")
+            continue
+        mean_run = (row["run_s"] / row["runs"] * 1e3) if row["runs"] else 0.0
+        print(
+            f"{bucket:44} {batch:5d} {row['compiles']:8d} "
+            f"{row['compile_s']:11.2f} {row['runs']:6d} {mean_run:13.2f} "
+            f"{note:>10}"
+        )
+    total_c = sum(r["compile_s"] for r in rows.values())
+    print(f"\ntotal compile wall: {total_c:.2f}s over "
+          f"{sum(r['compiles'] for r in rows.values())} compiles; "
+          f"warmed steady-state pays none of it")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
