@@ -115,53 +115,59 @@ def bench_gemm(jax, jnp, n, nb, dtype, K, trials):
     return _gflops(name, 2.0 * n**3 * K, best), best / K
 
 
-def bench_potrf(jax, jnp, n, nb, trials):
+def bench_potrf(jax, jnp, n, nb, trials, schedule="auto"):
     import slate_tpu as st
+    from slate_tpu.enums import Option
 
     key = jax.random.PRNGKey(1)
     G = jax.random.normal(key, (n, n), jnp.float64) / np.sqrt(n)
     S = G @ G.T + 2.0 * jnp.eye(n, dtype=jnp.float64)
     A = st.HermitianMatrix.from_global(S, nb, uplo=st.Uplo.Lower)
+    opts = {Option.Schedule: schedule}
 
     @jax.jit
     def step(A, t):
-        L, info = st.potrf(A._with(data=A.data + t * 1e-14))
+        L, info = st.potrf(A._with(data=A.data + t * 1e-14), opts)
         return L.data.sum() + info
 
-    name = f"bench.potrf_n{n}"
+    name = f"bench.potrf_n{n}_{schedule}"
     best = _bench(step, (A,), trials, name=name)
     return _gflops(name, n**3 / 3.0, best), best
 
 
-def bench_getrf(jax, jnp, n, nb, trials):
+def bench_getrf(jax, jnp, n, nb, trials, schedule="auto"):
     import slate_tpu as st
+    from slate_tpu.enums import Option
 
     key = jax.random.PRNGKey(2)
     G = jax.random.normal(key, (n, n), jnp.float64)
     A = st.Matrix.from_global(G + n * jnp.eye(n, dtype=jnp.float64), nb)
+    opts = {Option.Schedule: schedule}
 
     @jax.jit
     def step(A, t):
-        LU, piv, info = st.getrf(A._with(data=A.data + t * 1e-14))
+        LU, piv, info = st.getrf(A._with(data=A.data + t * 1e-14), opts)
         return LU.data.sum() + info
 
-    name = f"bench.getrf_n{n}"
+    name = f"bench.getrf_n{n}_{schedule}"
     best = _bench(step, (A,), trials, name=name)
     return _gflops(name, 2.0 * n**3 / 3.0, best), best
 
 
-def bench_geqrf(jax, jnp, n, nb, trials):
+def bench_geqrf(jax, jnp, n, nb, trials, schedule="auto"):
     import slate_tpu as st
+    from slate_tpu.enums import Option
 
     key = jax.random.PRNGKey(3)
     A = st.Matrix.from_global(jax.random.normal(key, (n, n), jnp.float64), nb)
+    opts = {Option.Schedule: schedule}
 
     @jax.jit
     def step(A, t):
-        fac, T = st.geqrf(A._with(data=A.data + t * 1e-14))
+        fac, T = st.geqrf(A._with(data=A.data + t * 1e-14), opts)
         return fac.data.sum()
 
-    name = f"bench.geqrf_n{n}"
+    name = f"bench.geqrf_n{n}_{schedule}"
     best = _bench(step, (A,), trials, name=name)
     return _gflops(name, 4.0 * n**3 / 3.0, best), best
 
@@ -230,6 +236,11 @@ def main(argv=None):
                          "seconds of budget remain")
     ap.add_argument("--quick", action="store_true",
                     help="CPU-scale sizes + minimal trials (smoke run)")
+    ap.add_argument("--full", action="store_true",
+                    help="historical flagship sizes (n=8192 factorizations, "
+                         "staged heev up to 8192) — needs a raised --budget; "
+                         "the default list is sized to fit the default "
+                         "budget and exit 0 (BENCH_r05 died at rc=124)")
     args = ap.parse_args(argv)
 
     import jax
@@ -306,27 +317,54 @@ def main(argv=None):
 
     run_entry("dgemm", entry_dgemm)
 
-    # -- f64 factorizations ------------------------------------------------
-    def entry_dpotrf():
-        nf = 8192 if on_tpu else 256
-        rep, sec = bench_potrf(jax, jnp, nf, 512 if on_tpu else 64, trials)
-        return {"n": nf, **rep, "seconds": round(sec, 3)}
+    # -- f64 factorizations, schedule=flat|recursive variants --------------
+    # default sizes fit the default --budget (the 8192 flagships pushed
+    # BENCH_r05 past its driver timeout: rc=124, no JSON); --full
+    # restores them.  The recursive variants measure the exact-shape
+    # divide & conquer schedules; extra[label]["flops_waste_ratio"]
+    # carries the per-entry exec/model ratio from the factor.* counters.
+    nfac = (8192 if args.full else 4096) if on_tpu else 128
 
-    run_entry("dpotrf", entry_dpotrf)
+    def factor_entry(label, fn, nsize, nb, schedule):
+        def run():
+            from slate_tpu.aux import metrics as _m
 
-    def entry_dgetrf():
-        nl = 8192 if on_tpu else 128
-        rep, sec = bench_getrf(jax, jnp, nl, 512 if on_tpu else 32, trials)
-        return {"n": nl, **rep, "seconds": round(sec, 3)}
+            c0 = _m.counters()
+            rep, sec = fn(nsize, nb, schedule)
+            c1 = _m.counters()
+            dm = c1.get("factor.flops_model", 0) - c0.get(
+                "factor.flops_model", 0
+            )
+            dx = c1.get("factor.flops_exec", 0) - c0.get(
+                "factor.flops_exec", 0
+            )
+            entry = {"n": nsize, "schedule": schedule, **rep,
+                     "seconds": round(sec, 3)}
+            if dm > 0:
+                entry["flops_waste_ratio"] = round(dx / dm, 3)
+            return entry
 
-    run_entry("dgetrf", entry_dgetrf)
+        return run_entry(label, run)
 
-    def entry_dgeqrf():
-        nq = 8192 if on_tpu else 128
-        rep, sec = bench_geqrf(jax, jnp, nq, 512 if on_tpu else 32, trials)
-        return {"n": nq, **rep, "seconds": round(sec, 3)}
+    nbfac = 512 if on_tpu else 32
+    npo = nfac if on_tpu else 256
+    nbpo = nbfac if on_tpu else 64
 
-    run_entry("dgeqrf", entry_dgeqrf)
+    def _potrf(nn, nb, s):
+        return bench_potrf(jax, jnp, nn, nb, trials, s)
+
+    def _getrf(nn, nb, s):
+        return bench_getrf(jax, jnp, nn, nb, trials, s)
+
+    def _geqrf(nn, nb, s):
+        return bench_geqrf(jax, jnp, nn, nb, trials, s)
+
+    factor_entry("dpotrf", _potrf, npo, nbpo, "flat")
+    factor_entry("dpotrf_recursive", _potrf, npo, nbpo, "recursive")
+    factor_entry("dgetrf", _getrf, nfac, nbfac, "flat")
+    factor_entry("dgetrf_recursive", _getrf, nfac, nbfac, "recursive")
+    factor_entry("dgeqrf", _geqrf, nfac, nbfac, "flat")
+    factor_entry("dgeqrf_recursive", _geqrf, nfac, nbfac, "recursive")
 
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
@@ -371,7 +409,7 @@ def main(argv=None):
                 "stages": stage_t,
             }
 
-        for nbig in (2048, 4096, 8192):
+        for nbig in (2048, 4096, 8192) if args.full else (2048, 4096):
             run_entry(f"dheev_vectors_staged_n{nbig}",
                       lambda nbig=nbig: entry_heev_staged(nbig))
 
@@ -380,6 +418,12 @@ def main(argv=None):
         metrics.dump()
 
     baseline_gflops = 700.0  # reference dgemm per GPU (docs/usage.md:40-42)
+    # sweep-wide waste ratio from the new factor.* counter pair: executed
+    # vs model FLOPs across every factorization the sweep dispatched
+    # (None when no factorization entry ran — the field always prints)
+    fmodel = metrics.counters().get("factor.flops_model", 0.0)
+    fexec = metrics.counters().get("factor.flops_exec", 0.0)
+    waste = round(fexec / fmodel, 3) if fmodel > 0 else None
     print(
         json.dumps(
             {
@@ -387,6 +431,7 @@ def main(argv=None):
                 "value": round(gf_fast, 1),
                 "unit": "GFLOP/s",
                 "vs_baseline": round(gf_fast / baseline_gflops, 3),
+                "flops_waste_ratio": waste,
                 "extra": extra,
             }
         )

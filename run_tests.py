@@ -75,11 +75,35 @@ def tier1() -> int:
     return rc
 
 
+def schedules_smoke() -> int:
+    """Parity gate for the factorization schedules: the whole of
+    tests/test_recursive_schedules.py across all four dtypes
+    (marker-independent — the slow marks only budget the tier-1 gate),
+    including the cheap n=256 driver-routing/metrics tests, minus only
+    the heavy n=2048 end-to-end driver case.  For touching
+    ops/*_kernels.py or the drivers' Option.Schedule routing without
+    paying a full tier-1."""
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_recursive_schedules.py", "-q",
+        "-k", "not driver_n2048",
+        "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.call(
+        cmd, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier1", action="store_true",
                     help="run the exact ROADMAP tier-1 gate (870 s timeout, "
                          "DOTS_PASSED accounting) and exit")
+    ap.add_argument("--schedules", action="store_true",
+                    help="run the factorization-schedule parity smoke "
+                         "(recursive vs flat vs scipy) and exit")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -90,6 +114,8 @@ def main() -> int:
 
     if args.tier1:
         return tier1()
+    if args.schedules:
+        return schedules_smoke()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

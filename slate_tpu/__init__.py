@@ -26,6 +26,7 @@ from .enums import (
     NormScope,
     Op,
     Option,
+    Schedule,
     Side,
     Target,
     TileKind,

@@ -428,13 +428,19 @@ def jit(fn=None, *, name: Optional[str] = None, capture_cost: bool = True,
     )
 
 
-def gated_jit(fn, name: str, **jit_kw):
+def gated_jit(fn, name: str, donate_argnums=(), **jit_kw):
     """Metrics-gated jit for eager kernel call sites: with metrics OFF
     (or under tracing) the original unjitted function runs, bit-identical
     to the un-instrumented code; with metrics ON, dispatch goes through a
     lazily created instrumented jit so the compile/run split and
     cost_analysis land under `name`.  One shared helper so the gate logic
-    (Tracer passthrough, lazy creation) lives in one place."""
+    (Tracer passthrough, lazy creation) lives in one place.
+
+    ``donate_argnums`` is applied only on non-CPU backends (resolved at
+    first dispatch): XLA:CPU does not implement donation and would warn
+    on every call.  Callers must pass freshly built temporaries in
+    donated positions — a donated buffer is invalidated after the call
+    (drivers pass padded/mirrored copies, never user-held storage)."""
     holder: list = []
 
     @functools.wraps(fn)
@@ -449,10 +455,29 @@ def gated_jit(fn, name: str, **jit_kw):
         if not holder:
             with _lock:  # double-check: racing first calls must not
                 if not holder:  # build (and compile) the jit twice
-                    holder.append(instrument_jit(jax.jit(fn, **jit_kw), name))
+                    kwj = dict(jit_kw)
+                    if donate_argnums and jax.default_backend() != "cpu":
+                        kwj["donate_argnums"] = donate_argnums
+                    holder.append(instrument_jit(jax.jit(fn, **kwj), name))
         return holder[0](*args, **kw)
 
     return gate
+
+
+def record_factor_flops(routine: str, fl: dict) -> None:
+    """Feed one factorization's schedule accounting (a dict with
+    ``model``/``exec`` FLOP counts and a ``units`` shape set — see
+    ops/*_kernels ``*_schedule_flops``) into the ``factor.flops_model``
+    / ``factor.flops_exec`` counter pair, global and per-routine, plus
+    a ``factor.<routine>.compile_units`` gauge — the waste ratio of
+    every factorization schedule is then one counter read away."""
+    if not _enabled:
+        return
+    inc("factor.flops_model", fl["model"])
+    inc("factor.flops_exec", fl["exec"])
+    inc(f"factor.{routine}.flops_model", fl["model"])
+    inc(f"factor.{routine}.flops_exec", fl["exec"])
+    gauge(f"factor.{routine}.compile_units", len(fl["units"]))
 
 
 # ---------------------------------------------------------------------------

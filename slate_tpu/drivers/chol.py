@@ -22,7 +22,7 @@ from ..enums import Diag, Norm, Op, Option, Side, Uplo
 from ..exceptions import DimensionError, NumericalError, slate_assert
 from ..matrix.base import BaseMatrix, conj_transpose
 from ..matrix.matrix import HermitianMatrix, Matrix, SymmetricMatrix, TriangularMatrix
-from ..options import Options, get_option
+from ..options import Options, get_option, resolve_schedule_opts
 from ..ops import blas2d, chol_kernels
 from ..parallel import spmd_chol
 from ..parallel.layout import eye_splice, tiles_from_global
@@ -39,9 +39,14 @@ from ..internal import fallbacks
 # metrics-gated jitted kernel: with metrics ON the eager global path
 # dispatches through this wrapper so the compile/run split and the
 # cost_analysis flops are attributed to "potrf.kernel"; with metrics off
-# the original unjitted call runs, bit-identical to before.
+# the original unjitted call runs, bit-identical to before.  The
+# operand (a freshly mirrored full_global copy, never user storage) is
+# donated on accelerators when this jit dispatches (metrics-on eager
+# calls; inside an outer jit — serve cores, bench steps — the outer
+# boundary owns donation, see serve/cache.py).
 _cholesky_kernel = metrics.gated_jit(
-    chol_kernels.cholesky, "potrf.kernel", static_argnums=(1,)
+    chol_kernels.cholesky, "potrf.kernel",
+    static_argnums=(1, 2, 3, 4), donate_argnums=(0,),
 )
 
 
@@ -84,12 +89,24 @@ def potrf(
         full = A.full_global()
         n = A.n
         lay = A.layout
-        # native blocked schedule on accelerators (ops/chol_kernels.py;
-        # handles padding/splicing for any n internally): the vendor
-        # lowering runs at ~3% of the chip's gemm rate.  nb is clamped to
-        # 512: larger blocks would push chol_unblocked into its
-        # bandwidth-bound regime
-        L2 = _cholesky_kernel(full, 512 if n >= 2048 else min(lay.nb, 512))
+        # schedule-dispatched kernel (ops/chol_kernels.py; handles
+        # padding/splicing for any n internally): the vendor lowering
+        # runs at ~3% of the chip's gemm rate, the flat blocked loop
+        # burns ~2-3x the model FLOPs, the recursive schedule factors
+        # exact halving-lattice shapes.  nb is clamped to 512: larger
+        # blocks would push chol_unblocked into its bandwidth-bound
+        # regime.
+        sched, nb_switch, lookahead = resolve_schedule_opts(opts)
+        nb_kernel = 512 if n >= 2048 else min(lay.nb, 512)
+        if metrics.is_on():
+            route = chol_kernels.resolve_schedule(n, sched)
+            metrics.record_factor_flops(
+                "potrf",
+                chol_kernels.chol_schedule_flops(
+                    n, nb_kernel, route, nb_switch, lookahead
+                ),
+            )
+        L2 = _cholesky_kernel(full, nb_kernel, sched, nb_switch, lookahead)
         L = TriangularMatrix.from_global(L2, lay.mb, lay.nb, grid=A.grid, uplo=Uplo.Lower)
 
     info = jnp.where(jnp.all(jnp.isfinite(L.data)), 0, 1).astype(jnp.int32)

@@ -23,7 +23,7 @@ from ..enums import Diag, MethodLU, Norm, Op, Option, Side, Uplo
 from ..exceptions import slate_assert
 from ..matrix.base import BaseMatrix
 from ..matrix.matrix import Matrix, TriangularMatrix
-from ..options import Options, get_option
+from ..options import Options, get_option, resolve_schedule_opts
 from ..ops import lu_kernels
 from ..parallel import spmd_lu, spmd_trsm
 from ..parallel.layout import eye_splice, tiles_from_global, tiles_to_global
@@ -40,9 +40,13 @@ from ..internal import fallbacks
 
 # metrics-gated jitted kernel: attributes the eager global LU's
 # compile/run split + cost_analysis to "getrf.kernel" (unjitted original
-# call with metrics off)
+# call with metrics off).  The padded-global operand (always a fresh
+# temporary) is donated on accelerators when this jit dispatches —
+# getrf overwrites A in place like the reference; under an outer jit
+# (serve cores) the outer boundary donates instead (serve/cache.py).
 _lu_global_kernel = metrics.gated_jit(
-    lu_kernels.lu_global, "getrf.kernel", static_argnums=(1,)
+    lu_kernels.lu_global, "getrf.kernel",
+    static_argnums=(1, 2, 3, 4), donate_argnums=(0,),
 )
 
 
@@ -150,10 +154,24 @@ def getrf(
         if _is_distributed(A):
             fallbacks.record("getrf", opts, "non-square tiles")
         Gp = _padded_global(A)
-        # vendor LU when the backend supports the dtype (TPU: f32/c64
-        # only), else the native blocked right-looking kernel
-        # (ops/lu_kernels.py; reference: src/getrf.cc:85-214)
-        lu2d, perm = _lu_global_kernel(Gp, lay.nb)
+        # schedule-dispatched kernel: vendor LU when auto on a backend
+        # that supports the dtype (TPU: f32/c64 only), recursive divide
+        # & conquer at large n / on request, else the flat blocked
+        # right-looking kernel (ops/lu_kernels.py; src/getrf.cc:85-214)
+        sched, nb_switch, lookahead = resolve_schedule_opts(opts)
+        mp, np_ = Gp.shape
+        if metrics.is_on():
+            route = lu_kernels.resolve_lu_schedule(mp, np_, Gp.dtype, sched)
+            metrics.record_factor_flops(
+                "getrf",
+                lu_kernels.getrf_schedule_flops(
+                    mp, np_, lay.nb, route, nb_switch, lookahead,
+                    m_true=lay.m, n_true=lay.n,
+                ),
+            )
+        lu2d, perm = _lu_global_kernel(
+            Gp, lay.nb, sched, nb_switch, lookahead
+        )
         LU = A._with(data=tiles_from_global(lu2d[: lay.m, : lay.n], lay)).shard()
         m_valid = lay.m
 

@@ -41,10 +41,26 @@ from ..aux.metrics import instrumented
 
 from ..matrix.base import is_distributed as _is_distributed
 
-# metrics-gated jitted kernel: attributes the eager panel-QR's
-# compile/run split + cost_analysis to "geqrf.kernel" (unjitted original
-# call with metrics off)
-_geqrf_global_kernel = metrics.gated_jit(_geqrf_kernel, "geqrf.kernel")
+# metrics-gated jitted kernels: attribute the eager QR's compile/run
+# split + cost_analysis to "geqrf.kernel" (unjitted original call with
+# metrics off).  The padded-global operand (always a fresh temporary)
+# is donated on accelerators when these jits dispatch — geqrf
+# overwrites A with V/R in place like the reference; under an outer
+# jit the outer boundary donates instead (serve/cache.py).
+_geqrf_global_kernel = metrics.gated_jit(
+    _geqrf_kernel, "geqrf.kernel", donate_argnums=(0,)
+)
+
+from ..ops import qr_fast as _qr_fast
+
+_geqrf_recursive_kernel = metrics.gated_jit(
+    _qr_fast.geqrf_recursive, "geqrf.kernel_recursive",
+    static_argnums=(1,), donate_argnums=(0,),
+)
+
+_geqrf_flat_kernel = metrics.gated_jit(
+    _qr_fast.geqrf_flat, "geqrf.kernel_flat", donate_argnums=(0,)
+)
 
 
 def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
@@ -77,8 +93,30 @@ def geqrf(
         Td, Tstack = spmd_qr.spmd_geqrf(A.grid, T, lay)
         return A._with(data=Td), TriangularFactors(Tstack)
 
+    from ..options import resolve_schedule_opts
+
     Gp = _padded_global_splice(A)
-    vr, taus = _geqrf_global_kernel(Gp)
+    mp, npd = Gp.shape
+    sched, nb_switch, _lookahead = resolve_schedule_opts(opts)
+    # one resolver decides both the kernel and the accounting route, so
+    # the factor.geqrf.* counters always describe the traced program
+    route = _qr_fast.resolve_qr_schedule(mp, npd, sched)
+    if metrics.is_on():
+        metrics.record_factor_flops(
+            "geqrf",
+            _qr_fast.geqrf_schedule_flops(
+                mp, npd, nb, route, nb_switch,
+                m_true=lay.m, n_true=lay.n,
+            ),
+        )
+    if route == "recursive":
+        vr, taus = _geqrf_recursive_kernel(Gp, nb_switch)
+    elif route == "flat" and sched == "flat":
+        # explicit flat runs the native schedule on every backend (the
+        # auto flat route lets householder.geqrf pick, same kernel)
+        vr, taus = _geqrf_flat_kernel(Gp)
+    else:
+        vr, taus = _geqrf_global_kernel(Gp)
     m_pad = Gp.shape[0]
     Ts = []
     for k in range(kt):

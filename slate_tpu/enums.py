@@ -228,12 +228,59 @@ class MethodSVD(_StrParseMixin, enum.Enum):
         return {"*": ("auto",), "Q": ("qr",), "D": ("dc",), "B": ()}[self.value]
 
 
+class Schedule(_StrParseMixin, enum.Enum):
+    """Factorization schedule family (slate_tpu extension; no reference
+    analogue — the reference gets exact-shape trailing updates for free
+    from its dynamic tile task graph, a TPU static schedule has to pick):
+
+    * ``Flat``      — the pre-recursion native family: the coarse
+      blocked kernels where the shape admits them (``blocked_potrf``,
+      ``lu_fast``, ``geqrf_fast``), the single-compiled-shape loops
+      (``chol_fori`` / ``blocked_getrf`` lineage) otherwise — masked
+      full-shape inner steps, ~2-6x the model FLOPs.
+    * ``Recursive`` — divide & conquer on the halving lattice
+      (``chol_recursive`` / ``getrf_recursive`` / ``geqrf_recursive``):
+      exact statically-shrinking shapes, O(log n) distinct compile
+      units, near-model FLOPs.
+    * ``Auto``      — backend dispatch: vendor kernel on CPU (LAPACK is
+      already optimal), recursive above the crossover on accelerators,
+      flat/blocked below it.
+    """
+
+    Auto = "auto"
+    Flat = "flat"
+    Recursive = "recursive"
+
+    def aliases(self):
+        return {"auto": ("*",), "flat": (), "recursive": ("rec", "dc")}[
+            self.value
+        ]
+
+
 # ---------------------------------------------------------------------------
 # Option keys (reference: enums.hh:461-498)
 # ---------------------------------------------------------------------------
 
 
 class Option(enum.Enum):
+    # Option-keyed dicts travel through jax pytree flattening (the
+    # metrics layer's Tracer scan, user opts captured in jit closures),
+    # which sorts dict keys — so Option must be orderable, including
+    # against the string keys options.py also accepts.
+    def __lt__(self, other):
+        if isinstance(other, Option):
+            return self.value < other.value
+        if isinstance(other, str):
+            return self.value < other
+        return NotImplemented
+
+    def __gt__(self, other):
+        if isinstance(other, Option):
+            return self.value > other.value
+        if isinstance(other, str):
+            return self.value > other
+        return NotImplemented
+
     ChunkSize = "chunk_size"
     Lookahead = "lookahead"
     BlockSize = "block_size"
@@ -261,6 +308,7 @@ class Option(enum.Enum):
     MethodTrsm = "method_trsm"
     MethodSVD = "method_svd"
     # slate_tpu extensions
+    Schedule = "schedule"  # factorization schedule: flat|recursive|auto
     MaxUnrolledTiles = "max_unrolled_tiles"  # unroll k-loop below this nt
     UseShardMap = "use_shard_map"  # explicit SPMD fast path vs GSPMD
     RequireSpmd = "require_spmd"  # error instead of gathered fallback

@@ -104,8 +104,12 @@ def chol_fori(G: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
 
     One fori_loop over the n//nb panels; every step runs at full array
     shape with row masks (one compile unit).  The trailing update is a
-    (n, nb) x (nb, n) gemm — within ~2x of the exact-shape FLOP count,
-    the price of the single compiled shape.
+    (n, nb) x (nb, n) gemm at EVERY step, so the executed FLOPs are
+    ~2 n^3 + n^2 nb against the n^3/3 model — ~6x the model, ~3x the
+    exact-shape blocked schedule (see ``chol_schedule_flops``), the
+    price of the single compiled shape.  Large-n callers should prefer
+    ``chol_recursive``: exact halving-lattice shapes, near-model FLOPs,
+    O(log n) compile units.
     """
     n = G.shape[0]
     if n == nb:
@@ -257,16 +261,334 @@ def tri_inv_blocked(L: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
     return jnp.concatenate([top, bot], axis=0)
 
 
-def cholesky(G: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
-    """Platform-dispatched Cholesky: vendor kernel on CPU (LAPACK —
-    already optimal), native blocked schedule on accelerators.
+# ---------------------------------------------------------------------------
+# Recursive (divide & conquer) schedule: exact shapes on the halving
+# lattice.  The flat loops above pay for their single compiled shape in
+# raw FLOPs (chol_fori: ~6x the n^3/3 model); the recursion factors the
+# top-left half, solves/updates the off-diagonal block and trailing half
+# at their *exact* static shapes (n, n/2, n/4, ... — at most O(log n)
+# distinct compile units), with the flat kernels kept as the small-n
+# base case below the ``nb_switch`` crossover.
+# ---------------------------------------------------------------------------
+
+
+# auto-schedule crossover: below this the flat/blocked schedules win
+# (recursion overhead + compile-unit count buy nothing at small n)
+RECURSIVE_MIN_N = 2048
+
+
+def split_point(n: int) -> int:
+    """Top-half size of the recursion: ceil(n/2) rounded up to the best
+    MXU alignment that still leaves a nonempty trailing half.  For the
+    serve halving-lattice sizes (2^k * 64/128) this is exactly n/2, so
+    every recursion shape lands back on the lattice the warmup manifest
+    already covers."""
+    h = (n + 1) // 2
+    for a in (128, 64, 32, 16, 8):
+        ha = -(-h // a) * a
+        if ha < n:
+            return ha
+    return h
+
+
+def _lat_height(M: int) -> int:
+    """Round M up to the nearest 2^k or 3*2^(k-1) (1.0x or 1.5x a power
+    of two — exactly two values per octave).  The tall LU/QR recursions
+    produce operand heights m - k*nb — O(n/nb) distinct values;
+    snapping them to this lattice keeps distinct compiled shapes
+    O(log^2) at <= 33% zero-row padding, and halving-lattice sizes map
+    to themselves."""
+    if M <= 0:
+        return 0
+    k = M.bit_length() - 1
+    if M == 1 << k:
+        return M
+    c15 = 3 << (k - 1)  # 1.5 * 2^k
+    return c15 if M <= c15 else 1 << (k + 1)
+
+
+def _trsm_right_lh(L: jnp.ndarray, A: jnp.ndarray, nb: int) -> jnp.ndarray:
+    """X L^H = A with L lower triangular, by recursive 2x2 splitting:
+    the vendor triangular_solve only ever sees <= nb diagonal blocks
+    (the full-size vendor trsm is schedule-bound on this toolchain, the
+    _chol_panels finding) and the bulk work rides exact-shape MXU gemms
+    at exactly the model FLOP count (t h^2)."""
+    h = L.shape[0]
+    if h <= nb:
+        return lax.linalg.triangular_solve(
+            L, A, left_side=False, lower=True, transpose_a=True,
+            conjugate_a=jnp.iscomplexobj(A),
+        )
+    s = split_point(h)
+    X1 = _trsm_right_lh(L[:s, :s], A[:, :s], nb)
+    X2 = _trsm_right_lh(
+        L[s:, s:], A[:, s:] - _dot(X1, _conj(L[s:, :s]).T), nb
+    )
+    return jnp.concatenate([X1, X2], axis=1)
+
+
+def _syrk_lower(C: jnp.ndarray, A: jnp.ndarray, nb: int) -> jnp.ndarray:
+    """Lower triangle of C - A A^H by triangle recursion: only the
+    diagonal nb-blocks pay the full-square gemm, the off-diagonal
+    blocks are plain exact-shape gemms — executed FLOPs t^2 h + O(nb t h)
+    against the t^2 h syrk model, killing the 2x a full-square gemm
+    would cost.  Entries above the diagonal pass through untouched
+    (callers only consume the lower triangle)."""
+    t = C.shape[0]
+    if t <= nb:
+        return C - _dot(A, _conj(A).T)
+    s = split_point(t)
+    C11 = _syrk_lower(C[:s, :s], A[:s], nb)
+    C21 = C[s:, :s] - _dot(A[s:], _conj(A[:s]).T)
+    C22 = _syrk_lower(C[s:, s:], A[s:], nb)
+    top = jnp.concatenate([C11, C[:s, s:]], axis=1)
+    bot = jnp.concatenate([C21, C22], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def _chol_rec(G: jnp.ndarray, nb: int) -> jnp.ndarray:
+    n = G.shape[0]
+    if n <= nb:
+        return chol_unblocked(G)
+    s = split_point(n)
+    L11 = _chol_rec(G[:s, :s], nb)
+    L21 = _trsm_right_lh(L11, G[s:, :s], nb)
+    L22 = _chol_rec(_syrk_lower(G[s:, s:], L21, nb), nb)
+    top = jnp.concatenate([L11, jnp.zeros((s, n - s), G.dtype)], axis=1)
+    bot = jnp.concatenate([L21, L22], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def chol_recursive(
+    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1
+) -> jnp.ndarray:
+    """Divide & conquer Cholesky factor L (lower) of an SPD (n, n) array.
+
+    Schedule: factor the top-left half, solve the off-diagonal block
+    (recursive trsm, vendor solves only at <= nb_switch), subtract the
+    exact-shape triangle-recursive syrk, recurse on the trailing half.
+    Shapes shrink statically down the halving lattice (n, n/2, n/4, ...)
+    so the dominant gemms run at their exact shapes — executed FLOPs stay
+    within ~1.3x of the n^3/3 model at n/nb_switch >= 8 (the flat
+    ``chol_fori`` runs ~6x; see ``chol_schedule_flops``) from O(log n)
+    distinct compile units.
+
+    ``lookahead`` follows the reference potrf convention (lookahead=1 is
+    the baseline pipeline): k > 1 peels k-1 eager ``nb_switch``-wide
+    panels ahead of the halving split at the top level, each with
+    exact-shape trsm + syrk updates (Option.Lookahead wiring).
+    """
+    n = G.shape[0]
+    if n <= nb_switch:
+        return jnp.tril(chol_unblocked(G))
+    cols = []
+    T = G
+    k0 = 0
+    peel = max(int(lookahead) - 1, 0)
+    while peel > 0 and (n - k0) > 2 * nb_switch:
+        w = nb_switch
+        D = chol_unblocked(T[:w, :w])
+        L21 = _trsm_right_lh(D, T[w:, :w], nb_switch)
+        T = _syrk_lower(T[w:, w:], L21, nb_switch)
+        cols.append(
+            jnp.concatenate([jnp.zeros((k0, w), G.dtype), D, L21], axis=0)
+        )
+        k0 += w
+        peel -= 1
+    Lr = _chol_rec(T, nb_switch)
+    if not cols:
+        return jnp.tril(Lr)
+    Lr = jnp.concatenate(
+        [jnp.zeros((k0, n - k0), G.dtype), Lr], axis=0
+    )
+    return jnp.tril(jnp.concatenate(cols + [Lr], axis=1))
+
+
+# ---------------------------------------------------------------------------
+# FLOP accounting.  Pure-python structural mirrors of the schedules
+# above: every gemm/trsm/base-case the traced program will execute is
+# counted at the shape it executes at (masked full-shape ops count at
+# full shape — that IS the waste being measured).  The drivers feed
+# these into the ``factor.flops_model`` / ``factor.flops_exec`` metric
+# counters so the waste ratio is observable per routine; the ``units``
+# set of distinct (op, shape) tuples bounds the schedule's compile-unit
+# count (the recursive paths stay O(log n) vs the data-dependent-free
+# but FLOP-hungry flat loops' O(1)).
+# ---------------------------------------------------------------------------
+
+
+def _chol_unblocked_flops(b: int, ib: int = 16):
+    if b % ib != 0:
+        ib = 8 if b % 8 == 0 else 1
+    nsteps = max(b // ib, 1)
+    # per strip: one full-shape rank-ib trailing gemm + ib masked rank-1
+    # micro-updates on the (b, ib) strip
+    return nsteps * (2.0 * b * ib * b + 2.0 * b * ib * ib), {
+        ("chol_base", b)
+    }
+
+
+def _trsm_flops(t: int, h: int, nb: int):
+    """Executed FLOPs of _trsm_right_lh / the unit-lower left variant in
+    lu_kernels (identical split structure): exactly the t h^2 model."""
+    if h <= nb:
+        return float(t) * h * h, {("trsm", h, t)}
+    s = split_point(h)
+    f1, u1 = _trsm_flops(t, s, nb)
+    f2, u2 = _trsm_flops(t, h - s, nb)
+    return f1 + f2 + 2.0 * t * s * (h - s), u1 | u2 | {("gemm", t, s, h - s)}
+
+
+def _syrk_flops(t: int, h: int, nb: int):
+    if t <= nb:
+        return 2.0 * t * t * h, {("gemm", t, h, t)}
+    s = split_point(t)
+    f1, u1 = _syrk_flops(s, h, nb)
+    f2, u2 = _syrk_flops(t - s, h, nb)
+    return f1 + f2 + 2.0 * (t - s) * h * s, u1 | u2 | {
+        ("gemm", t - s, h, s)
+    }
+
+
+def _chol_rec_flops(n: int, nb: int):
+    if n <= nb:
+        return _chol_unblocked_flops(n)
+    s = split_point(n)
+    f1, u1 = _chol_rec_flops(s, nb)
+    ft, ut = _trsm_flops(n - s, s, nb)
+    fs, us = _syrk_flops(n - s, s, nb)
+    f2, u2 = _chol_rec_flops(n - s, nb)
+    return f1 + ft + fs + f2, u1 | ut | us | u2
+
+
+def _chol_panels_flops(n: int, nb: int):
+    """_chol_panels / blocked_potrf coarse level: exact shapes but the
+    explicit panel inverse (MAGMA recipe) and full-square trailing gemm
+    both cost real FLOPs."""
+    fl, units = 0.0, set()
+    k0 = 0
+    while k0 < n:
+        w = min(nb, n - k0)
+        fb, ub = _chol_unblocked_flops(w)
+        fl += fb
+        units |= ub
+        rest = n - k0 - w
+        if rest > 0:
+            fl += w**3 / 2.0  # Dinv trsm vs identity
+            fl += 2.0 * rest * w * w  # L21 gemm
+            fl += 2.0 * rest * rest * w  # full-square trailing gemm
+            units |= {("trsm", w, w), ("gemm", rest, w, w),
+                      ("gemm", rest, w, rest)}
+        k0 += w
+    return fl, units
+
+
+def _blocked_potrf_flops(n: int, nb: int = 512, coarse_panels: int = 4):
+    if n <= 256:
+        return _chol_unblocked_flops(n)
+    nb = min(nb, n)
+    if n % nb != 0:
+        nb = 256 if n % 256 == 0 else 128
+    nt = n // nb
+    if nt <= coarse_panels:
+        return _chol_panels_flops(n, nb)
+    NB = nb * (-(-nt // coarse_panels))
+    fl, units = 0.0, set()
+    k0 = 0
+    while k0 < n:
+        w = min(NB, n - k0)
+        fd, ud = _blocked_potrf_flops(w, nb, coarse_panels)
+        fl += fd
+        units |= ud
+        rest = n - k0 - w
+        if rest > 0:
+            fl += w**3 / 2.0 + 2.0 * rest * w * w + 2.0 * rest * rest * w
+            units |= {("trsm", w, w), ("gemm", rest, w, w),
+                      ("gemm", rest, w, rest)}
+        k0 += w
+    return fl, units
+
+
+def _chol_fori_flops(n: int, nb: int):
+    if n == nb:
+        return _chol_unblocked_flops(n)
+    steps = n // nb
+    fb, ub = _chol_unblocked_flops(nb)
+    # per step: full-height trsm + full (n, nb) x (nb, n) trailing gemm
+    per = float(n) * nb * nb + 2.0 * n * nb * n
+    return steps * (fb + per), ub | {("trsm", nb, n), ("gemm", n, nb, n)}
+
+
+def chol_schedule_flops(
+    n: int, nb: int = 512, schedule: str = "recursive",
+    nb_switch: int = 256, lookahead: int = 1,
+) -> dict:
+    """(model, exec, units) FLOP accounting for one Cholesky of size n
+    under the given schedule (after the dispatcher's pad to a multiple
+    of 128).  ``model`` is the textbook n^3/3; ``exec`` counts what the
+    traced program actually issues; ``units`` is the set of distinct
+    (op, shape) compile units in the schedule."""
+    npad = -(-n // 128) * 128
+    model = n**3 / 3.0
+    if schedule == "vendor":
+        return {"model": model, "exec": float(model),
+                "units": {("vendor_potrf", n)}}
+    if schedule == "flat":
+        ex, units = _blocked_potrf_flops(npad, nb)
+    elif schedule == "flat_fori":
+        ex, units = _chol_fori_flops(npad, nb if npad % nb == 0 else 128)
+    else:
+        ex, units = 0.0, set()
+        k0, peel = 0, max(int(lookahead) - 1, 0)
+        if npad <= nb_switch:
+            ex, units = _chol_unblocked_flops(npad)
+        else:
+            while peel > 0 and (npad - k0) > 2 * nb_switch:
+                w = nb_switch
+                fb, ub = _chol_unblocked_flops(w)
+                ft, ut = _trsm_flops(npad - k0 - w, w, nb_switch)
+                fs, us = _syrk_flops(npad - k0 - w, w, nb_switch)
+                ex += fb + ft + fs
+                units |= ub | ut | us
+                k0 += w
+                peel -= 1
+            fr, ur = _chol_rec_flops(npad - k0, nb_switch)
+            ex += fr
+            units |= ur
+    return {"model": model, "exec": ex, "units": units}
+
+
+def resolve_schedule(n: int, schedule: str = "auto") -> str:
+    """Resolve an ``auto`` schedule request against the backend and
+    size: vendor LAPACK on CPU, recursive above the crossover on
+    accelerators, the flat/blocked schedule below it.  Explicit
+    ``flat``/``recursive`` are honored on every backend (tests exercise
+    the native schedules on CPU)."""
+    if schedule in ("flat", "recursive"):
+        return schedule
+    if jax.default_backend() == "cpu":
+        return "vendor"
+    return "recursive" if n >= RECURSIVE_MIN_N else "flat"
+
+
+def cholesky(
+    G: jnp.ndarray,
+    nb: int = 512,
+    schedule: str = "auto",
+    nb_switch: int = 256,
+    lookahead: int = 1,
+) -> jnp.ndarray:
+    """Schedule-dispatched Cholesky: vendor kernel on CPU under ``auto``
+    (LAPACK — already optimal), native blocked (``flat``) or divide &
+    conquer (``recursive``, crossover ``nb_switch``) schedule otherwise.
 
     Accepts any n: pads to a multiple of 128 with a unit-diagonal
     splice (chol of blockdiag(A, I) is blockdiag(L, I)) and slices the
     factor back out."""
-    if jax.default_backend() == "cpu":
-        return lax.linalg.cholesky(G)
     n = G.shape[0]
+    route = resolve_schedule(n, schedule)
+    if route == "vendor":
+        return lax.linalg.cholesky(G)
     npad = -(-n // 128) * 128
     if npad != n:
         # pad first even at small n so chol_unblocked keeps its ib=16
@@ -275,5 +597,9 @@ def cholesky(G: jnp.ndarray, nb: int = 512) -> jnp.ndarray:
         idx = jnp.arange(npad)
         splice = jnp.where(idx >= n, 1.0, 0.0).astype(G.dtype)
         Gp = Gp.at[idx, idx].add(splice)
+        if route == "recursive":
+            return chol_recursive(Gp, nb_switch, lookahead)[:n, :n]
         return blocked_potrf(Gp, nb)[:n, :n]
+    if route == "recursive":
+        return chol_recursive(G, nb_switch, lookahead)
     return blocked_potrf(G, nb)

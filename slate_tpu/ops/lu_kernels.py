@@ -29,7 +29,7 @@ from jax import lax
 
 
 def panel_lu(
-    panel: jnp.ndarray, pivot: bool = True
+    panel: jnp.ndarray, pivot: bool = True, act: int | None = None
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Unblocked LU of an (M, nb) panel, partial pivoting by default.
 
@@ -39,6 +39,12 @@ def panel_lu(
     Zero pivot columns produce zero L columns (flagged by the caller's
     info check), not NaNs.  pivot=False runs the no-exchange elimination
     (used after tournament pivoting has already ordered the rows).
+
+    ``act`` (static) restricts the pivot search to rows < act: the
+    recursive schedule pads panels with zero rows up to a canonical
+    height so distinct compiled shapes stay O(log), and those pad rows
+    must never be chosen as pivots (they stay exact fixed points of
+    perm).
     """
     M, nb = panel.shape
     rows = jnp.arange(M)
@@ -47,7 +53,8 @@ def panel_lu(
         a, perm = carry
         col = a[:, j]
         if pivot:
-            mag = jnp.where(rows >= j, jnp.abs(col), -jnp.inf)
+            elig = rows >= j if act is None else (rows >= j) & (rows < act)
+            mag = jnp.where(elig, jnp.abs(col), -jnp.inf)
             piv = jnp.argmax(mag)
         else:
             piv = j
@@ -79,6 +86,15 @@ def blocked_getrf(
     spliced to 1 (layout.eye_splice semantics).  Returns (LU, perm) with
     perm the net forward row permutation: LU = (L\\U) of Gp[perm].
     Reference: src/getrf.cc:85-214.
+
+    Every one of the min(Mp, Np)/nb steps runs the panel factor, row
+    swaps, trsm row and trailing gemm at the FULL padded array shape
+    (one compile unit): the trailing gemms alone execute
+    2 Mp Np min(Mp, Np) FLOPs — ~3x the square-shape 2n^3/3 model, plus
+    the full-shape panel/trsm terms on top (see
+    ``getrf_schedule_flops``).  Large-n callers should prefer
+    ``getrf_recursive``: exact halving-lattice shapes, near-model
+    FLOPs, O(log n) compile units.
     """
     Mp, Np = Gp.shape
     kt = min(Mp, Np) // nb
@@ -235,6 +251,228 @@ def blocked_getrf_tntpiv(
     return G[:Mp], perm[:Mp]
 
 
+# ---------------------------------------------------------------------------
+# Recursive (divide & conquer) schedule: exact shapes on the halving
+# lattice, pivoted, with permutation composition across the halves
+# (Toledo-style recursive LU).  The flat blocked_getrf above pays ~3x
+# the model FLOPs for its single compiled shape; the recursion factors
+# the left column half at its exact (shrinking) height, solves/updates
+# the right half at exact shapes, and composes the half permutations.
+# ---------------------------------------------------------------------------
+
+from .chol_kernels import RECURSIVE_MIN_N, _lat_height, split_point
+
+
+def _trsm_left_unit(L: jnp.ndarray, B: jnp.ndarray, nb: int) -> jnp.ndarray:
+    """L X = B with L unit-lower (diagonal implicit — only the strict
+    lower triangle of L is read), by recursive 2x2 splitting: vendor
+    solves only at <= nb diagonal blocks, exact-shape MXU gemms carry
+    the bulk at exactly the model FLOP count (r h^2)."""
+    h = L.shape[0]
+    if h <= nb:
+        return lax.linalg.triangular_solve(
+            L, B, left_side=True, lower=True, unit_diagonal=True
+        )
+    s = split_point(h)
+    B1 = _trsm_left_unit(L[:s, :s], B[:s], nb)
+    B2 = _trsm_left_unit(
+        L[s:, s:], B[s:] - L[s:, :s] @ B1, nb
+    )
+    return jnp.concatenate([B1, B2], axis=0)
+
+
+def getrf_recursive(
+    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Recursive blocked LU with partial pivoting of an (m, n) array,
+    m >= n.  Returns (LU, perm): LU = (L\\U) of G[perm], the
+    blocked_getrf contract.
+
+    Schedule: factor the left n1 = split_point(n) columns recursively
+    (exact full-height panels), permute the right half by the left
+    half's pivots, solve U12 with the recursive unit-lower trsm, one
+    exact-shape Schur gemm, recurse on the trailing (m-n1, n-n1) block,
+    then compose the two half permutations — the pivot order matches
+    LAPACK partial pivoting exactly (the base case is ``panel_lu``).
+
+    ``lookahead`` follows the reference getrf convention (1 = baseline
+    pipeline): k > 1 peels k-1 eager nb_switch-wide panels ahead of the
+    halving split at the top level (Option.Lookahead wiring).
+    """
+    m, n = G.shape
+    assert m >= n, f"getrf_recursive requires m >= n, got {(m, n)}"
+
+    def canon(X, act):
+        """Snap X's height to the canonical ``_lat_height(act)``:
+        truncate (rows >= act are exact zeros by construction) or
+        zero-pad.  Returns (X', restore) with restore mapping a child
+        (LU, perm) over X' back to X's frame — safe because rows >= act
+        are never pivoted, hence fixed points of the child perm."""
+        M = X.shape[0]
+        Mc = _lat_height(act)
+        if Mc == M:
+            return X, lambda LU, p: (LU, p)
+        if Mc < M:  # drop all-zero tail rows for the child
+
+            def restore(LU, p):
+                LU = jnp.concatenate(
+                    [LU, jnp.zeros((M - Mc, LU.shape[1]), LU.dtype)]
+                )
+                return LU, jnp.concatenate(
+                    [p, jnp.arange(Mc, M, dtype=p.dtype)]
+                )
+
+            return X[:Mc], restore
+
+        def restore(LU, p):  # Mc > M: child's pad rows are fixed points
+            return LU[:M], p[:M]
+
+        return jnp.pad(X, ((0, Mc - M), (0, 0))), restore
+
+    def rec(G, act):
+        # invariant: rows >= act of G are exact zeros (never pivotable)
+        M, n = G.shape
+        if n <= nb_switch:
+            return panel_lu(G, act=None if act >= M else act)
+        s = split_point(n)
+        LU1, p1 = rec(G[:, :s], act)
+        R = G[:, s:][p1]
+        U12 = _trsm_left_unit(LU1[:s, :s], R[:s], nb_switch)
+        S2, restore = canon(
+            jnp.concatenate([LU1[s:, :s], R[s:]], axis=1), act - s
+        )
+        S = S2[:, s:] - S2[:, :s] @ U12
+        LU2, p2 = rec(S, act - s)
+        LU2, p2 = restore(LU2, p2)
+        top = jnp.concatenate([LU1[:s], U12], axis=1)
+        bot = jnp.concatenate([LU1[s:][p2], LU2], axis=1)
+        perm = jnp.concatenate([p1[:s], p1[s:][p2]])
+        return jnp.concatenate([top, bot], axis=0), perm
+
+    if n <= nb_switch:
+        return panel_lu(G)
+    peel = max(int(lookahead) - 1, 0)
+    frames = []  # (top_row_block, L_below, step perm), outermost first
+    T, act = G, m
+    while peel > 0 and (T.shape[1]) > 2 * nb_switch:
+        w = nb_switch
+        LU1, p1 = panel_lu(T[:, :w], act=None if act >= T.shape[0] else act)
+        R = T[:, w:][p1]
+        U12 = _trsm_left_unit(LU1[:w, :w], R[:w], nb_switch)
+        S = R[w:] - LU1[w:, :w] @ U12
+        frames.append((jnp.concatenate([LU1[:w], U12], axis=1),
+                       LU1[w:], p1))
+        T, act = S, act - w
+        peel -= 1
+    LUr, pr = rec(T, act)
+    # stitch the peeled frames back around the recursed trailing factor,
+    # composing permutations innermost-out (each frame nests exactly
+    # like a recursion half)
+    bot, p = LUr, pr
+    for top, Lw, p1 in reversed(frames):
+        w = top.shape[0]
+        bot = jnp.concatenate([Lw[p], bot], axis=1)
+        bot = jnp.concatenate([top, bot], axis=0)
+        p = jnp.concatenate([p1[:w], p1[w:][p]])
+    return bot, p
+
+
+def getrf_schedule_flops(
+    m: int,
+    n: int,
+    nb: int = 512,
+    schedule: str = "recursive",
+    nb_switch: int = 256,
+    lookahead: int = 1,
+    m_true: int | None = None,
+    n_true: int | None = None,
+) -> dict:
+    """(model, exec, units) FLOP accounting for one pivoted LU of
+    (m, n), m >= n, mirroring the executed schedule (masked full-shape
+    ops counted at full shape).  model = n^2 (m - n/3), the LAPACK
+    getrf count — computed from (m_true, n_true) when given, so drivers
+    passing padded kernel shapes still report waste against the TRUE
+    problem size (pad rows/columns are waste, the same convention as
+    chol_schedule_flops)."""
+    from .chol_kernels import _trsm_flops
+
+    mt, nt_ = (m_true or m), (n_true or n)
+    model = float(nt_) * nt_ * (mt - nt_ / 3.0)
+
+    def panel_flops(M, b):
+        # panel_lu: per eliminated column one full-height rank-1 on the
+        # whole (M, b) panel
+        return 2.0 * M * b * min(M, b), {("lu_panel", M, b)}
+
+    if schedule == "vendor":
+        # the vendor kernel still runs on the PADDED array
+        return {"model": model,
+                "exec": float(n) * n * (m - n / 3.0),
+                "units": {("vendor_lu", m, n)}}
+    if schedule == "flat":
+        # blocked_getrf: every step at the full (m, n) padded shape
+        kt = max(min(m, n) // max(nb, 1), 1)
+        fp, up = panel_flops(m, nb)
+        per_step = fp + float(n) * nb * nb + 2.0 * m * n * nb
+        return {
+            "model": model,
+            "exec": kt * per_step,
+            "units": up | {("trsm", nb, n), ("gemm", m, nb, n)},
+        }
+    if schedule == "flat_fast":
+        # lu_fast.blocked_getrf_fast: <= 4 coarse panels at exact
+        # shapes, _block_lu's inner loops masked at full block shape
+        nbf = _lu_fast_nb(n) or max(nb, 1)
+        nt = max(n // nbf, 1)
+        NB = nbf * (-(-nt // 4))
+        ex, units = 0.0, set()
+        k0 = 0
+        while k0 < n:
+            W = min(NB, n - k0)
+            mk = m - k0
+            # _block_lu(mk, W): strips + in-block trsm/gemm, all masked
+            # to the full (mk, W) block per panel
+            ex += 2.0 * mk * nbf * W + 2.0 * nbf * W * W + 2.0 * mk * W * W
+            units |= {("lu_block", mk, W)}
+            rest = n - k0 - W
+            if rest > 0:
+                ex += W**3 / 2.0 + 2.0 * W * W * rest
+                ex += 2.0 * (mk - W) * W * rest
+                units |= {("trsm", W, W), ("gemm", W, W, rest),
+                          ("gemm", mk - W, W, rest)}
+            k0 += W
+        return {"model": model, "exec": ex, "units": units}
+
+    from .chol_kernels import _lat_height
+
+    def rec(M, act, n):
+        # M: physical (canonical) height, act: true rows — mirrors
+        # getrf_recursive's canon() exactly
+        if n <= nb_switch:
+            return panel_flops(M, n)
+        s = split_point(n)
+        f1, u1 = rec(M, act, s)
+        ft, ut = _trsm_flops(n - s, s, nb_switch)
+        Mc = _lat_height(act - s)
+        fg = 2.0 * Mc * s * (n - s)
+        f2, u2 = rec(Mc, act - s, n - s)
+        return f1 + ft + fg + f2, u1 | ut | u2 | {("gemm", Mc, s, n - s)}
+
+    ex, units = 0.0, set()
+    k0, peel = 0, max(int(lookahead) - 1, 0)
+    while peel > 0 and (n - k0) > 2 * nb_switch:
+        w = nb_switch
+        fp, up = panel_flops(m - k0, w)
+        ft, ut = _trsm_flops(n - k0 - w, w, nb_switch)
+        fg = 2.0 * (m - k0 - w) * w * (n - k0 - w)
+        ex += fp + ft + fg
+        units |= up | ut | {("gemm", m - k0 - w, w, n - k0 - w)}
+        k0 += w
+        peel -= 1
+    fr, ur = rec(m - k0, m - k0, n - k0)
+    return {"model": model, "exec": ex + fr, "units": units | ur}
+
+
 def lu_supported(dtype) -> bool:
     """Whether the vendor lax.linalg.lu compiles for this dtype on the
     current default backend (TPU: f32/c64 only)."""
@@ -246,27 +484,68 @@ def lu_supported(dtype) -> bool:
     return dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.complex64))
 
 
-def lu_global(Gp: jnp.ndarray, nb: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Platform-dispatched LU of the padded global array.
+def _lu_fast_nb(n: int) -> int:
+    """Block size the three-level lu_fast schedule uses, 0 when the
+    shape does not admit it — shared by dispatch and accounting."""
+    for nbf in (512, 256, 128):
+        if n % nbf == 0:
+            return nbf
+    return 0
 
-    Returns (LU, perm), perm over Gp's (padded) rows.  CPU keeps the
-    vendor (LAPACK) kernel; on accelerators large square arrays run the
-    three-level native schedule (ops/lu_fast.py — the vendor lowering
-    and the single-level blocked_getrf are both schedule-bound at a few
-    % of the chip's gemm rate), with blocked_getrf as the small-size /
-    rectangular fallback.
-    """
+
+def resolve_lu_schedule(m: int, n: int, dtype, schedule: str = "auto") -> str:
+    """The route ``lu_global`` will take for this shape/dtype/backend —
+    shared with the drivers' FLOP accounting so the recorded
+    ``factor.getrf.*`` counters describe the program actually traced.
+
+    ``flat`` is the pre-recursion native family (same convention as the
+    chol/QR flat routes, which map to the tuned coarse kernels): the
+    three-level ``lu_fast`` schedule for large divisible squares
+    (``flat_fast``), the single-level ``blocked_getrf`` otherwise."""
     import jax
 
-    m, n = Gp.shape
-    if jax.default_backend() != "cpu" and m == n and n >= 2048:
-        from .lu_fast import blocked_getrf_fast
+    if schedule == "recursive" and m >= n:
+        return "recursive"
+    if schedule in ("flat", "recursive"):
+        if m == n and n >= 2048 and _lu_fast_nb(n):
+            return "flat_fast"
+        return "flat"
+    if jax.default_backend() != "cpu" and m == n and n >= RECURSIVE_MIN_N:
+        return "recursive"
+    if lu_supported(dtype):
+        return "vendor"
+    return "flat"
 
-        for nbf in (512, 256, 128):
-            if n % nbf == 0:
-                return blocked_getrf_fast(Gp, nbf)
-    if lu_supported(Gp.dtype):
+
+def lu_global(
+    Gp: jnp.ndarray,
+    nb: int,
+    schedule: str = "auto",
+    nb_switch: int = 256,
+    lookahead: int = 1,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Schedule-dispatched LU of the padded global array.
+
+    Returns (LU, perm), perm over Gp's (padded) rows.  ``auto``: CPU
+    keeps the vendor (LAPACK) kernel; on accelerators large square
+    arrays run the recursive divide & conquer schedule (the vendor
+    lowering and the single-level blocked_getrf are both schedule-bound
+    at a few % of the chip's gemm rate, and the flat loops burn ~3x the
+    model FLOPs), with blocked_getrf as the unsupported-dtype /
+    rectangular fallback.  Explicit ``recursive``/``flat`` are honored
+    on every backend (tests exercise the native schedules on CPU).
+    Dispatch and the drivers' FLOP accounting share
+    ``resolve_lu_schedule``, so the recorded route is always the traced
+    one.
+    """
+    route = resolve_lu_schedule(*Gp.shape, Gp.dtype, schedule)
+    if route == "recursive":
+        return getrf_recursive(Gp, nb_switch, lookahead)
+    if route == "vendor":
         lu2d, _, perm = lax.linalg.lu(Gp)
         return lu2d, perm.astype(jnp.int32)
-    LU, perm = blocked_getrf(Gp, nb)
-    return LU, perm
+    if route == "flat_fast":
+        from .lu_fast import blocked_getrf_fast
+
+        return blocked_getrf_fast(Gp, _lu_fast_nb(Gp.shape[1]))
+    return blocked_getrf(Gp, nb)

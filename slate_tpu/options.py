@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Mapping, Optional, Union
 
-from .enums import Option
+from .enums import Option, Schedule
 from .exceptions import OptionError
 
 OptionKey = Union[Option, str]
@@ -18,6 +18,10 @@ Options = Mapping[OptionKey, Any]
 
 _DEFAULTS = {
     Option.ChunkSize: 1,
+    # Lookahead follows the reference convention: 1 = the baseline
+    # pipeline (one panel in flight — no extra eager panels); k > 1
+    # peels k-1 exact-shape panels ahead of the recursion split in the
+    # recursive factorization schedules (drivers/chol.py, drivers/lu.py).
     Option.Lookahead: 1,
     Option.BlockSize: 256,
     Option.InnerBlocking: 16,
@@ -36,6 +40,7 @@ _DEFAULTS = {
     Option.MaxUnrolledTiles: 256,
     Option.UseShardMap: True,
     Option.RequireSpmd: False,
+    Option.Schedule: Schedule.Auto,
     Option.ServeQueueLimit: 128,
     Option.ServeBatchMax: 8,
     Option.ServeBatchWindow: 0.002,
@@ -58,6 +63,20 @@ def normalize_options(opts: Optional[Options]) -> dict:
     for key, val in (opts or {}).items():
         out[_canon(key)] = val
     return out
+
+
+def resolve_schedule_opts(opts: Optional[Options]):
+    """(schedule, nb_switch, lookahead) for the factorization drivers:
+    the Option.Schedule route (flat|recursive|auto), the recursion
+    crossover (Option.BlockSize), and the eager-panel peel count
+    (Option.Lookahead — reference semantics: 1 = baseline pipeline,
+    k > 1 peels k-1 exact-shape panels ahead of the recursion split)."""
+    sched = get_option(opts, Option.Schedule, Schedule.Auto)
+    if isinstance(sched, str):
+        sched = Schedule.from_string(sched)
+    nb_switch = int(get_option(opts, Option.BlockSize, 256))
+    lookahead = int(get_option(opts, Option.Lookahead, 1))
+    return sched.value, nb_switch, lookahead
 
 
 def get_option(opts: Optional[Options], key: OptionKey, default: Any = None) -> Any:

@@ -24,6 +24,16 @@ The TPU schedule per step k (inside one lax.fori_loop, static shapes):
 Numerical failure (non-SPD) surfaces as NaNs from the Cholesky of the
 diagonal tile; the driver reduces an info code afterwards (reference:
 internal::reduce_info, potrf.cc:208).
+
+Option.Lookahead note: the reference's lookahead queues overlap the
+next panel's factor with the trailing herk on separate host/device
+streams.  Inside one compiled shard_map fori_loop there is no stream
+to schedule — XLA already overlaps independent ops within the step,
+and the k+1 panel column depends on the k trailing update, so an
+explicit lookahead here has nothing to control.  The option instead
+drives the eager-panel peel of the single-chip recursive schedules
+(ops/chol_kernels.chol_recursive, ops/lu_kernels.getrf_recursive),
+threaded through drivers/chol.resolve_schedule_opts.
 """
 
 from __future__ import annotations

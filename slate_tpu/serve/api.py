@@ -62,6 +62,7 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         max_queue=int(get_option(opts, Option.ServeQueueLimit)),
         batch_max=int(get_option(opts, Option.ServeBatchMax)),
         batch_window_s=float(get_option(opts, Option.ServeBatchWindow)),
+        schedule=get_option(opts, Option.Schedule),
     )
     cfg.update(kw)
     return SolverService(**cfg)

@@ -93,8 +93,16 @@ def bucket_mn(m: int, n: int, floor: int = DIM_FLOOR) -> Tuple[int, int]:
 @dataclass(frozen=True)
 class BucketKey:
     """Identity of one compiled executable: (routine, bucket shape,
-    dtype, nb, options tag).  Hashable cache key, JSON round-trippable
-    for the warmup manifest."""
+    dtype, nb, options tag, schedule).  Hashable cache key, JSON
+    round-trippable for the warmup manifest.
+
+    ``schedule`` is the factorization schedule the executable's drivers
+    were traced with (Option.Schedule: auto|flat|recursive) — a
+    first-class key component so a warmup manifest captured from a
+    recursive-schedule deployment precompiles the recursion shapes, not
+    the flat ones.  The recursion's halving splits land exactly on this
+    module's bucket lattice, so one warmed bucket covers every shape
+    the recursive factor touches."""
 
     routine: str
     m: int  # row bucket
@@ -103,19 +111,22 @@ class BucketKey:
     dtype: str  # canonical numpy name, e.g. "float64"
     nb: int  # tile size the executable was built with
     tag: str = ""  # options fingerprint (empty = defaults)
+    schedule: str = "auto"  # factorization schedule (Option.Schedule)
 
     @property
     def label(self) -> str:
         """Metric-name fragment: serve.<routine>.<label>.b<batch>.run"""
-        return f"{self.routine}.{self.m}x{self.n}x{self.nrhs}.{self.dtype}" + (
-            f".{self.tag}" if self.tag else ""
+        return (
+            f"{self.routine}.{self.m}x{self.n}x{self.nrhs}.{self.dtype}"
+            + (f".{self.tag}" if self.tag else "")
+            + (f".{self.schedule}" if self.schedule != "auto" else "")
         )
 
     def to_json(self) -> dict:
         return {
             "routine": self.routine, "m": self.m, "n": self.n,
             "nrhs": self.nrhs, "dtype": self.dtype, "nb": self.nb,
-            "tag": self.tag,
+            "tag": self.tag, "schedule": self.schedule,
         }
 
     @staticmethod
@@ -124,6 +135,7 @@ class BucketKey:
             routine=str(d["routine"]), m=int(d["m"]), n=int(d["n"]),
             nrhs=int(d["nrhs"]), dtype=str(d["dtype"]), nb=int(d["nb"]),
             tag=str(d.get("tag", "")),
+            schedule=str(d.get("schedule", "auto")),
         )
 
 
@@ -142,22 +154,24 @@ def bucket_for(
     floor: int = DIM_FLOOR,
     nrhs_floor: int = NRHS_FLOOR,
     tag: str = "",
+    schedule: str = "auto",
 ) -> BucketKey:
     """Map one request onto its BucketKey.  gesv/posv are square
     (m == n); gels buckets rows and columns independently (m >= n —
-    underdetermined systems are served by the direct path, see api)."""
+    underdetermined systems are served by the direct path, see api).
+    ``schedule`` keys the executable by factorization schedule."""
     dt = np.dtype(dtype).name
     rb = bucket_dim(nrhs, nrhs_floor)
     if routine in ("gesv", "posv"):
         if m != n:
             raise ValueError(f"{routine} requires square A, got {m}x{n}")
         S = bucket_dim(n, floor)
-        return BucketKey(routine, S, S, rb, dt, _serve_nb(S), tag)
+        return BucketKey(routine, S, S, rb, dt, _serve_nb(S), tag, schedule)
     if routine == "gels":
         if m < n:
             raise ValueError("gels serving path requires m >= n")
         Mb, Nb = bucket_mn(m, n, floor)
-        return BucketKey(routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag)
+        return BucketKey(routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule)
     raise ValueError(f"unknown serving routine: {routine!r}")
 
 

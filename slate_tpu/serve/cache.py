@@ -38,21 +38,24 @@ def _build_core(key: BucketKey) -> Callable:
     """The unbatched core over padded globals for one bucket.  Driver
     imports are local: serve must stay importable before drivers are
     (the lazy ``serve/__init__`` keeps ``drivers/eig -> serve.buckets``
-    acyclic)."""
+    acyclic).  The key's factorization schedule is threaded into the
+    drivers via Option.Schedule, so a manifest captured from a
+    recursive-schedule deployment precompiles the recursion shapes."""
     from ..drivers import chol as _chol
     from ..drivers import lu as _lu
     from ..drivers import qr as _qr
-    from ..enums import Uplo
+    from ..enums import Option, Uplo
     from ..matrix.matrix import HermitianMatrix, Matrix
 
     nb = key.nb
+    opts = {Option.Schedule: key.schedule}
 
     if key.routine == "gesv":
 
         def core(Ag, Bg):
             A = Matrix.from_global(Ag, nb)
             B = Matrix.from_global(Bg, nb)
-            X, _LU, _piv, info = _lu.gesv(A, B)
+            X, _LU, _piv, info = _lu.gesv(A, B, opts)
             return X.to_global(), info
 
         return core
@@ -62,7 +65,7 @@ def _build_core(key: BucketKey) -> Callable:
         def core(Ag, Bg):
             A = HermitianMatrix.from_global(Ag, nb, uplo=Uplo.Lower)
             B = Matrix.from_global(Bg, nb)
-            X, _L, info = _chol.posv(A, B)
+            X, _L, info = _chol.posv(A, B, opts)
             return X.to_global(), info
 
         return core
@@ -73,7 +76,7 @@ def _build_core(key: BucketKey) -> Callable:
         def core(Ag, Bg):
             A = Matrix.from_global(Ag, nb)
             B = Matrix.from_global(Bg, nb)
-            X = _qr.gels(A, B)
+            X = _qr.gels(A, B, opts)
             return X.to_global(), jnp.zeros((), jnp.int32)
 
         return core
@@ -206,10 +209,17 @@ class ExecutableCache:
 
         core = _build_core(key)
         name = f"serve.{key.label}.b{batch}"
+        # donate the padded batch operands on accelerators: run() always
+        # builds them fresh from the request's host arrays, so the
+        # factorizations work in place instead of paying a batch-sized
+        # copy per dispatch (XLA:CPU has no donation and would warn).
+        jit_kw = {}
+        if jax.default_backend() != "cpu":
+            jit_kw["donate_argnums"] = (0, 1)
         # capture_cost=False: the AOT second compile would double every
         # warmup (metrics still splits compile-vs-run wall per bucket)
         exe = metrics.instrument_jit(
-            jax.jit(jax.vmap(core)), name, capture_cost=False
+            jax.jit(jax.vmap(core), **jit_kw), name, capture_cost=False
         )
         with self._lock:
             exe = self._exes.setdefault((key, batch), exe)

@@ -98,6 +98,10 @@ class SolverService:
     dim_floor / nrhs_floor: bucket lattice floors (buckets.py).
     degrade_after: consecutive batched-path failures of one bucket
         before it is permanently routed to the direct driver.
+    schedule: factorization schedule the bucket executables trace their
+        drivers with (Option.Schedule: "auto"|"flat"|"recursive") —
+        part of the BucketKey, so manifests and warmup precompile the
+        matching shapes; None reads the Option default.
     start: set False to build paused (tests; call :meth:`start`).
     """
 
@@ -110,11 +114,12 @@ class SolverService:
         dim_floor: int = _bk.DIM_FLOOR,
         nrhs_floor: int = _bk.NRHS_FLOOR,
         degrade_after: int = 2,
+        schedule: Optional[str] = None,
         start: bool = True,
     ):
         # None -> the Serve* Option defaults (one source of truth with
         # options.py; api._make_service resolves per-call opts the same way)
-        from ..enums import Option
+        from ..enums import Option, Schedule
         from ..options import get_option
 
         self.cache = cache if cache is not None else ExecutableCache()
@@ -133,6 +138,12 @@ class SolverService:
         self.dim_floor = int(dim_floor)
         self.nrhs_floor = int(nrhs_floor)
         self.degrade_after = int(degrade_after)
+        if schedule is None:
+            schedule = get_option(None, Option.Schedule, Schedule.Auto)
+        self.schedule = (
+            schedule.value if isinstance(schedule, Schedule)
+            else Schedule.from_string(str(schedule)).value
+        )
         self._q: Deque[_Request] = deque()
         self._cond = threading.Condition()
         self._running = False
@@ -211,6 +222,7 @@ class SolverService:
             key = _bk.bucket_for(
                 routine, m, n, nrhs, A.dtype,
                 floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
+                schedule=self.schedule,
             )
         req = _Request(
             routine=routine, key=key, A=A, B=B, m=m, n=n, nrhs=nrhs,

@@ -1,0 +1,95 @@
+"""Per-routine factorization-schedule report from a metrics JSONL.
+
+Reads the counters/gauges a run exported with SLATE_TPU_METRICS (or
+metrics.dump()) and prints, per factorization routine, the model vs
+executed FLOPs recorded by the drivers' schedule accounting
+(factor.<routine>.flops_model / .flops_exec), the waste ratio, the
+schedule's distinct compile-unit count, and the kernel's jit
+compilation count — the observability loop for the recursive-schedule
+work (ISSUE 3): a deployment can see exactly how much of its
+factorization budget is masked-shape waste and how many shapes it paid
+compiles for.
+
+Run: python tools/schedule_report.py metrics.jsonl [more.jsonl ...]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def collect(paths):
+    from slate_tpu.aux.metrics import load_jsonl
+
+    counters, gauges = {}, {}
+    for path in paths:
+        for rec in load_jsonl(path):
+            if rec.get("type") == "counter":
+                counters[rec["name"]] = (
+                    counters.get(rec["name"], 0.0) + rec["value"]
+                )
+            elif rec.get("type") == "gauge":
+                gauges[rec["name"]] = rec["value"]
+    return counters, gauges
+
+
+def report(counters, gauges):
+    routines = sorted(
+        name.split(".")[1]
+        for name in counters
+        if name.startswith("factor.")
+        and name.endswith(".flops_model")
+        and name.count(".") == 2
+    )
+    lines = []
+    hdr = (f"{'routine':12} {'model GFLOP':>12} {'exec GFLOP':>12} "
+           f"{'waste':>7} {'units':>6} {'compiles':>9}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for r in routines:
+        model = counters.get(f"factor.{r}.flops_model", 0.0)
+        ex = counters.get(f"factor.{r}.flops_exec", 0.0)
+        waste = f"{ex / model:7.3f}" if model > 0 else f"{'n/a':>7}"
+        units = gauges.get(f"factor.{r}.compile_units")
+        # every kernel variant of the routine counts: <r>.kernel and
+        # e.g. <r>.kernel_recursive both record .compilations
+        compiles = sum(
+            v for k, v in counters.items()
+            if k.startswith(f"{r}.kernel") and k.endswith(".compilations")
+        ) or counters.get(f"{r}.compilations", 0)
+        lines.append(
+            f"{r:12} {model / 1e9:12.3f} {ex / 1e9:12.3f} {waste} "
+            f"{int(units) if units is not None else '?':>6} "
+            f"{int(compiles):>9}"
+        )
+    tm = counters.get("factor.flops_model", 0.0)
+    tx = counters.get("factor.flops_exec", 0.0)
+    if tm > 0:
+        lines.append("-" * len(hdr))
+        lines.append(
+            f"{'TOTAL':12} {tm / 1e9:12.3f} {tx / 1e9:12.3f} "
+            f"{tx / tm:7.3f}"
+        )
+    if not routines:
+        lines.append("(no factor.* counters in the given JSONL —"
+                     " run with SLATE_TPU_METRICS set and metrics on)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__.strip())
+        return 2
+    missing = [p for p in argv if not os.path.exists(p)]
+    if missing:
+        print(f"no such file: {missing}", file=sys.stderr)
+        return 2
+    counters, gauges = collect(argv)
+    print(report(counters, gauges))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
