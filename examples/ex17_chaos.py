@@ -1,0 +1,124 @@
+"""ex17: fault injection + the self-healing serve layer.
+
+Runs a mixed request stream while aux/faults kills the worker, fails
+dispatches, corrupts results, and poisons info codes — then shows the
+containment doing its job (README "Failure semantics"):
+
+  1. every future resolves: a result or a typed SlateError, never a hang
+  2. the supervisor respawns the dead worker (serve.worker_restarts)
+  3. a failing bucket's breaker opens, and once the faults stop, a
+     half-open probe restores the batched path (recovery, not one-way
+     degradation)
+  4. admission checks reject non-finite operands before any queue cost
+  5. service.health() snapshots all of it for an external probe
+"""
+
+from _common import check, np
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import InvalidInput, SlateError
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+metrics.on()
+rng = np.random.default_rng(17)
+n = 24
+
+mk = lambda i: rng.standard_normal((n, n)) + (n + i) * np.eye(n)
+rhs = lambda: rng.standard_normal((n, 2))
+
+# the production route is SLATE_TPU_FAULTS="execute:p=0.25,seed=7;..."
+faults.arm("execute", p=0.25, seed=7)
+faults.arm("worker_death", p=0.15, seed=9)
+faults.arm("result_corrupt", every=11)
+faults.on()
+
+svc = SolverService(
+    cache=ExecutableCache(manifest_path=None), batch_max=4, dim_floor=32,
+    retry_backoff_s=0.005, breaker_cooldown_s=0.05, retry_seed=17,
+)
+
+# -- phase 1: faulty stream -----------------------------------------------
+futs = [svc.submit("gesv", mk(i), rhs(), retries=2) for i in range(40)]
+ok = typed = 0
+for i, f in enumerate(futs):
+    try:
+        X = f.result(timeout=300)
+        assert np.all(np.isfinite(X)), "corrupted result must never deliver"
+        ok += 1
+    except SlateError as e:
+        typed += 1  # typed, contextful: e.routine / e.bucket / e.attempt
+assert ok + typed == len(futs), "every future must resolve"
+c = metrics.counters()
+print(f"stream under faults: {ok} solved, {typed} typed errors, 0 hangs")
+print(f"  injected: " + ", ".join(
+    f"{k.split('.')[-1]}={int(v)}" for k, v in sorted(c.items())
+    if k.startswith("faults.injected.")))
+print(f"  worker restarts: {int(c.get('serve.worker_restarts', 0))}, "
+      f"retries: {int(c.get('serve.retries', 0))}, "
+      f"fallbacks: {int(c.get('serve.fallbacks', 0))}, "
+      f"corrupt results recovered: {int(c.get('serve.corrupt_result', 0))}")
+
+# -- phase 2: admission checks --------------------------------------------
+bad = mk(0)
+bad[1, 1] = np.nan
+try:
+    svc.submit("gesv", bad, rhs())
+    raise AssertionError("non-finite A must be rejected at admission")
+except InvalidInput as e:
+    print(f"admission check: rejected pre-queue ({e})")
+
+# -- phase 3: corruption containment, deterministically -------------------
+# result_corrupt fires only on the batched path; the service detects the
+# non-finite X against finite inputs and re-solves the item directly.
+# First close any breaker phase 1 left open (an open breaker would
+# route the probe request direct, where the corrupt site never fires)
+faults.reset()
+import time
+
+while svc.health()["open_buckets"]:
+    time.sleep(0.06)  # past the breaker cooldown
+    svc.submit("gesv", mk(49), rhs()).result(timeout=300)  # clean probe
+faults.arm("result_corrupt", every=1)
+faults.on()
+with metrics.deltas() as d:
+    A, B = mk(50), rhs()
+    X = svc.submit("gesv", A, B).result(timeout=300)
+assert np.all(np.isfinite(X)), "corrupt X must be re-solved, not delivered"
+assert d.get("serve.corrupt_result") >= 1, "corruption must be detected"
+check("ex17 corrupt-recovery solve", np.abs(A @ X - B).max(), 1e-8)
+print(f"corrupt result: detected x{d.get('serve.corrupt_result'):g}, "
+      f"re-solved per-item, clean X delivered")
+
+# -- phase 4: breaker opens under a hard-failing bucket -------------------
+faults.reset()
+faults.arm("execute", every=1)  # every dispatch fails: batched AND direct
+faults.on()
+for i in range(2 * svc.degrade_after):
+    try:
+        svc.submit("gesv", mk(60 + i), rhs(), retries=0).result(timeout=300)
+    except SlateError:
+        pass  # expected: both paths are poisoned
+h = svc.health()
+assert h["open_buckets"], "consecutive batched failures must open the breaker"
+print(f"breaker opened: open_buckets={h['open_buckets']}")
+
+# -- phase 5: recovery to a clean steady state ----------------------------
+faults.reset()  # chaos over
+print(f"health mid-recovery: worker_alive={h['worker_alive']} "
+      f"restarts={h['worker_restarts']} open_buckets={h['open_buckets']}")
+time.sleep(0.06)  # past the breaker cooldown
+with metrics.deltas() as d:
+    errs = []
+    for i in range(8):
+        A, B = mk(100 + i), rhs()
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        errs.append(np.abs(A @ X - B).max() / np.abs(B).max())
+h2 = svc.health()
+assert h2["open_buckets"] == [], "half-open probes must restore batching"
+assert d.get("serve.breaker_closed") >= 1, "the probe must close the breaker"
+check("ex17 post-chaos stream", max(errs), 1e-8)
+print(f"recovered: open_buckets={h2['open_buckets']}, "
+      f"breaker closes: {d.get('serve.breaker_closed'):g}, "
+      f"clean requests served: 8")
+svc.stop()

@@ -96,6 +96,75 @@ def schedules_smoke() -> int:
     )
 
 
+# Env-activated faulty stream for the --chaos gate: SLATE_TPU_FAULTS +
+# SLATE_TPU_METRICS are read at import (the production activation path),
+# the atexit dump writes the JSONL chaos_report joins.
+_CHAOS_DRIVER = """
+import numpy as np
+from slate_tpu.exceptions import SlateError
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+rng = np.random.default_rng(0)
+n = 12
+svc = SolverService(cache=ExecutableCache(manifest_path=None), batch_max=4,
+                    dim_floor=16, nrhs_floor=4, retry_backoff_s=0.002,
+                    breaker_cooldown_s=0.02, retry_seed=0)
+futs = [svc.submit("gesv", rng.standard_normal((n, n)) + n * np.eye(n),
+                   rng.standard_normal((n, 2)), retries=2)
+        for _ in range(24)]
+ok = typed = 0
+for f in futs:
+    try:
+        assert np.all(np.isfinite(f.result(timeout=300)))
+        ok += 1
+    except SlateError:
+        typed += 1
+assert ok + typed == len(futs), "a future hung"
+print(f"chaos driver: {ok} solved, {typed} typed errors, 0 hangs")
+svc.stop()
+"""
+
+
+def chaos() -> int:
+    """Chaos gate, two legs: (1) the fault-injection suite — every
+    site x hardening combination including the slow-marked sustained
+    streams; (2) an env-activated faulty stream (SLATE_TPU_FAULTS +
+    SLATE_TPU_METRICS, the production path) whose JSONL is joined by
+    tools/chaos_report.py — a fault site with injections but no
+    recovery signal fails the gate."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/test_chaos.py", "-q",
+        "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+    ]
+    rc = subprocess.call(cmd, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         cwd=here)
+    if rc != 0:
+        return rc
+    jsonl = os.path.join(tempfile.gettempdir(), f"chaos_{os.getpid()}.jsonl")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
+        SLATE_TPU_FAULTS="execute:p=0.3,seed=3;worker_death:every=7",
+    )
+    try:
+        rc = subprocess.call([sys.executable, "-c", _CHAOS_DRIVER], env=env,
+                             cwd=here)
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "chaos_report.py"), jsonl],
+            cwd=here,
+        )
+    finally:
+        try:
+            os.unlink(jsonl)
+        except OSError:
+            pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier1", action="store_true",
@@ -104,6 +173,9 @@ def main() -> int:
     ap.add_argument("--schedules", action="store_true",
                     help="run the factorization-schedule parity smoke "
                          "(recursive vs flat vs scipy) and exit")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the fault-injection suite (slow matrix "
+                         "included) + the chaos_report recovery gate")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -116,6 +188,8 @@ def main() -> int:
         return tier1()
     if args.schedules:
         return schedules_smoke()
+    if args.chaos:
+        return chaos()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -4,6 +4,8 @@
 - aux.metrics: counters/gauges/timers registry, compile-vs-execute
   split, cost_analysis FLOP attribution, JSONL export
   (SLATE_TPU_METRICS=/path/out.jsonl).
+- aux.faults: deterministic seedable fault injection over named sites
+  in the serve/driver dispatch path (SLATE_TPU_FAULTS spec).
 """
 
-from . import metrics, trace  # noqa: F401
+from . import faults, metrics, trace  # noqa: F401

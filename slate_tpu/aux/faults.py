@@ -1,0 +1,313 @@
+"""Deterministic, seedable fault injection (the chaos layer).
+
+The serving path has retries, deadlines, fallbacks, a supervisor, and a
+circuit breaker — none of which mean anything until they are exercised
+under induced failure.  This module is the induction coil: named fault
+*sites* are threaded through serve/ and the driver dispatch path, and a
+site that is *armed* fires per its trigger, deterministically under a
+seed, so a chaos test can replay the exact failure pattern.
+
+Sites (:data:`SITES`) and where they are checked:
+
+    ``compile``        executable build fails
+                       (``serve.cache.ExecutableCache.executable``)
+    ``execute``        dispatch raises (``cache.run`` / ``direct_call``)
+    ``result_corrupt`` NaN poisoned into the first batch item's output
+                       (``cache.run``)
+    ``latency``        injected sleep before dispatch, ``ms=`` spec key
+                       (``cache.run`` / ``direct_call``)
+    ``worker_death``   the service worker thread dies mid-loop with a
+                       batch in flight (``service.SolverService._loop``)
+    ``info_nonzero``   the first batch item's ``info`` forced nonzero,
+                       ``info=`` spec key (``cache.run``)
+
+Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
+site, so the fire pattern is a pure function of ``seed`` and the call
+sequence), every-Nth call ``every=3``, or ``once`` (fires on the
+``after=N``-th call, default the first, then never again).
+
+Activation mirrors ``aux/metrics``: one module-level bool gates every
+entry point, so with faults off each site costs a single bool check and
+nothing else — production dispatch is untouched (**zero overhead when
+disabled**).
+
+::
+
+    SLATE_TPU_FAULTS="execute:p=0.2,seed=7;worker_death:every=9" python app.py
+
+or programmatically::
+
+    from slate_tpu.aux import faults
+    faults.arm("execute", p=0.2, seed=7)
+    faults.on()
+    ...
+    faults.reset()
+
+Spec grammar (``SLATE_TPU_FAULTS`` / :func:`configure`)::
+
+    spec      := site_spec (';' site_spec)*
+    site_spec := site ':' item (',' item)*
+    item      := 'p=<float>' | 'every=<int>' | 'once'
+               | 'after=<int>' | 'seed=<int>' | 'ms=<float>'
+               | 'info=<int>'
+
+Every injection increments ``faults.injected.<site>`` in the metrics
+registry and the site's local stats (:func:`stats`), so
+``tools/chaos_report.py`` can join injected-vs-recovered counts from a
+single metrics JSONL.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..exceptions import SlateError
+from . import metrics
+
+SITES = (
+    "compile",
+    "execute",
+    "result_corrupt",
+    "latency",
+    "worker_death",
+    "info_nonzero",
+)
+
+
+class FaultInjected(SlateError):
+    """An armed fault site fired (raised only under chaos testing —
+    carries the site name so recovery paths and reports can attribute
+    the failure)."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+@dataclass
+class _Site:
+    """One armed site: trigger config + live counters."""
+
+    name: str
+    p: float = 0.0
+    every: int = 0
+    once: bool = False
+    after: int = 1
+    seed: int = 0
+    ms: float = 1.0  # latency-site sleep duration
+    info: int = 1  # info_nonzero-site injected value
+    calls: int = 0
+    fired: int = 0
+    rng: random.Random = field(default_factory=random.Random)
+
+
+_enabled = False
+_lock = threading.RLock()
+_sites: Dict[str, _Site] = {}
+
+
+# ---------------------------------------------------------------------------
+# control
+# ---------------------------------------------------------------------------
+
+
+def on() -> None:
+    """Enable injection (one bool flips; armed sites start evaluating)."""
+    global _enabled
+    _enabled = True
+
+
+def off() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_on() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Disable and disarm everything (test teardown)."""
+    global _enabled
+    with _lock:
+        _enabled = False
+        _sites.clear()
+
+
+def arm(
+    site: str,
+    p: float = 0.0,
+    every: int = 0,
+    once: bool = False,
+    after: int = 1,
+    seed: int = 0,
+    ms: float = 1.0,
+    info: int = 1,
+) -> None:
+    """Arm one site with exactly one trigger (p / every / once).  Does
+    NOT enable injection — call :func:`on` (or let the env spec do it)."""
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}; sites: {SITES}")
+    triggers = sum((p > 0, every > 0, bool(once)))
+    if triggers != 1:
+        raise ValueError(
+            f"{site}: exactly one trigger of p=/every=/once required"
+        )
+    s = _Site(
+        name=site, p=float(p), every=int(every), once=bool(once),
+        after=int(after), seed=int(seed), ms=float(ms), info=int(info),
+    )
+    # per-site stream: the same seed arms several sites independently
+    s.rng = random.Random(f"{s.seed}:{site}")
+    with _lock:
+        _sites[site] = s
+
+
+def disarm(site: str) -> None:
+    with _lock:
+        _sites.pop(site, None)
+
+
+def configure(spec: str) -> None:
+    """Parse the SLATE_TPU_FAULTS grammar and arm each site_spec."""
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, sep, items = part.partition(":")
+        if not sep:
+            raise ValueError(f"fault spec {part!r}: expected 'site:trigger'")
+        kw: dict = {}
+        for item in items.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if item == "once":
+                kw["once"] = True
+                continue
+            k, sep, v = item.partition("=")
+            k, v = k.strip(), v.strip()
+            if not sep:
+                raise ValueError(f"fault spec item {item!r} in {part!r}")
+            if k in ("p", "ms"):
+                kw[k] = float(v)
+            elif k in ("every", "after", "seed", "info"):
+                kw[k] = int(v)
+            else:
+                raise ValueError(
+                    f"unknown fault spec key {k!r} in {part!r}"
+                )
+        arm(site.strip(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# firing
+# ---------------------------------------------------------------------------
+
+
+def fire(site: str) -> Optional[_Site]:
+    """Evaluate one site's trigger: returns the site record when it
+    fires, None otherwise.  The per-site call counter advances on every
+    evaluation, so p-mode patterns are a deterministic function of the
+    seed and the call sequence."""
+    if not _enabled:
+        return None
+    s = _sites.get(site)
+    if s is None:
+        return None
+    with _lock:
+        s.calls += 1
+        if s.once:
+            hit = s.calls >= s.after and s.fired == 0
+        elif s.every > 0:
+            hit = s.calls % s.every == 0
+        else:
+            hit = s.rng.random() < s.p
+        if hit:
+            s.fired += 1
+    if hit:
+        metrics.inc(f"faults.injected.{site}")
+        return s
+    return None
+
+
+def check(site: str) -> None:
+    """Raise :class:`FaultInjected` when the site fires (the compile /
+    execute / worker_death call-site form)."""
+    if not _enabled:
+        return
+    s = fire(site)
+    if s is not None:
+        raise FaultInjected(
+            f"injected {site} fault (#{s.fired})", site=site
+        )
+
+
+def sleep(site: str = "latency") -> float:
+    """Sleep ``ms`` milliseconds when the site fires; returns the
+    seconds actually slept."""
+    if not _enabled:
+        return 0.0
+    s = fire(site)
+    if s is None:
+        return 0.0
+    time.sleep(s.ms / 1e3)
+    return s.ms / 1e3
+
+
+def corrupt(site: str, arr: np.ndarray) -> np.ndarray:
+    """Return ``arr`` with its first element NaN-poisoned when the site
+    fires (result_corrupt: for a batched (b, m, k) output this lands in
+    item 0), unchanged otherwise."""
+    if not _enabled:
+        return arr
+    if fire(site) is None:
+        return arr
+    out = np.array(arr)  # fresh writable copy — device views are read-only
+    out.reshape(-1)[0] = np.nan
+    return out
+
+
+def poison_info(site: str, info: np.ndarray) -> np.ndarray:
+    """Force the first entry of an ``info`` vector to the site's
+    ``info=`` value when it fires (info_nonzero: poisons exactly batch
+    item 0), unchanged otherwise."""
+    if not _enabled:
+        return info
+    s = fire(site)
+    if s is None:
+        return info
+    out = np.array(info)
+    out.reshape(-1)[0] = s.info
+    return out
+
+
+def stats() -> Dict[str, dict]:
+    """Per-site {calls, fired} counters for every armed site."""
+    with _lock:
+        return {
+            k: {"calls": v.calls, "fired": v.fired}
+            for k, v in _sites.items()
+        }
+
+
+# ---------------------------------------------------------------------------
+# env activation: SLATE_TPU_FAULTS="site:trigger[,k=v]*;..."
+# ---------------------------------------------------------------------------
+
+_env_spec = os.environ.get("SLATE_TPU_FAULTS")
+if _env_spec:
+    # fail loud but name the knob: silently disarming a chaos spec the
+    # operator believes is active would be worse than refusing to start
+    try:
+        configure(_env_spec)
+    except (ValueError, TypeError) as e:
+        raise ValueError(f"SLATE_TPU_FAULTS={_env_spec!r}: {e}") from e
+    on()

@@ -41,6 +41,14 @@ JSONL schema (one object per line): ``{"type": "meta"|"event"|
 ``kind`` ("phase"|"compile"|"run"), ``t_start`` (seconds since the
 metrics epoch), ``dur_s``, ``thread``, and the active :func:`context`
 label.  Counters/gauges/timers are the end-of-run summaries.
+
+The containment layers report through this registry too: serve/ emits
+``serve.worker_restarts``, ``serve.breaker_open/half_open/closed``,
+``serve.retries`` + the ``serve.retry_backoff_s`` timer,
+``serve.invalid_input``, and the ``serve.deadline_miss_queued/_late``
+split; ``aux/faults`` counts every injection as
+``faults.injected.<site>`` — ``tools/chaos_report.py`` joins the
+injected-vs-recovered pair from one JSONL.
 """
 
 from __future__ import annotations

@@ -89,7 +89,12 @@ def hetrf(
       (L._aasen) and hetrs consumes them transparently.
     * "aasen" — Aasen directly (the reference's method).
     * "rbt"   — pivot-free with the random-butterfly breakdown fallback
-      of earlier rounds (L._rbt)."""
+      of earlier rounds (L._rbt).
+
+    Inside jit there is no host info value to branch on, so the
+    breakdown refactors cannot engage: traced calls return the
+    no-pivot factor with the lazy info array (nonzero = breakdown),
+    matching the other drivers' info contract."""
     slate_assert(A.m == A.n, "hetrf requires square")
     Af = A.full_global()
     lay = A.layout
@@ -107,18 +112,18 @@ def hetrf(
     if method == "aasen":
         return _aasen_factor()
     L, d, info = _ldl_nopiv(Af, lay.mb, A.grid, opts)
-    try:
-        broke = bool(info != 0)
-    except Exception:
-        # Traced (inside jit): the breakdown branch cannot be taken, and
-        # the butterfly fallback marker would be stripped at the pytree
-        # boundary, silently mis-pairing hetrs with the wrong factor.
-        raise TypeError(
-            "hetrf breakdown detection needs a concrete info value; call "
-            "hetrf/hesv outside jit (the reference's hetrf is likewise a "
-            "host-driven algorithm)"
-        ) from None
-    if not broke:
+    import jax
+
+    if isinstance(info, jax.core.Tracer):
+        # Traced (inside jit): the lazy-info contract of the other
+        # drivers (potrf/getrf) applies — return the no-pivot factor
+        # and the info ARRAY as-is; a nonzero info flags the breakdown
+        # to the caller, and NumericalError is raised only where a host
+        # value is demanded (serve's direct_call, compat int(info)).
+        # The Aasen/butterfly breakdown refactors are host-driven
+        # algorithms (as in the reference) and engage on eager calls.
+        return L, d, info
+    if int(info) == 0:
         return L, d, info
     if method == "auto":
         # breakdown: the reference's pivoted-stability algorithm

@@ -316,6 +316,10 @@ class Option(enum.Enum):
     ServeQueueLimit = "serve_queue_limit"  # admission bound (-> Rejected)
     ServeBatchMax = "serve_batch_max"  # coalesced batch point per bucket
     ServeBatchWindow = "serve_batch_window"  # coalescing linger, seconds
+    ServeRetryBackoff = "serve_retry_backoff"  # backoff base, seconds
+    ServeBreakerCooldown = "serve_breaker_cooldown"  # open -> half-open, s
+    ServeValidate = "serve_validate"  # admission finiteness checks
+    Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 
 # Marker constants kept for API parity (reference: enums.hh:531-534).

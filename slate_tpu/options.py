@@ -44,6 +44,13 @@ _DEFAULTS = {
     Option.ServeQueueLimit: 128,
     Option.ServeBatchMax: 8,
     Option.ServeBatchWindow: 0.002,
+    # decorrelated-jitter base: first retry waits ~this, later ones up
+    # to 3x the previous (service.decorrelated_backoff)
+    Option.ServeRetryBackoff: 0.01,
+    # how long an open bucket breaker waits before a half-open probe
+    Option.ServeBreakerCooldown: 5.0,
+    Option.ServeValidate: True,
+    Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 
 

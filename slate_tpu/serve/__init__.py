@@ -17,12 +17,14 @@ import importlib
 
 _API = (
     "gesv", "posv", "gels", "submit", "warmup", "configure", "shutdown",
-    "get_service", "get_cache",
+    "get_service", "get_cache", "health", "InvalidInput",
 )
-_SERVICE = ("SolverService", "Rejected", "DeadlineExceeded")
+_SERVICE = (
+    "SolverService", "Rejected", "DeadlineExceeded", "decorrelated_backoff",
+)
 _CACHE = ("ExecutableCache", "direct_call", "WARMUP_ENV")
 _BUCKETS = (
-    "BucketKey", "bucket_for", "bucket_dim", "halving_bucket",
+    "BucketKey", "Breaker", "bucket_for", "bucket_dim", "halving_bucket",
     "size_bucket_runs", "batch_bucket",
 )
 

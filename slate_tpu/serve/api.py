@@ -20,11 +20,16 @@ Semantics:
   driver (minimum-norm path is not vmap-batched).
 * A nonzero driver ``info`` raises NumericalError from ``.result()`` /
   the sync wrapper; deadline misses raise DeadlineExceeded; a full
-  queue raises Rejected from ``submit`` itself.
-* Graceful degradation: when a bucket's batched executable keeps
-  failing, its requests transparently fall back to the direct driver
-  (counted in ``serve.fallbacks``; the bucket is marked degraded after
-  ``degrade_after`` consecutive failures and stops being batched).
+  queue raises Rejected and non-finite operands raise InvalidInput
+  from ``submit`` itself (admission checks; every error carries
+  structured ``routine``/``bucket``/``attempt`` context).
+* Self-healing: executable failures retry with decorrelated-jitter
+  backoff, then fall back to the direct driver (``serve.fallbacks``);
+  a bucket failing ``degrade_after`` times in a row opens its circuit
+  breaker (routed direct), half-opens after a cooldown, and one
+  healthy probe restores the batched path.  A dead worker thread is
+  respawned with its in-flight futures re-enqueued or failed fast —
+  no future ever hangs.  ``serve.health()`` snapshots all of it.
 
 The default service reads :class:`~slate_tpu.enums.Option` defaults
 (``ServeQueueLimit``, ``ServeBatchMax``, ``ServeBatchWindow``) through
@@ -40,6 +45,7 @@ from typing import Optional
 import numpy as np
 
 from ..enums import Option
+from ..exceptions import InvalidInput  # noqa: F401  (re-export: taxonomy)
 from ..options import Options, get_option
 from .cache import ExecutableCache
 from .service import DeadlineExceeded, Rejected, SolverService  # noqa: F401
@@ -62,7 +68,13 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         max_queue=int(get_option(opts, Option.ServeQueueLimit)),
         batch_max=int(get_option(opts, Option.ServeBatchMax)),
         batch_window_s=float(get_option(opts, Option.ServeBatchWindow)),
+        retry_backoff_s=float(get_option(opts, Option.ServeRetryBackoff)),
+        breaker_cooldown_s=float(
+            get_option(opts, Option.ServeBreakerCooldown)
+        ),
+        validate=bool(get_option(opts, Option.ServeValidate)),
         schedule=get_option(opts, Option.Schedule),
+        faults_spec=str(get_option(opts, Option.Faults) or ""),
     )
     cfg.update(kw)
     return SolverService(**cfg)
@@ -135,6 +147,14 @@ def posv(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray
 def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
     """Least-squares solve min ||A X - B|| (m >= n batched; m < n direct)."""
     return _sync("gels", A, B, deadline, retries)
+
+
+def health() -> dict:
+    """Liveness/readiness snapshot of the process service for external
+    probes: queue depth, worker liveness + restarts, per-bucket circuit
+    breaker states, recent failure rate (see
+    :meth:`SolverService.health`)."""
+    return get_service().health()
 
 
 def get_cache() -> ExecutableCache:

@@ -139,6 +139,64 @@ class BucketKey:
         )
 
 
+# ---------------------------------------------------------------------------
+# circuit breaker (per-BucketKey batched-path state; service.py drives it)
+# ---------------------------------------------------------------------------
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+
+@dataclass
+class Breaker:
+    """Circuit-breaker state for one bucket's batched path.
+
+    Lifecycle (SolverService drives the transitions, keyed by
+    BucketKey):  ``closed`` --degrade_after consecutive failures-->
+    ``open`` (requests route to the direct driver) --cooldown
+    elapsed--> ``half_open`` (the next batch is a probe through the
+    batched path) --probe success--> ``closed`` / --probe failure-->
+    ``open`` with a fresh cooldown.  Unlike the permanent degradation
+    it replaces, an open breaker is a *recoverable* state: one healthy
+    probe restores batching.
+    """
+
+    state: str = BREAKER_CLOSED
+    streak: int = 0  # consecutive batched-path failures
+    opened_at: float = 0.0  # monotonic time of the last open transition
+    opens: int = 0  # lifetime open transitions (health reporting)
+
+    def record_failure(self, now: float, degrade_after: int) -> bool:
+        """One batched-path failure; returns True when this failure
+        opens the breaker (half-open probes reopen immediately)."""
+        self.streak += 1
+        if self.state == BREAKER_HALF_OPEN or (
+            self.state == BREAKER_CLOSED and self.streak >= degrade_after
+        ):
+            self.state = BREAKER_OPEN
+            self.opened_at = now
+            self.opens += 1
+            return True
+        return False
+
+    def record_success(self) -> bool:
+        """One batched-path success; returns True when it closed a
+        half-open breaker (the recovery transition)."""
+        was_probe = self.state == BREAKER_HALF_OPEN
+        self.state = BREAKER_CLOSED
+        self.streak = 0
+        return was_probe
+
+    def try_half_open(self, now: float, cooldown_s: float) -> bool:
+        """Move an open breaker whose cooldown has elapsed to
+        half-open; returns True on that transition."""
+        if self.state == BREAKER_OPEN and now - self.opened_at >= cooldown_s:
+            self.state = BREAKER_HALF_OPEN
+            return True
+        return False
+
+
 def _serve_nb(S: int) -> int:
     """Tile size for a serving executable: one MXU-friendly tile up to
     64, then the drivers' blocked paths take over."""

@@ -27,7 +27,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..aux import metrics
+from ..aux import faults, metrics
 from ..exceptions import NumericalError
 from .buckets import BucketKey, manifest_dumps, manifest_loads
 
@@ -94,6 +94,8 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
     from ..enums import Uplo
     from ..matrix.matrix import HermitianMatrix, Matrix
 
+    faults.sleep("latency")
+    faults.check("execute")
     nb = min(64, A.shape[1])
     if routine == "gesv":
         Bm = Matrix.from_global(B, nb)
@@ -205,6 +207,7 @@ class ExecutableCache:
             exe = self._exes.get((key, batch))
             if exe is not None:
                 return exe
+        faults.check("compile")  # cold builds only: a cache hit never fires
         import jax
 
         core = _build_core(key)
@@ -227,12 +230,23 @@ class ExecutableCache:
         return exe
 
     def run(self, key: BucketKey, A_batch: np.ndarray, B_batch: np.ndarray):
-        """Execute one padded batch; returns host (X_batch, info_batch)."""
+        """Execute one padded batch; returns host (X_batch, info_batch).
+
+        Fault sites (aux/faults; every check is one bool when off):
+        ``latency`` sleeps before dispatch, ``execute`` raises in place
+        of the dispatch, ``result_corrupt`` NaN-poisons item 0 of X,
+        ``info_nonzero`` forces item 0's info nonzero."""
         import jax.numpy as jnp
 
+        faults.sleep("latency")
+        faults.check("execute")
         exe = self.executable(key, A_batch.shape[0])
         X, info = exe(jnp.asarray(A_batch), jnp.asarray(B_batch))
-        return np.asarray(X), np.atleast_1d(np.asarray(info))
+        X = faults.corrupt("result_corrupt", np.asarray(X))
+        info = faults.poison_info(
+            "info_nonzero", np.atleast_1d(np.asarray(info))
+        )
+        return np.asarray(X), info
 
     # -- warmup ------------------------------------------------------------
 

@@ -1,44 +1,74 @@
-"""SolverService: bounded request queue, worker thread, same-bucket
-batch coalescing, deadlines, retries, and graceful degradation.
+"""SolverService: bounded request queue, supervised worker thread,
+same-bucket batch coalescing, deadlines, retries with backoff, and
+circuit-breaker recovery.
 
-Execution model (one worker, deliberately simple — the architectural
-seam later scaling PRs widen into multi-host dispatch / priority
-tiers / admission control):
+Execution model (one supervised worker — the architectural seam later
+scaling PRs widen into multi-host dispatch / priority tiers):
 
-* ``submit()`` buckets the request (`buckets.bucket_for`), pads nothing
-  yet, and enqueues.  A full queue rejects IMMEDIATELY with
-  :class:`Rejected` — backpressure belongs at admission, not at a
-  timeout deep in the pipeline.
-* The worker pops the oldest request, waits up to ``batch_window_s``
-  for company, then extracts every queued request with the SAME
-  BucketKey (up to ``batch_max``) into one coalesced batch.  Batches
-  are padded to the fixed ``batch_max`` point (`buckets.batch_bucket`)
-  by repeating the first request, so only two executables exist per
-  bucket and warmed steady state never compiles.
+* ``submit()`` validates (non-finite A/B -> immediate
+  :class:`~slate_tpu.exceptions.InvalidInput`, before any queue or
+  compile cost is paid; ``validate=False`` opts out), buckets the
+  request (`buckets.bucket_for`), and enqueues.  A full queue rejects
+  IMMEDIATELY with :class:`Rejected` — backpressure belongs at
+  admission, not at a timeout deep in the pipeline.
+* The worker pops the oldest *eligible* request (one whose retry
+  backoff has elapsed), waits up to ``batch_window_s`` for company,
+  then coalesces every queued request with the same BucketKey (up to
+  ``batch_max``) into one batch padded to the fixed batch point
+  (`buckets.batch_bucket`), so only two executables exist per bucket
+  and warmed steady state never compiles.
+* **Supervision**: the worker runs under a guard that catches ANY
+  death (including the ``worker_death`` fault site), re-enqueues
+  in-flight requests that still have retry budget, fails the rest fast
+  with a typed error, respawns the worker, and counts
+  ``serve.worker_restarts`` — no future ever hangs.
 * Deadlines: a request whose deadline passes while still QUEUED is
-  cancelled with :class:`DeadlineExceeded` (counted in
-  ``serve.deadline_miss``) — it never starts.  A request that finishes
-  past its deadline still delivers its result (XLA dispatches cannot be
-  cancelled mid-flight) but also counts a miss.
+  cancelled with :class:`DeadlineExceeded`
+  (``serve.deadline_miss_queued``) — it never starts.  A request that
+  finishes past its deadline still delivers its result (XLA dispatches
+  cannot be cancelled mid-flight) but counts
+  ``serve.deadline_miss_late``.  ``serve.deadline_miss`` stays the
+  total of both.
 * Failures: an executable exception re-enqueues the batch's requests
-  while they have ``retries`` left; after that each request falls back
-  to the direct driver (``serve.fallbacks``).  A bucket whose batched
-  path fails ``degrade_after`` consecutive times is degraded — routed
-  straight to the direct driver from then on (the api.py graceful-
-  degradation contract).  A nonzero per-item ``info`` raises
+  while they have ``retries`` left, each delayed by exponential
+  backoff with decorrelated jitter (:func:`decorrelated_backoff`,
+  seeded — never the old immediate re-enqueue); after the budget each
+  request falls back to the direct driver (``serve.fallbacks``).
+* **Circuit breaker** (`buckets.Breaker`, keyed by BucketKey): a
+  bucket whose batched path fails ``degrade_after`` consecutive times
+  opens its breaker — requests route direct — but after
+  ``breaker_cooldown_s`` the breaker half-opens and the next batch
+  probes the batched path; one healthy probe closes it again.
+  Degradation is a recoverable state, not a one-way door.
+* A nonzero per-item ``info`` raises
   :class:`~slate_tpu.exceptions.NumericalError` on that item's future
-  only (no retry: the failure is deterministic).
+  only (no retry: the failure is deterministic); a non-finite solution
+  for finite inputs (the ``result_corrupt`` fault site) re-solves that
+  item on the direct driver instead of delivering garbage.
+* :meth:`SolverService.health` returns a liveness/readiness snapshot
+  (queue depth, worker liveness + restarts, per-bucket breaker states,
+  recent failure rate) for external probes.
+
+Every exception set on a future carries structured context
+(``routine``/``bucket``/``attempt``, :meth:`SlateError.with_context`).
 
 Metrics: ``serve.queue_depth`` gauge, ``serve.requests``,
-``serve.batched`` (coalesced batches), ``serve.batched_requests``,
-``serve.batch_pad`` (repeat-padding), ``serve.bucket_pad_waste``
-(elements), ``serve.deadline_miss``, ``serve.rejected``,
-``serve.fallbacks``, ``serve.degraded``; per-bucket compile/run split
-via the cache's instrumented executables.
+``serve.batched``, ``serve.batched_requests``, ``serve.batch_pad``,
+``serve.bucket_pad_waste``, ``serve.deadline_miss`` (+ ``_queued`` /
+``_late`` split), ``serve.rejected``, ``serve.invalid_input``,
+``serve.retries`` + ``serve.retry_backoff_s`` timer,
+``serve.fallbacks``, ``serve.worker_restarts``,
+``serve.breaker_open`` / ``half_open`` / ``closed`` (and the legacy
+``serve.degraded`` alias for open transitions),
+``serve.numerical_errors``, ``serve.corrupt_result``; per-bucket
+compile/run split via the cache's instrumented executables;
+``faults.injected.<site>`` from aux/faults when chaos is on.
 """
 
 from __future__ import annotations
 
+import functools
+import random
 import threading
 import time
 from collections import deque
@@ -48,8 +78,8 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from ..aux import metrics
-from ..exceptions import NumericalError, SlateError
+from ..aux import faults, metrics
+from ..exceptions import InvalidInput, NumericalError, SlateError
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
 
@@ -60,6 +90,23 @@ class Rejected(SlateError):
 
 class DeadlineExceeded(SlateError):
     """The request's deadline passed before execution started."""
+
+
+#: ceiling for one decorrelated-jitter backoff step, seconds
+BACKOFF_CAP_S = 2.0
+
+
+def decorrelated_backoff(
+    rng: random.Random, prev_s: float, base_s: float,
+    cap_s: float = BACKOFF_CAP_S,
+) -> float:
+    """One step of exponential backoff with decorrelated jitter
+    (Brooker, AWS Architecture Blog 2015): ``sleep_{k+1} = min(cap,
+    U(base, 3 * sleep_k))`` with ``sleep_0 = base``.  Pure in ``rng``,
+    so a seeded RNG replays the exact delay sequence — the chaos tests
+    assert determinism through this function."""
+    hi = max(base_s, 3.0 * prev_s)
+    return min(cap_s, rng.uniform(base_s, hi))
 
 
 @dataclass
@@ -74,6 +121,9 @@ class _Request:
     future: Future = field(default_factory=Future)
     deadline: Optional[float] = None  # absolute time.monotonic()
     retries: int = 0
+    attempt: int = 0  # batched attempts so far (error context)
+    backoff_s: float = 0.0  # last backoff delay (decorrelated jitter state)
+    not_before: float = 0.0  # monotonic eligibility time after a retry
     t_submit: float = field(default_factory=time.monotonic)
 
     def expired(self, now: Optional[float] = None) -> bool:
@@ -97,11 +147,24 @@ class SolverService:
         arrivals after popping a lone request.
     dim_floor / nrhs_floor: bucket lattice floors (buckets.py).
     degrade_after: consecutive batched-path failures of one bucket
-        before it is permanently routed to the direct driver.
+        before its breaker opens (requests route direct until the
+        cooldown elapses and a half-open probe succeeds).
+    breaker_cooldown_s: open -> half-open delay
+        (Option.ServeBreakerCooldown when None).
+    retry_backoff_s: decorrelated-jitter base delay for batch retries
+        (Option.ServeRetryBackoff when None).
+    retry_backoff_cap_s: ceiling for one backoff step.
+    retry_seed: seeds the backoff jitter RNG (deterministic replay).
+    validate: admission-time finiteness checks on A/B
+        (Option.ServeValidate when None).
     schedule: factorization schedule the bucket executables trace their
         drivers with (Option.Schedule: "auto"|"flat"|"recursive") —
         part of the BucketKey, so manifests and warmup precompile the
         matching shapes; None reads the Option default.
+    faults_spec: aux/faults grammar string; arms + enables injection
+        (Option.Faults when None; empty = no injection).  Injection is
+        process-global — the arming service owns it and disarms on
+        :meth:`stop`.
     start: set False to build paused (tests; call :meth:`start`).
     """
 
@@ -114,7 +177,13 @@ class SolverService:
         dim_floor: int = _bk.DIM_FLOOR,
         nrhs_floor: int = _bk.NRHS_FLOOR,
         degrade_after: int = 2,
+        breaker_cooldown_s: Optional[float] = None,
+        retry_backoff_s: Optional[float] = None,
+        retry_backoff_cap_s: float = BACKOFF_CAP_S,
+        retry_seed: int = 0,
+        validate: Optional[bool] = None,
         schedule: Optional[str] = None,
+        faults_spec: Optional[str] = None,
         start: bool = True,
     ):
         # None -> the Serve* Option defaults (one source of truth with
@@ -138,19 +207,45 @@ class SolverService:
         self.dim_floor = int(dim_floor)
         self.nrhs_floor = int(nrhs_floor)
         self.degrade_after = int(degrade_after)
+        self.breaker_cooldown_s = float(
+            breaker_cooldown_s if breaker_cooldown_s is not None
+            else get_option(None, Option.ServeBreakerCooldown)
+        )
+        self.retry_backoff_s = float(
+            retry_backoff_s if retry_backoff_s is not None
+            else get_option(None, Option.ServeRetryBackoff)
+        )
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
+        self.validate = bool(
+            validate if validate is not None
+            else get_option(None, Option.ServeValidate)
+        )
         if schedule is None:
             schedule = get_option(None, Option.Schedule, Schedule.Auto)
         self.schedule = (
             schedule.value if isinstance(schedule, Schedule)
             else Schedule.from_string(str(schedule)).value
         )
+        if faults_spec is None:
+            faults_spec = get_option(None, Option.Faults) or ""
+        # injection state is process-global (like metrics); a service
+        # that armed it owns it and disarms on stop(), so a discarded
+        # chaos service cannot keep poisoning later services
+        self._owns_faults = bool(faults_spec)
+        if faults_spec:
+            faults.configure(faults_spec)
+            faults.on()
+        self._rng = random.Random(retry_seed)
         self._q: Deque[_Request] = deque()
         self._cond = threading.Condition()
         self._running = False
         self._stopped = False  # stop() called; submit() rejects until start()
         self._thread: Optional[threading.Thread] = None
-        self._fail_streak: Dict[_bk.BucketKey, int] = {}
-        self._degraded: set = set()
+        self._breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}
+        self._inflight: List[_Request] = []
+        self._restarts = 0
+        self._recent_fail: Deque[float] = deque(maxlen=256)
+        self._t_started = time.monotonic()
         if start:
             self.start()
 
@@ -162,11 +257,16 @@ class SolverService:
                 return self
             self._running = True
             self._stopped = False
-        self._thread = threading.Thread(
-            target=self._loop, name="slate-serve-worker", daemon=True
-        )
-        self._thread.start()
+        self._spawn_worker()
         return self
+
+    def _spawn_worker(self) -> None:
+        t = threading.Thread(
+            target=self._run_worker, name="slate-serve-worker", daemon=True
+        )
+        with self._cond:
+            self._thread = t
+        t.start()
 
     def stop(self, timeout: float = 10.0) -> None:
         """Stop the worker; unstarted/leftover requests resolve with
@@ -177,11 +277,17 @@ class SolverService:
             leftovers = list(self._q)
             self._q.clear()
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+            with self._cond:
+                if self._thread is t:
+                    self._thread = None
         for r in leftovers:
-            _resolve_exc(r.future, Rejected("service stopped"))
+            _resolve_exc(r.future, Rejected("service stopped"), req=r)
+        if self._owns_faults:
+            faults.reset()
+            self._owns_faults = False
         metrics.gauge("serve.queue_depth", 0)
 
     def __enter__(self) -> "SolverService":
@@ -205,8 +311,10 @@ class SolverService:
         solution X (n x nrhs ndarray).
 
         ``deadline`` is seconds from now; ``retries`` re-runs the
-        batched path on executable failure before falling back.
-        Raises :class:`Rejected` when the queue is full."""
+        batched path (with backoff) on executable failure before
+        falling back.  Raises :class:`Rejected` when the queue is full
+        and :class:`InvalidInput` on non-finite operands (before any
+        queue/compile cost; disable with ``validate=False``)."""
         A = np.asarray(A)
         B = np.asarray(B)
         if B.ndim == 1:
@@ -215,6 +323,17 @@ class SolverService:
             raise ValueError(
                 f"{routine}: bad shapes A{A.shape} B{B.shape}"
             )
+        if self.validate:
+            bad = (
+                "A" if not np.all(np.isfinite(A))
+                else "B" if not np.all(np.isfinite(B))
+                else None
+            )
+            if bad is not None:
+                metrics.inc("serve.invalid_input")
+                raise InvalidInput(
+                    f"{routine}: non-finite entries in {bad}"
+                ).with_context(routine=routine)
         m, n = A.shape
         nrhs = B.shape[1]
         key: Optional[_bk.BucketKey] = None
@@ -237,12 +356,14 @@ class SolverService:
                 # future (a paused-but-never-started one does: start());
                 # admitting here would hang the sync wrappers
                 metrics.inc("serve.rejected")
-                raise Rejected("service stopped; configure() a new one")
+                raise Rejected(
+                    "service stopped; configure() a new one"
+                ).with_context(routine=routine)
             if len(self._q) >= self.max_queue:
                 metrics.inc("serve.rejected")
                 raise Rejected(
                     f"queue full ({self.max_queue}); retry with backoff"
-                )
+                ).with_context(routine=routine)
             self._q.append(req)
             depth = len(self._q)
             self._cond.notify_all()
@@ -254,6 +375,79 @@ class SolverService:
         with self._cond:
             return len(self._q)
 
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> dict:
+        """Liveness/readiness snapshot for external probes: queue
+        depth vs limit, worker liveness + lifetime restarts, per-bucket
+        breaker states, and the recent failure rate (last 60 s over a
+        bounded window).  Cheap enough to poll."""
+        now = time.monotonic()
+        window_s = 60.0
+        with self._cond:
+            depth = len(self._q)
+            alive = bool(self._thread is not None and self._thread.is_alive())
+            running = self._running
+            restarts = self._restarts
+            inflight = len(self._inflight)
+            breakers = {k.label: b.state for k, b in self._breakers.items()}
+            recent = [t for t in self._recent_fail if now - t <= window_s]
+        return {
+            "ok": running and alive,
+            "running": running,
+            "worker_alive": alive,
+            "worker_restarts": restarts,
+            "queue_depth": depth,
+            "queue_limit": self.max_queue,
+            "inflight": inflight,
+            "breakers": breakers,
+            "open_buckets": sorted(
+                lbl for lbl, s in breakers.items() if s == _bk.BREAKER_OPEN
+            ),
+            "failures_60s": len(recent),
+            "failure_rate_60s": len(recent) / window_s,
+            "uptime_s": now - self._t_started,
+        }
+
+    def _note_failure(self) -> None:
+        with self._cond:
+            self._recent_fail.append(time.monotonic())
+
+    # -- supervision -------------------------------------------------------
+
+    def _run_worker(self) -> None:
+        try:
+            self._loop()
+        except BaseException as e:  # noqa: BLE001 — supervise ANY death
+            self._supervise(e)
+
+    def _supervise(self, exc: BaseException) -> None:
+        """Worker-death containment: re-enqueue in-flight requests that
+        still have retry budget (with backoff), fail the rest fast with
+        a typed error — no future ever hangs — and respawn the worker."""
+        metrics.inc("serve.worker_restarts")
+        with self._cond:
+            inflight, self._inflight = self._inflight, []
+            self._restarts += 1
+            respawn = self._running
+        self._note_failure()
+        for r in inflight:
+            if r.future.done():
+                continue  # _execute resolved it before the death
+            if respawn and r.retries > 0:
+                self._requeue_with_backoff(r)
+            else:
+                # no worker will ever pop a re-enqueued request once
+                # stop() has drained the queue — fail fast instead of
+                # stranding the future
+                _resolve_exc(
+                    r.future,
+                    SlateError(f"worker died mid-batch: {exc!r}"),
+                    req=r,
+                )
+        if respawn:
+            self._spawn_worker()
+
     # -- worker ------------------------------------------------------------
 
     def _loop(self) -> None:
@@ -261,41 +455,86 @@ class SolverService:
             batch = self._next_batch()
             if batch is None:
                 return
-            if batch:
-                self._execute(batch)
+            if not batch:
+                continue
+            with self._cond:
+                self._inflight = batch
+            faults.check("worker_death")  # in-flight: supervision must cover
+            self._execute(batch)
+            with self._cond:
+                self._inflight = []
+
+    def _pop_eligible_locked(self, now: float) -> Optional[_Request]:
+        """Oldest request whose retry backoff (not_before) has elapsed."""
+        for i, r in enumerate(self._q):
+            if r.not_before <= now:
+                del self._q[i]
+                return r
+        return None
 
     def _next_batch(self) -> Optional[List[_Request]]:
-        """Pop the oldest live request plus every same-key request (up
-        to batch_max).  None => stopped; [] => only expired requests
-        were popped this round."""
+        """Pop the oldest eligible request plus every same-key eligible
+        request (up to batch_max).  None => stopped; [] => only expired
+        requests were popped this round."""
+        expired: List[_Request] = []
         with self._cond:
-            while self._running and not self._q:
-                self._cond.wait(0.05)
+            first: Optional[_Request] = None
+            while self._running:
+                now = time.monotonic()
+                # deadline sweep over the whole queue before eligibility:
+                # a request that is backing off (not_before in the
+                # future) must still be queued-cancelled the moment its
+                # deadline passes, not after its backoff elapses
+                if self._q:
+                    live: Deque[_Request] = deque()
+                    for r in self._q:
+                        (expired if r.expired() else live).append(r)
+                    self._q = live
+                if expired:
+                    break  # cancel outside the lock, then come back
+                first = self._pop_eligible_locked(now)
+                if first is not None:
+                    break
+                if self._q:  # everything is backing off: sleep to the next
+                    wake = min(r.not_before for r in self._q) - now
+                    self._cond.wait(min(max(wake, 0.001), 0.05))
+                else:
+                    self._cond.wait(0.05)
             if not self._running:
                 # resolve anything the failure path re-enqueued after
                 # stop() drained the queue — futures must never strand
                 leftovers = list(self._q)
                 self._q.clear()
                 for r in leftovers:
-                    _resolve_exc(r.future, Rejected("service stopped"))
+                    _resolve_exc(
+                        r.future, Rejected("service stopped"), req=r
+                    )
                 return None
-            first = self._q.popleft()
             metrics.gauge("serve.queue_depth", len(self._q))
+        if expired:
+            for r in expired:
+                self._miss_queued(r)
+            return []
         if first.expired():
-            self._miss(first)
+            self._miss_queued(first)
             return []
         if first.key is None:
             return [first]
         if self.batch_max > 1 and self.batch_window_s > 0:
             with self._cond:
-                if not any(r.key == first.key for r in self._q):
+                now = time.monotonic()
+                if not any(
+                    r.key == first.key and r.not_before <= now
+                    for r in self._q
+                ):
                     self._cond.wait(self.batch_window_s)
         batch = [first]
         with self._cond:
             keep: Deque[_Request] = deque()
+            now = time.monotonic()
             while self._q and len(batch) < self.batch_max:
                 r = self._q.popleft()
-                if r.key == first.key:
+                if r.key == first.key and r.not_before <= now:
                     batch.append(r)
                 else:
                     keep.append(r)
@@ -305,50 +544,108 @@ class SolverService:
         live = []
         for r in batch:
             if r.expired():
-                self._miss(r)
+                self._miss_queued(r)
             else:
                 live.append(r)
         return live
 
-    def _miss(self, req: _Request) -> None:
+    def _miss_queued(self, req: _Request) -> None:
+        """Deadline passed while still queued: cancel, never start."""
         metrics.inc("serve.deadline_miss")
+        metrics.inc("serve.deadline_miss_queued")
         _resolve_exc(
             req.future,
             DeadlineExceeded(
                 f"{req.routine} {req.m}x{req.n}: deadline passed after "
                 f"{time.monotonic() - req.t_submit:.3f}s in queue"
             ),
+            req=req,
         )
+
+    def _miss_late(self) -> None:
+        """Finished past the deadline: result still delivered, counted."""
+        metrics.inc("serve.deadline_miss")
+        metrics.inc("serve.deadline_miss_late")
 
     # -- execution ---------------------------------------------------------
 
+    def _breaker(self, key: _bk.BucketKey) -> _bk.Breaker:
+        with self._cond:  # health() iterates _breakers under the lock
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = _bk.Breaker()
+        return br
+
     def _execute(self, batch: List[_Request]) -> None:
         key = batch[0].key
-        if key is None or key in self._degraded:
+        if key is None:
             for r in batch:
                 self._direct(r)
             return
+        br = self._breaker(key)
+        if br.state == _bk.BREAKER_OPEN:
+            if br.try_half_open(time.monotonic(), self.breaker_cooldown_s):
+                metrics.inc("serve.breaker_half_open")
+            else:
+                for r in batch:  # open: route direct until the cooldown
+                    self._direct(r)
+                return
         try:
-            self._execute_batched(key, batch)
-            self._fail_streak[key] = 0
+            for r in batch:
+                r.attempt += 1
+            deliver, corrupt = self._execute_batched(key, batch)
         except Exception as e:  # noqa: BLE001 — futures carry the error
+            self._note_failure()
+            if br.record_failure(time.monotonic(), self.degrade_after):
+                metrics.inc("serve.breaker_open")
+                metrics.inc("serve.degraded")  # legacy alias: open events
             retryable = [r for r in batch if r.retries > 0]
             rest = [r for r in batch if r.retries <= 0]
-            streak = self._fail_streak.get(key, 0) + 1
-            self._fail_streak[key] = streak
-            if streak >= self.degrade_after:
-                self._degraded.add(key)
-                metrics.inc("serve.degraded")
-            if retryable:
-                with self._cond:
-                    for r in reversed(retryable):
-                        r.retries -= 1
-                        self._q.appendleft(r)
-                    self._cond.notify_all()
+            for r in reversed(retryable):
+                self._requeue_with_backoff(r)
             for r in rest:
                 self._direct(r, batched_error=e)
+            return
+        if corrupt:
+            # delivered garbage is a batched-path failure even though
+            # nothing raised: a deterministically-corrupt executable
+            # must still open the breaker, and a half-open probe that
+            # returned non-finite X must re-open, not close
+            if br.record_failure(time.monotonic(), self.degrade_after):
+                metrics.inc("serve.breaker_open")
+                metrics.inc("serve.degraded")
+        elif br.record_success():
+            metrics.inc("serve.breaker_closed")  # half-open probe healed
+        # resolve futures only AFTER the breaker transition committed: a
+        # client that wakes from .result() must observe consistent
+        # breaker metrics / health() state
+        for fn in deliver:
+            fn()
 
-    def _execute_batched(self, key: _bk.BucketKey, batch: List[_Request]) -> None:
+    def _requeue_with_backoff(self, r: _Request) -> None:
+        """Retry with exponential backoff + decorrelated jitter instead
+        of an immediate re-enqueue (which would hammer a failing path
+        in a tight loop)."""
+        r.retries -= 1
+        r.backoff_s = decorrelated_backoff(
+            self._rng, r.backoff_s, self.retry_backoff_s,
+            self.retry_backoff_cap_s,
+        )
+        r.not_before = time.monotonic() + r.backoff_s
+        metrics.inc("serve.retries")
+        metrics.observe("serve.retry_backoff_s", r.backoff_s)
+        with self._cond:
+            self._q.appendleft(r)
+            self._cond.notify_all()
+
+    def _execute_batched(self, key: _bk.BucketKey, batch: List[_Request]):
+        """Run one padded batch; returns ``(deliver, corrupt)``: the
+        deferred per-item delivery thunks (resolutions happen in
+        _execute, after the breaker bookkeeping, so clients never
+        observe stale breaker state) and the count of corrupt-result
+        items (a garbage batch is a breaker failure, not a success —
+        nonzero ``info`` is NOT corruption: it is a numerical property
+        of the input, no fault of the batched path)."""
         self.cache.ensure_manifest(key, (1, self.batch_max))
         bb = _bk.batch_bucket(len(batch), self.batch_max)
         pads = [_bk.pad_request(key, r.A, r.B) for r in batch]
@@ -359,23 +656,43 @@ class SolverService:
         B_b = np.stack([p[1] for p in pads])
         X_b, info_b = self.cache.run(key, A_b, B_b)
         now = time.monotonic()
+        deliver = []
+        corrupt = 0
         for i, r in enumerate(batch):
             metrics.inc(
                 "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
             )
-            if r.deadline is not None and now > r.deadline:
-                metrics.inc("serve.deadline_miss")  # finished late; still delivered
+            late = r.deadline is not None and now > r.deadline
             info = int(info_b[i]) if i < len(info_b) else 0
             if info != 0:
-                _resolve_exc(
-                    r.future,
-                    NumericalError(f"{r.routine}: info={info}", info),
-                )
-            else:
-                _resolve(r.future, _bk.crop_result(key, X_b[i], r.n, r.nrhs))
+                if late:
+                    self._miss_late()
+                metrics.inc("serve.numerical_errors")
+                deliver.append(functools.partial(
+                    _resolve_exc, r.future,
+                    NumericalError(f"{r.routine}: info={info}", info), r,
+                ))
+                continue
+            X = _bk.crop_result(key, X_b[i], r.n, r.nrhs)
+            if self.validate and not np.all(np.isfinite(X)):
+                # admission validated the inputs finite, so a
+                # non-finite solution is a corrupted executable result
+                # (the result_corrupt fault site, a bad kernel, bit
+                # rot): re-solve this item on the direct driver rather
+                # than deliver garbage (_direct does its own late-miss
+                # accounting — counting here would double it)
+                metrics.inc("serve.corrupt_result")
+                self._note_failure()
+                corrupt += 1
+                deliver.append(functools.partial(self._direct, r))
+                continue
+            if late:
+                self._miss_late()  # finished late; still delivered
+            deliver.append(functools.partial(_resolve, r.future, X))
         if len(batch) > 1:
             metrics.inc("serve.batched")
             metrics.inc("serve.batched_requests", len(batch))
+        return deliver, corrupt
 
     def _direct(self, req: _Request, batched_error: Optional[Exception] = None) -> None:
         if req.key is not None:
@@ -388,18 +705,26 @@ class SolverService:
         except Exception as e:  # noqa: BLE001 — futures carry the error
             if batched_error is not None:
                 e.__context__ = batched_error
-            _resolve_exc(req.future, e)
+            _resolve_exc(req.future, e, req=req)
             return
         if req.deadline is not None and time.monotonic() > req.deadline:
-            metrics.inc("serve.deadline_miss")
+            self._miss_late()
         _resolve(req.future, X)
 
 
 def _resolve(fut: Future, value) -> None:
-    if not fut.cancelled():
+    if not fut.done():
         fut.set_result(value)
 
 
-def _resolve_exc(fut: Future, exc: Exception) -> None:
-    if not fut.cancelled():
+def _resolve_exc(
+    fut: Future, exc: Exception, req: Optional[_Request] = None
+) -> None:
+    if req is not None and isinstance(exc, SlateError):
+        exc.with_context(
+            routine=req.routine,
+            bucket=req.key.label if req.key is not None else None,
+            attempt=req.attempt,
+        )
+    if not fut.done():
         fut.set_exception(exc)

@@ -158,7 +158,25 @@ def indefinite_factor(A: HermitianMatrix, opts=None):
 
 
 def indefinite_solve(A: HermitianMatrix, B, opts=None):
-    X, *_ = _indef.hesv(A, B, opts)
+    """Solve with breakdown surfaced: this wrapper returns only X, so
+    it demands the success flag itself (the lazy-info contract) —
+    eager breakdown raises NumericalError; inside a trace, where no
+    host value exists, X is NaN-poisoned when info != 0 so a traced
+    caller can never consume a silently-wrong solution."""
+    import jax
+    import jax.numpy as jnp
+
+    from .exceptions import NumericalError
+
+    X, _L, _d, info = _indef.hesv(A, B, opts)
+    if isinstance(info, jax.core.Tracer):
+        nan = jnp.asarray(jnp.nan, X.data.dtype)
+        return X._with(data=jnp.where(info != 0, nan, X.data))
+    if int(info) != 0:
+        raise NumericalError(
+            f"indefinite_solve: factorization breakdown (info={int(info)})",
+            int(info),
+        )
     return X
 
 

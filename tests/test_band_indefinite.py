@@ -226,6 +226,69 @@ def test_hesv_near_singular_leading_minor(rng):
     assert res < 1e-9 * max(np.abs(A0).max(), 1.0)
 
 
+def test_hetrf_traced_lazy_info(rng):
+    """Inside jit there is no host info value to branch on, so hetrf
+    follows the other drivers' lazy-info contract: it returns the
+    no-pivot factor and the info ARRAY (nonzero = breakdown) instead of
+    raising — the old concrete-info TypeError path is gone.  The
+    singular-minor matrix that trips the eager Aasen refactor must flag
+    info != 0 through the trace; a healthy matrix must flag 0."""
+    import jax
+    import jax.numpy as jnp
+
+    n, nb = 16, 8
+
+    @jax.jit
+    def traced_info(Ag):
+        A = HermitianMatrix.from_global(Ag, nb, uplo=Uplo.Lower)
+        _L, _d, info = indef.hetrf(A)
+        return info
+
+    # singular leading minors (every odd leading minor is singular)
+    A0 = np.kron(np.eye(n // 2), np.array([[0.0, 1.0], [1.0, 0.0]]))
+    assert int(traced_info(jnp.asarray(A0))) != 0
+    # well-conditioned SPD: same trace, clean info
+    S0 = 3.0 * np.eye(n)
+    assert int(traced_info(jnp.asarray(S0))) == 0
+    # eager calls on the same singular-minor matrix still take the
+    # host-driven Aasen refactor (the breakdown path is not lost)
+    L, d, info = indef.hetrf(HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower))
+    assert getattr(L, "_aasen", None) is not None
+
+
+def test_simplified_indefinite_solve_surfaces_breakdown(rng):
+    """simplified.indefinite_solve returns only X, so it must demand
+    the info flag itself: a traced breakdown NaN-poisons X (never a
+    silently-wrong finite solution), an eager breakdown recovers via
+    Aasen, and eager hetrs-with-zero-d stays guarded."""
+    import jax
+    import jax.numpy as jnp
+
+    import slate_tpu as st
+
+    n, nb = 16, 8
+    A0 = np.kron(np.eye(n // 2), np.array([[0.0, 1.0], [1.0, 0.0]]))
+    B0 = rng.standard_normal((n, 2))
+
+    @jax.jit
+    def traced(Ag, Bg):
+        A = HermitianMatrix.from_global(Ag, nb, uplo=Uplo.Lower)
+        return st.simplified.indefinite_solve(A, Matrix.from_global(Bg, nb)).to_global()
+
+    Xt = np.asarray(traced(jnp.asarray(A0), jnp.asarray(B0)))
+    assert not np.any(np.isfinite(Xt)), "traced breakdown must poison X"
+    # the same trace on a healthy matrix returns the clean solution
+    S0 = np.diag(np.arange(1.0, n + 1))
+    Xs = np.asarray(traced(jnp.asarray(S0), jnp.asarray(B0)))
+    assert np.abs(S0 @ Xs - B0).max() < 1e-8
+    # eager: breakdown refactors via Aasen and solves exactly
+    Xe = st.simplified.indefinite_solve(
+        HermitianMatrix.from_global(A0, nb, uplo=Uplo.Lower),
+        Matrix.from_global(B0, nb),
+    )
+    assert np.abs(A0 @ np.asarray(Xe.to_global()) - B0).max() < 1e-8
+
+
 def test_hetrf_aasen_direct(rng):
     """Aasen's pivoted LTL^H (reference: src/hetrf.cc's algorithm) as an
     explicit method: factor + solve residuals at LAPACK grade."""

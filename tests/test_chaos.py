@@ -1,0 +1,708 @@
+"""Chaos suite: seeded fault injection (aux/faults) x the serve
+hardening paths.
+
+Matrix covered (site -> hardening that must absorb it):
+
+    compile        -> direct-driver fallback (serve.fallbacks)
+    execute        -> backoff retry, then fallback; breaker opens
+    result_corrupt -> per-item direct re-solve (serve.corrupt_result)
+    latency        -> late-miss accounting (serve.deadline_miss_late)
+    worker_death   -> supervisor respawn + redelivery (worker_restarts)
+    info_nonzero   -> typed NumericalError on exactly the poisoned item
+
+plus the pure pieces: the SLATE_TPU_FAULTS grammar, trigger
+determinism under seed, the decorrelated-backoff sequence, the Breaker
+state machine, admission validation, structured error context, and the
+ISSUE acceptance stream (worker_death + execute at p=0.2 over >= 50
+mixed requests: every future resolves, restarts > 0, a degraded bucket
+returns to the batched path via a half-open probe).
+
+A module-scoped ExecutableCache is shared so each (bucket, batch)
+executable compiles once for the file; heavy combinations live behind
+the ``slow`` marker.
+"""
+
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import InvalidInput, NumericalError, SlateError
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache, direct_call
+from slate_tpu.serve.service import (
+    DeadlineExceeded,
+    Rejected,
+    SolverService,
+    decorrelated_backoff,
+)
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def chaos_env():
+    """Metrics on (the counters are part of the contract under test),
+    faults disarmed before AND after every test."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    faults.reset()
+    yield
+    faults.reset()
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _svc(cache, **kw):
+    cfg = dict(
+        cache=cache, batch_max=4, batch_window_s=0.002,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, degrade_after=2,
+        retry_backoff_s=0.002, retry_backoff_cap_s=0.05,
+        breaker_cooldown_s=0.05,
+    )
+    cfg.update(kw)
+    return SolverService(**cfg)
+
+
+def _gesv_problem(n=10, nrhs=1, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+# ---------------------------------------------------------------------------
+# faults.py: grammar, triggers, determinism, zero side effects when off
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_grammar():
+    faults.configure(
+        "execute:p=0.5,seed=3; latency:once,ms=2.5 ;worker_death:every=4"
+    )
+    st = faults.stats()
+    assert set(st) == {"execute", "latency", "worker_death"}
+    with pytest.raises(ValueError):
+        faults.configure("nosite:p=0.1")
+    with pytest.raises(ValueError):
+        faults.configure("execute:bogus=1")
+    with pytest.raises(ValueError):
+        faults.configure("execute")  # missing ':trigger'
+    with pytest.raises(ValueError):
+        faults.arm("execute", p=0.5, every=2)  # two triggers
+    with pytest.raises(ValueError):
+        faults.arm("execute")  # no trigger
+
+
+def test_trigger_patterns_deterministic():
+    # p-mode: identical fire pattern under the same seed
+    faults.reset()
+    faults.arm("execute", p=0.3, seed=42)
+    faults.on()
+    pat1 = [faults.fire("execute") is not None for _ in range(50)]
+    faults.reset()
+    faults.arm("execute", p=0.3, seed=42)
+    faults.on()
+    pat2 = [faults.fire("execute") is not None for _ in range(50)]
+    assert pat1 == pat2
+    assert 0 < sum(pat1) < 50  # actually probabilistic, not all/none
+    # every-Nth fires on exact multiples
+    faults.reset()
+    faults.arm("compile", every=3)
+    faults.on()
+    pat = [faults.fire("compile") is not None for _ in range(9)]
+    assert pat == [False, False, True] * 3
+    # once fires exactly once, on the after-th call
+    faults.reset()
+    faults.arm("latency", once=True, after=4)
+    faults.on()
+    pat = [faults.fire("latency") is not None for _ in range(8)]
+    assert pat == [False, False, False, True, False, False, False, False]
+    assert faults.stats()["latency"] == {"calls": 8, "fired": 1}
+
+
+def test_faults_off_zero_side_effects():
+    """Disabled faults are inert: no metric, no mutation, no sleep."""
+    faults.arm("result_corrupt", once=True)  # armed but not on()
+    x = np.ones((2, 2))
+    with metrics.deltas() as d:
+        assert faults.fire("result_corrupt") is None
+        faults.check("execute")
+        assert faults.sleep("latency") == 0.0
+        assert faults.corrupt("result_corrupt", x) is x
+        assert faults.poison_info("info_nonzero", x) is x
+        assert not any(k.startswith("faults.") for k in d.all())
+    assert faults.stats()["result_corrupt"]["calls"] == 0
+
+
+def test_backoff_sequence_deterministic_and_bounded():
+    base, cap = 0.01, 0.5
+
+    def seq(seed):
+        rng = random.Random(seed)
+        out, prev = [], 0.0
+        for _ in range(10):
+            prev = decorrelated_backoff(rng, prev, base, cap)
+            out.append(prev)
+        return out
+
+    s1, s2 = seq(7), seq(7)
+    assert s1 == s2  # deterministic under seed
+    assert seq(8) != s1  # actually seeded, not constant
+    assert all(base <= d <= cap for d in s1)
+    assert s1[0] == base  # sleep_0 = base (prev=0 collapses the range)
+    assert max(s1) > base  # jitter grows the window
+
+
+def test_breaker_state_machine_unit():
+    br = bk.Breaker()
+    assert br.state == bk.BREAKER_CLOSED
+    assert not br.record_failure(now=100.0, degrade_after=2)  # streak 1
+    assert br.record_failure(now=101.0, degrade_after=2)  # opens
+    assert br.state == bk.BREAKER_OPEN and br.opens == 1
+    assert not br.try_half_open(now=101.5, cooldown_s=1.0)  # too soon
+    assert br.try_half_open(now=102.5, cooldown_s=1.0)
+    assert br.state == bk.BREAKER_HALF_OPEN
+    assert br.record_failure(now=103.0, degrade_after=2)  # probe fails
+    assert br.state == bk.BREAKER_OPEN and br.opened_at == 103.0
+    assert br.try_half_open(now=105.0, cooldown_s=1.0)
+    assert br.record_success()  # probe heals -> the recovery transition
+    assert br.state == bk.BREAKER_CLOSED and br.streak == 0
+    assert not br.record_success()  # closed success is not a recovery
+
+
+# ---------------------------------------------------------------------------
+# site x hardening: each injected site is absorbed by its recovery path
+# ---------------------------------------------------------------------------
+
+
+def test_execute_fault_retries_with_backoff(shared_cache):
+    A, B = _gesv_problem()
+    faults.arm("execute", once=True)
+    faults.on()
+    s = _svc(shared_cache)
+    with metrics.deltas() as d:
+        X = s.submit("gesv", A, B, retries=1).result(timeout=120)
+        assert np.all(np.isfinite(X))
+        assert d.get("serve.retries") == 1
+        assert d.get("faults.injected.execute") == 1
+        assert d.get("serve.fallbacks") == 0  # retry absorbed it
+    t = metrics.timers().get("serve.retry_backoff_s")
+    assert t is not None and t["count"] >= 1 and t["min_s"] >= s.retry_backoff_s
+    s.stop()
+
+
+def test_compile_fault_falls_back_direct():
+    A, B = _gesv_problem()
+    faults.arm("compile", once=True)
+    faults.on()
+    # fresh cache: the compile site only fires on cold builds
+    s = _svc(ExecutableCache(manifest_path=None))
+    with metrics.deltas() as d:
+        X = s.submit("gesv", A, B).result(timeout=120)  # no retry budget
+        assert np.abs(A @ X - B).max() < 1e-8
+        assert d.get("faults.injected.compile") == 1
+        assert d.get("serve.fallbacks") == 1
+    s.stop()
+
+
+def test_worker_death_respawns_and_redelivers(shared_cache):
+    rng = np.random.default_rng(1)
+    n = 10
+    B = rng.standard_normal((n, 2))
+    mats = [rng.standard_normal((n, n)) + n * np.eye(n) for _ in range(3)]
+    faults.arm("worker_death", once=True)
+    faults.on()
+    s = _svc(shared_cache, start=False)
+    with metrics.deltas() as d:
+        futs = [s.submit("gesv", A, B, retries=1) for A in mats]
+        s.start()
+        out = [f.result(timeout=120) for f in futs]
+        assert d.get("serve.worker_restarts") == 1
+        assert d.get("faults.injected.worker_death") == 1
+    for A, X in zip(mats, out):
+        assert np.abs(A @ X - B).max() < 1e-8  # redelivered, correct
+    h = s.health()
+    assert h["worker_restarts"] == 1 and h["worker_alive"] and h["ok"]
+    s.stop()
+
+
+def test_worker_death_fails_fast_without_budget(shared_cache):
+    A, B = _gesv_problem()
+    faults.arm("worker_death", once=True)
+    faults.on()
+    s = _svc(shared_cache, start=False)
+    fut = s.submit("gesv", A, B)  # retries=0: no budget to redeliver
+    s.start()
+    with pytest.raises(SlateError, match="worker died"):
+        fut.result(timeout=120)
+    # the respawned worker keeps serving
+    X = s.submit("gesv", A, B).result(timeout=120)
+    assert np.all(np.isfinite(X))
+    assert s.health()["worker_alive"]
+    s.stop()
+
+
+def test_info_nonzero_poisons_exactly_one_item(shared_cache):
+    rng = np.random.default_rng(2)
+    n = 10
+    B = rng.standard_normal((n, 1))
+    mats = [rng.standard_normal((n, n)) + n * np.eye(n) for _ in range(3)]
+    faults.arm("info_nonzero", once=True, info=3)
+    faults.on()
+    s = _svc(shared_cache, start=False)
+    with metrics.deltas() as d:
+        futs = [s.submit("gesv", A, B) for A in mats]
+        s.start()
+        # poison lands on batch item 0 == the oldest request
+        with pytest.raises(NumericalError) as ei:
+            futs[0].result(timeout=120)
+        assert ei.value.info == 3
+        assert ei.value.routine == "gesv"  # structured context attached
+        assert ei.value.bucket == "gesv.16x16x4.float64"
+        for A, f in zip(mats[1:], futs[1:]):
+            X = f.result(timeout=120)
+            assert np.abs(A @ X - B).max() < 1e-8  # others unharmed
+        assert d.get("serve.numerical_errors") == 1
+    s.stop()
+
+
+def test_result_corrupt_recovers_via_direct(shared_cache):
+    A, B = _gesv_problem(seed=3)
+    faults.arm("result_corrupt", once=True)
+    faults.on()
+    s = _svc(shared_cache)
+    with metrics.deltas() as d:
+        X = s.submit("gesv", A, B).result(timeout=120)
+        assert np.all(np.isfinite(X))  # never delivers the NaN
+        assert np.abs(A @ X - B).max() < 1e-8
+        assert d.get("serve.corrupt_result") == 1
+        assert d.get("faults.injected.result_corrupt") == 1
+    s.stop()
+
+
+def test_latency_fault_counts_late_miss(shared_cache):
+    A, B = _gesv_problem(seed=4)
+    faults.arm("latency", once=True, ms=400)
+    faults.on()
+    s = _svc(shared_cache)  # idle: pops well before the 0.15 s deadline
+    with metrics.deltas() as d:
+        X = s.submit("gesv", A, B, deadline=0.15).result(timeout=120)
+        assert np.all(np.isfinite(X))  # late, but still delivered
+        assert d.get("serve.deadline_miss_late") == 1
+        assert d.get("serve.deadline_miss_queued") == 0
+        assert d.get("serve.deadline_miss") == 1  # total stays the sum
+    s.stop()
+
+
+def test_deadline_queued_cancel_counter(shared_cache):
+    """The other half of the deadline_miss split: a queued cancel."""
+    A, B = _gesv_problem(seed=5)
+    s = _svc(shared_cache, start=False)
+    with metrics.deltas() as d:
+        fut = s.submit("gesv", A, B, deadline=0.01)
+        time.sleep(0.05)  # expires while the worker is paused
+        s.start()
+        with pytest.raises(DeadlineExceeded) as ei:
+            fut.result(timeout=120)
+        assert d.get("serve.deadline_miss_queued") == 1
+        assert d.get("serve.deadline_miss_late") == 0
+        assert d.get("serve.deadline_miss") == 1
+    assert ei.value.routine == "gesv" and ei.value.bucket
+    s.stop()
+
+
+def test_deadline_cancels_during_backoff(shared_cache):
+    """A request whose deadline passes while it is backing off is
+    queued-cancelled promptly by the worker's sweep — the retry backoff
+    must not extend the deadline by up to the backoff cap."""
+    A, B = _gesv_problem(seed=6)
+    s = _svc(shared_cache, retry_backoff_s=0.8, retry_backoff_cap_s=1.5)
+    s.submit("gesv", A, B).result(timeout=120)  # warm: dispatch is fast
+    faults.arm("execute", every=1)  # every batched dispatch fails
+    faults.on()
+    t0 = time.monotonic()
+    with metrics.deltas() as d:
+        fut = s.submit("gesv", A, B, retries=3, deadline=0.15)
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=120)
+        elapsed = time.monotonic() - t0
+        assert d.get("serve.deadline_miss_queued") == 1
+    # without the sweep the cancel waits out the 0.8 s backoff floor
+    assert elapsed < 0.6, f"deadline cancel delayed by backoff: {elapsed:.3f}s"
+    s.stop()
+
+
+def test_corrupt_results_open_breaker(shared_cache):
+    """Delivered garbage is a batched-path failure even though nothing
+    raised: a bucket whose executable deterministically corrupts every
+    result must open its breaker (it would otherwise pay batched
+    dispatch + per-item direct re-solve forever and report healthy)."""
+    A, B = _gesv_problem(seed=7)
+    faults.arm("result_corrupt", every=1)
+    faults.on()
+    s = _svc(shared_cache)  # degrade_after=2
+    with metrics.deltas() as d:
+        for _ in range(2):
+            X = s.submit("gesv", A, B).result(timeout=120)
+            assert np.all(np.isfinite(X))  # re-solved direct, not garbage
+        assert d.get("serve.corrupt_result") == 2
+        assert d.get("serve.breaker_open") == 1
+        assert d.get("serve.breaker_closed") == 0
+    assert s.health()["open_buckets"]
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: open -> half-open -> closed recovery
+# ---------------------------------------------------------------------------
+
+
+class HealingCache(ExecutableCache):
+    """Fails the batched path a fixed number of times, then heals."""
+
+    def __init__(self, fail_times):
+        super().__init__(manifest_path=None)
+        self.fail_times = fail_times
+        self.calls = 0
+
+    def run(self, key, A_batch, B_batch):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise RuntimeError("injected batched failure")
+        return super().run(key, A_batch, B_batch)
+
+
+def test_breaker_opens_half_opens_closes():
+    A, B = _gesv_problem(seed=6)
+    hc = HealingCache(fail_times=2)
+    # cooldown far beyond test timing: transitions happen only when the
+    # test rewinds opened_at (deterministic on a loaded box)
+    s = _svc(hc, breaker_cooldown_s=60.0)
+    key = bk.bucket_for(
+        "gesv", 10, 10, 1, A.dtype, floor=FLOOR, nrhs_floor=NRHS_FLOOR
+    )
+    label = key.label
+    with metrics.deltas() as d:
+        # two consecutive failures (retry included) open the breaker
+        X = s.submit("gesv", A, B, retries=1).result(timeout=120)
+        assert np.abs(A @ X - B).max() < 1e-8  # direct fallback result
+        assert d.get("serve.breaker_open") == 1
+        assert d.get("serve.degraded") == 1  # legacy alias still counts
+        assert s.health()["breakers"][label] == bk.BREAKER_OPEN
+        assert s.health()["open_buckets"] == [label]
+        # while open: routed direct, the batched path is NOT touched
+        calls_before = hc.calls
+        s.submit("gesv", A, B).result(timeout=120)
+        assert hc.calls == calls_before
+        # "elapse" the cooldown: half-open probe heals and closes
+        s._breakers[key].opened_at -= 61.0
+        X3 = s.submit("gesv", A, B).result(timeout=120)
+        assert np.abs(A @ X3 - B).max() < 1e-8
+        assert hc.calls == calls_before + 1  # the probe went batched
+        assert d.get("serve.breaker_half_open") == 1
+        assert d.get("serve.breaker_closed") == 1
+        assert s.health()["breakers"][label] == bk.BREAKER_CLOSED
+        # and the bucket stays on the batched path afterwards
+        s.submit("gesv", A, B).result(timeout=120)
+        assert hc.calls == calls_before + 2
+    s.stop()
+
+
+def test_breaker_failed_probe_reopens():
+    A, B = _gesv_problem(seed=7)
+    hc = HealingCache(fail_times=3)  # 2 to open + 1 failed probe
+    s = _svc(hc, breaker_cooldown_s=60.0)
+    key = bk.bucket_for(
+        "gesv", 10, 10, 1, A.dtype, floor=FLOOR, nrhs_floor=NRHS_FLOOR
+    )
+    with metrics.deltas() as d:
+        s.submit("gesv", A, B, retries=1).result(timeout=120)
+        assert d.get("serve.breaker_open") == 1
+        s._breakers[key].opened_at -= 61.0
+        s.submit("gesv", A, B).result(timeout=120)  # probe fails -> reopen
+        assert d.get("serve.breaker_half_open") == 1
+        assert d.get("serve.breaker_open") == 2
+        assert d.get("serve.breaker_closed") == 0
+        s._breakers[key].opened_at -= 61.0
+        s.submit("gesv", A, B).result(timeout=120)  # healed probe closes
+        assert d.get("serve.breaker_closed") == 1
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission checks
+# ---------------------------------------------------------------------------
+
+
+def test_invalid_input_rejected_before_queue(shared_cache):
+    A, B = _gesv_problem(seed=8)
+    Abad = A.copy()
+    Abad[3, 3] = np.nan
+    Bbad = B.copy()
+    Bbad[0, 0] = np.inf
+    s = _svc(shared_cache)
+    with metrics.deltas() as d:
+        with pytest.raises(InvalidInput) as ei:
+            s.submit("gesv", Abad, B)
+        with pytest.raises(InvalidInput):
+            s.submit("gesv", A, Bbad)
+        assert d.get("serve.invalid_input") == 2
+        assert d.get("serve.requests") == 0  # never admitted
+    assert s.queue_depth() == 0
+    assert ei.value.routine == "gesv"
+    assert "non-finite" in str(ei.value)
+    s.stop()
+    # toggleable: validate=False admits the same operands
+    s2 = _svc(shared_cache, validate=False, start=False)
+    fut = s2.submit("gesv", Abad, B)
+    assert s2.queue_depth() == 1
+    s2.stop()  # resolves the future with Rejected; nothing hangs
+    with pytest.raises(Rejected):
+        fut.result(timeout=10)
+
+
+def test_structured_context_on_every_error_path(shared_cache):
+    A, B = _gesv_problem(seed=9)
+    s = _svc(shared_cache, max_queue=1, start=False)
+    f1 = s.submit("gesv", A, B)
+    with pytest.raises(Rejected) as ei:
+        s.submit("gesv", A, B)  # queue full
+    assert ei.value.routine == "gesv"
+    s.stop()
+    with pytest.raises(Rejected) as ei2:
+        f1.result(timeout=10)  # drained on stop
+    assert ei2.value.routine == "gesv"
+    assert ei2.value.bucket == "gesv.16x16x4.float64"
+    assert "[routine=gesv" in str(ei2.value)
+
+
+def test_health_snapshot_shape(shared_cache):
+    s = _svc(shared_cache)
+    h = s.health()
+    for field in (
+        "ok", "running", "worker_alive", "worker_restarts", "queue_depth",
+        "queue_limit", "inflight", "breakers", "open_buckets",
+        "failures_60s", "failure_rate_60s", "uptime_s",
+    ):
+        assert field in h, field
+    assert h["ok"] and h["running"] and h["worker_alive"]
+    assert h["queue_limit"] == s.max_queue
+    s.stop()
+    h2 = s.health()
+    assert not h2["ok"] and not h2["running"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE acceptance: faulty mixed stream to steady recovery
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_faulty_stream_all_futures_resolve(shared_cache):
+    """worker_death + execute injected at p=0.2 over a >= 50-request
+    mixed stream (seeded): every future resolves (result or typed
+    exception, none hang), the worker restart counter is > 0, and at
+    least one degraded bucket returns to the batched path via a
+    half-open probe."""
+    rng = np.random.default_rng(0)
+    n1, n2 = 10, 20
+    B1 = rng.standard_normal((n1, 2))
+    G = rng.standard_normal((n2, n2))
+    A2 = G @ G.T + n2 * np.eye(n2)
+    B2 = rng.standard_normal((n2, 3))
+
+    faults.arm("execute", p=0.2, seed=11)
+    faults.arm("worker_death", p=0.2, seed=13)
+    faults.on()
+    s = _svc(shared_cache, breaker_cooldown_s=0.02, retry_backoff_s=0.001,
+             start=False)
+    futs = []
+    for i in range(54):
+        if i % 3 == 2:
+            futs.append(s.submit("posv", A2 + i * 1e-3 * np.eye(n2), B2,
+                                 retries=2))
+        else:
+            A = rng.standard_normal((n1, n1)) + n1 * np.eye(n1)
+            futs.append(s.submit("gesv", A, B1, retries=2))
+    s.start()
+    resolved = typed = 0
+    for f in futs:
+        try:
+            X = f.result(timeout=300)  # a hung future fails the test here
+            assert np.all(np.isfinite(X))
+            resolved += 1
+        except SlateError:
+            typed += 1
+    assert resolved + typed == len(futs)  # every future resolved
+    assert resolved > 0
+    c = metrics.counters()
+    assert c.get("serve.worker_restarts", 0) > 0
+    assert c.get("faults.injected.execute", 0) > 0
+    assert c.get("faults.injected.worker_death", 0) > 0
+
+    # recovery leg: stop injecting; any open breaker must return to the
+    # batched path through a half-open probe
+    faults.reset()
+    if not s.health()["open_buckets"]:
+        # the seeded stream didn't open a breaker (possible under
+        # thread-timing variance): force one open deterministically
+        faults.arm("execute", every=1)
+        faults.on()
+        A, B = _gesv_problem(seed=21)
+        for _ in range(2):
+            try:
+                s.submit("gesv", A, B).result(timeout=120)
+            except SlateError:
+                pass  # every=1 faults the direct fallback too — typed
+        faults.reset()
+    assert s.health()["open_buckets"]
+    time.sleep(0.05)  # past the cooldown
+    with metrics.deltas() as d:
+        # one request per previously-open bucket probes and heals it
+        A, B = _gesv_problem(seed=22)
+        s.submit("gesv", A, B).result(timeout=120)
+        Xp = s.submit("posv", A2, B2).result(timeout=120)
+        assert np.all(np.isfinite(Xp))
+        assert d.get("serve.breaker_closed") >= 1
+    assert s.health()["open_buckets"] == []  # batched path restored
+    s.stop()
+
+
+# ---------------------------------------------------------------------------
+# tools/chaos_report.py: injected-vs-recovered join over a metrics JSONL
+# ---------------------------------------------------------------------------
+
+
+def _load_chaos_report():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "chaos_report.py",
+    )
+    spec = importlib.util.spec_from_file_location("chaos_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_report_flags_unrecovered_sites(tmp_path):
+    import json
+
+    cr = _load_chaos_report()
+    path = tmp_path / "m.jsonl"
+    rows = [
+        {"type": "meta", "schema": 1},
+        {"type": "counter", "name": "faults.injected.execute", "value": 5},
+        {"type": "counter", "name": "serve.retries", "value": 4},
+        {"type": "counter", "name": "serve.fallbacks", "value": 1},
+        {"type": "counter", "name": "faults.injected.worker_death", "value": 2},
+        # no serve.worker_restarts -> worker_death must be flagged
+        {"type": "counter", "name": "faults.injected.latency", "value": 3},
+        # latency with no deadline traffic is informational, NOT flagged
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    rep = cr.analyze(str(path))
+    by_site = {r["site"]: r for r in rep}
+    assert by_site["execute"]["injected"] == 5
+    assert by_site["execute"]["recovered"] == 5  # retries + fallbacks
+    assert not by_site["execute"]["flagged"]
+    assert by_site["worker_death"]["injected"] == 2
+    assert by_site["worker_death"]["flagged"]
+    assert not by_site["latency"]["flagged"]  # informational site
+    assert cr.main([str(path)]) == 1  # flagged site -> nonzero exit
+
+
+def test_chaos_report_end_to_end(shared_cache, tmp_path):
+    """A real faulty run's JSONL round-trips through the report with
+    every injected site showing a recovery signal."""
+    cr = _load_chaos_report()
+    A, B = _gesv_problem(seed=23)
+    faults.arm("execute", once=True)
+    faults.on()
+    s = _svc(shared_cache)
+    s.submit("gesv", A, B, retries=1).result(timeout=120)
+    s.stop()
+    faults.reset()
+    path = str(tmp_path / "run.jsonl")
+    metrics.dump(path)
+    rep = cr.analyze(path)
+    by_site = {r["site"]: r for r in rep}
+    assert "execute" in by_site
+    assert not by_site["execute"]["flagged"]
+    assert cr.main([path]) == 0
+
+
+# ---------------------------------------------------------------------------
+# heavy combinations (slow marker: excluded from tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "site,kw,recovery",
+    [
+        ("execute", dict(p=0.5, seed=5), "serve.retries"),
+        ("worker_death", dict(every=3), "serve.worker_restarts"),
+        ("result_corrupt", dict(every=2), "serve.corrupt_result"),
+        ("info_nonzero", dict(every=5), "serve.numerical_errors"),
+        ("latency", dict(p=0.5, seed=9, ms=5), None),
+    ],
+)
+def test_site_matrix_stream(shared_cache, site, kw, recovery):
+    """Sustained injection per site over a 20-request stream: every
+    future resolves and the site's recovery metric fires."""
+    rng = np.random.default_rng(31)
+    n = 10
+    B = rng.standard_normal((n, 1))
+    faults.arm(site, **kw)
+    faults.on()
+    s = _svc(shared_cache, retry_backoff_s=0.001, breaker_cooldown_s=0.01,
+             start=False)
+    futs = []
+    for _ in range(20):
+        A = rng.standard_normal((n, n)) + n * np.eye(n)
+        futs.append(s.submit("gesv", A, B, retries=2))
+    s.start()
+    outcomes = []
+    for f in futs:
+        try:
+            X = f.result(timeout=300)
+            assert np.all(np.isfinite(X))
+            outcomes.append("ok")
+        except SlateError:
+            outcomes.append("typed")
+    assert len(outcomes) == 20  # nothing hung
+    st = faults.stats()[site]
+    assert st["fired"] > 0
+    if recovery is not None:
+        assert metrics.counters().get(recovery, 0) > 0, recovery
+    s.stop()
+
+
+@pytest.mark.slow
+def test_env_spec_drives_service(shared_cache, monkeypatch):
+    """The Option.Faults spec string arms + enables injection through
+    the service constructor (the SLATE_TPU_FAULTS production path)."""
+    A, B = _gesv_problem(seed=41)
+    s = _svc(shared_cache, faults_spec="execute:once", start=False)
+    assert faults.is_on() and "execute" in faults.stats()
+    fut = s.submit("gesv", A, B, retries=1)
+    s.start()
+    X = fut.result(timeout=120)
+    assert np.all(np.isfinite(X))
+    assert faults.stats()["execute"]["fired"] == 1
+    s.stop()
+    # the arming service owns the global injection state: stop() disarms,
+    # so a discarded chaos service cannot poison later services
+    assert not faults.is_on() and faults.stats() == {}

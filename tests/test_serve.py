@@ -254,6 +254,9 @@ def test_deadline_miss_cancels_queued_request(shared_cache):
         with pytest.raises(DeadlineExceeded):
             fut.result(timeout=120)
         assert d.get("serve.deadline_miss") == 1
+        # the split: a queued cancel, NOT a late finish
+        assert d.get("serve.deadline_miss_queued") == 1
+        assert d.get("serve.deadline_miss_late") == 0
     s.stop()
 
 

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Injected-vs-recovered report over a metrics JSONL.
+
+Reads a ``SLATE_TPU_METRICS`` dump from a chaos run (faults armed via
+``SLATE_TPU_FAULTS`` or ``aux.faults``) and joins every
+``faults.injected.<site>`` counter against the serve hardening
+counters that should have absorbed it:
+
+    compile        -> serve.fallbacks, serve.retries
+    execute        -> serve.retries, serve.fallbacks, serve.breaker_open
+    result_corrupt -> serve.corrupt_result, serve.fallbacks
+    latency        -> serve.deadline_miss_late
+    worker_death   -> serve.worker_restarts
+    info_nonzero   -> serve.numerical_errors
+
+A site with injections but NO recovery signal is flagged — either the
+containment path regressed or the site is not wired to one — and the
+tool exits nonzero so CI can gate on it.  Exception: ``latency`` is
+informational only (reported, never flagged) — added delay violates
+nothing unless requests carry deadlines, so a latency-only run with no
+deadline traffic is a legitimate zero-signal outcome.
+
+Attribution caveat: the counters are process-global, so when two armed
+sites share a recovery family (``compile`` and ``execute`` both join
+``serve.retries``/``serve.fallbacks``), one site's activity can mask
+the other's regressed containment.  Rows whose every signal is shared
+with another injected site are marked ``shared with <site>`` — for
+airtight per-site attribution, run one site per chaos pass.
+
+Usage:
+    SLATE_TPU_METRICS=/tmp/chaos.jsonl python -m pytest tests/test_chaos.py
+    python tools/chaos_report.py /tmp/chaos.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+#: site -> counter families whose sum is that site's recovery signal
+RECOVERY = {
+    "compile": ("serve.fallbacks", "serve.retries"),
+    "execute": ("serve.retries", "serve.fallbacks", "serve.breaker_open"),
+    # the per-item direct re-solve of a corrupt batch bumps
+    # serve.fallbacks, so it is part of this site's signal (and of the
+    # shared-attribution overlap with compile/execute)
+    "result_corrupt": ("serve.corrupt_result", "serve.fallbacks"),
+    # _miss_late() bumps both the split counter and the total; summing
+    # them would double-count, so only the split counter is joined
+    "latency": ("serve.deadline_miss_late",),
+    "worker_death": ("serve.worker_restarts",),
+    "info_nonzero": ("serve.numerical_errors",),
+}
+
+#: sites whose zero-recovery outcome is legitimate (see module doc)
+INFORMATIONAL = {"latency"}
+
+INJECT_PREFIX = "faults.injected."
+
+
+def _counters(path: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("type") == "counter":
+                out[row["name"]] = float(row.get("value", 0))
+    return out
+
+
+def analyze(path: str) -> List[dict]:
+    """One row per injected site: injected count, summed recovery
+    signal, the counters it came from, and the flag."""
+    counters = _counters(path)
+    injected_sites = {
+        name[len(INJECT_PREFIX):]
+        for name, v in counters.items()
+        if name.startswith(INJECT_PREFIX) and v > 0
+    }
+    rows = []
+    for site in sorted(injected_sites):
+        injected = counters[INJECT_PREFIX + site]
+        families = RECOVERY.get(site, ())
+        signals = {f: counters[f] for f in families if counters.get(f, 0) > 0}
+        recovered = sum(signals.values())
+        # every nonzero signal also claimable by another injected site
+        # => this row's recovery cannot be attributed to this site alone
+        sharers = sorted(
+            o for o in injected_sites
+            if o != site and signals
+            and all(f in RECOVERY.get(o, ()) for f in signals)
+        )
+        rows.append({
+            "site": site,
+            "injected": int(injected),
+            "recovered": int(recovered),
+            "signals": signals,
+            "shared_with": sharers,
+            "flagged": recovered <= 0 and site not in INFORMATIONAL,
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a chaos run")
+    args = ap.parse_args(argv)
+
+    rows = analyze(args.jsonl)
+    if not rows:
+        print("no faults.injected.* counters in this JSONL (faults off?)")
+        return 0
+    hdr = f"{'site':16} {'injected':>9} {'recovered':>10}  status / signals"
+    print(hdr)
+    print("-" * len(hdr))
+    flagged = 0
+    for r in rows:
+        if r["flagged"]:
+            flagged += 1
+            status = "FLAG: no recovery/fallback signal"
+        elif not r["signals"]:
+            status = "informational (no deadline traffic)"
+        else:
+            status = ", ".join(
+                f"{k}={int(v)}" for k, v in sorted(r["signals"].items())
+            )
+            if r["shared_with"]:
+                status += f"  [shared with {', '.join(r['shared_with'])}]"
+        print(f"{r['site']:16} {r['injected']:9d} {r['recovered']:10d}  {status}")
+    if flagged:
+        print(f"\n{flagged} site(s) injected faults with no recovery signal")
+        return 1
+    print("\nevery injected site shows a recovery signal")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
