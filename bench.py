@@ -172,6 +172,77 @@ def bench_geqrf(jax, jnp, n, nb, trials, schedule="auto"):
     return _gflops(name, 4.0 * n**3 / 3.0, best), best
 
 
+def bench_solve_mixed(jax, jnp, routine, n, nb, trials):
+    """Mixed-precision solve vs the plain f64 direct driver: wall
+    seconds for both (eager best-of — the mixed drivers run the host
+    fallback branch, so they are timed as the user calls them),
+    refinement iteration count, and the speedup ratio.  Well-
+    conditioned operands so the refine path never falls back (a
+    fallback would time factor+direct and report speedup < 1 — which
+    is exactly what the ratio is for)."""
+    import slate_tpu as st
+
+    key = jax.random.PRNGKey(6)
+    G = jax.random.normal(key, (n, n), jnp.float64)
+    B = jax.random.normal(jax.random.PRNGKey(7), (n, 8), jnp.float64)
+    Bm = st.Matrix.from_global(B, nb)
+
+    if routine == "posv":
+        S = G @ G.T / n + 2.0 * jnp.eye(n, dtype=jnp.float64)
+
+        def make_A(t):
+            return st.HermitianMatrix.from_global(
+                S + t * 1e-12 * jnp.eye(n, dtype=jnp.float64), nb,
+                uplo=st.Uplo.Lower,
+            )
+
+        def plain(A):
+            X, _L, info = st.posv(A, Bm)
+            return X, int(info)
+
+        def mixed(A):
+            X, info, iters = st.posv_mixed(A, Bm)
+            return X, iters
+    else:
+        Ad = G + n * jnp.eye(n, dtype=jnp.float64)
+
+        def make_A(t):
+            return st.Matrix.from_global(
+                Ad + t * 1e-12 * jnp.eye(n, dtype=jnp.float64), nb
+            )
+
+        def plain(A):
+            X, _LU, _piv, info = st.gesv(A, Bm)
+            return X, int(info)
+
+        def mixed(A):
+            X, info, iters = st.gesv_mixed(A, Bm)
+            return X, iters
+
+    def best_of(fn):
+        fn(make_A(0.0))  # compile + warm
+        best, aux = float("inf"), None
+        for t in range(trials):
+            A = make_A(1.0 + t)
+            jax.block_until_ready(A.data)
+            t0 = time.perf_counter()
+            X, a = fn(A)
+            float(np.asarray(X.data.ravel()[-1]))  # host readback barrier
+            best = min(best, time.perf_counter() - t0)
+            aux = a
+        return best, aux
+
+    sec_plain, _ = best_of(plain)
+    sec_mixed, iters = best_of(mixed)
+    return {
+        "n": n,
+        "seconds": round(sec_mixed, 3),
+        "seconds_plain": round(sec_plain, 3),
+        "speedup_vs_plain": round(sec_plain / sec_mixed, 3),
+        "iterations": int(iters),
+    }
+
+
 def bench_heev_vectors(jax, jnp, n, nb, trials):
     """Two-stage heev WITH eigenvectors: he2hb + hb2st wavefront +
     native stedc divide & conquer + both back-transforms — no vendor
@@ -365,6 +436,23 @@ def main(argv=None):
     factor_entry("dgetrf_recursive", _getrf, nfac, nbfac, "recursive")
     factor_entry("dgeqrf", _geqrf, nfac, nbfac, "flat")
     factor_entry("dgeqrf_recursive", _geqrf, nfac, nbfac, "recursive")
+
+    # -- mixed-precision solves (refine/): f32-factor IR vs plain f64.
+    # speedup_vs_plain is the headline the subsystem exists for: on the
+    # MXU the f32 factor runs several times faster than the emulated-
+    # f64 one, and the O(n^2) refinement is noise at these sizes -------
+    nmix = (4096 if args.full else 2048) if on_tpu else 256
+
+    def entry_mixed(routine):
+        def run():
+            return bench_solve_mixed(
+                jax, jnp, routine, nmix, 512 if on_tpu else 32, trials
+            )
+
+        return run
+
+    run_entry("dgesv_mixed", entry_mixed("gesv"))
+    run_entry("dposv_mixed", entry_mixed("posv"))
 
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96

@@ -96,6 +96,76 @@ def schedules_smoke() -> int:
     )
 
 
+# Env-activated mixed-precision stream for the --refine gate: metrics
+# are read at import (the production activation path); the atexit dump
+# writes the JSONL refine_report joins.  One deliberately ill-
+# conditioned system exercises the fallback, well under the report's
+# rate threshold.
+_REFINE_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)  # the f64/f32 pair is the gate
+import numpy as np
+import slate_tpu as st
+from slate_tpu.matgen import cond_matrix
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix
+
+B = np.arange(96, dtype=np.float64).reshape(48, 2) / 48.0
+for seed in (0, 1, 2):
+    A = cond_matrix(48, 1e3, seed=seed)
+    X, info, iters = st.gesv_mixed(Matrix.from_global(A, 16),
+                                   Matrix.from_global(B, 16))
+    assert int(info) == 0 and iters >= 0, (int(info), iters)
+S = cond_matrix(48, 1e4, spd=True)
+X, info, iters = st.posv_mixed(
+    HermitianMatrix.from_global(S, 16, uplo=st.Uplo.Lower),
+    Matrix.from_global(B, 16))
+assert int(info) == 0 and iters >= 0
+# divergence leg: cond >> 1/eps_f32 must demote to the fallback solver
+A = cond_matrix(48, 1e9)
+X, info, iters = st.gesv_mixed(Matrix.from_global(A, 16),
+                               Matrix.from_global(B, 16))
+assert int(info) == 0 and iters < 0, (int(info), iters)
+assert np.all(np.isfinite(np.asarray(X.to_global())))
+print("refine driver: 4 converged, 1 fallback, 0 hangs")
+"""
+
+
+def refine_gate() -> int:
+    """Refine gate, two legs: (1) the mixed-precision suite (slow
+    parametrizations included); (2) an env-activated driver stream
+    (SLATE_TPU_METRICS, the production path) whose JSONL is joined by
+    tools/refine_report.py — a fallback rate past the threshold fails
+    the gate."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/test_refine.py", "-q",
+        "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+    ]
+    rc = subprocess.call(cmd, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         cwd=here)
+    if rc != 0:
+        return rc
+    jsonl = os.path.join(tempfile.gettempdir(), f"refine_{os.getpid()}.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl)
+    try:
+        rc = subprocess.call([sys.executable, "-c", _REFINE_DRIVER], env=env,
+                             cwd=here)
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "refine_report.py"),
+             jsonl, "--max-fallback-rate", "0.5"],
+            cwd=here,
+        )
+    finally:
+        try:
+            os.unlink(jsonl)
+        except OSError:
+            pass
+
+
 # Env-activated faulty stream for the --chaos gate: SLATE_TPU_FAULTS +
 # SLATE_TPU_METRICS are read at import (the production activation path),
 # the atexit dump writes the JSONL chaos_report joins.
@@ -176,6 +246,9 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true",
                     help="run the fault-injection suite (slow matrix "
                          "included) + the chaos_report recovery gate")
+    ap.add_argument("--refine", action="store_true",
+                    help="run the mixed-precision refinement suite + the "
+                         "refine_report fallback-rate gate")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -190,6 +263,8 @@ def main() -> int:
         return schedules_smoke()
     if args.chaos:
         return chaos()
+    if args.refine:
+        return refine_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -26,6 +26,7 @@ from .enums import (
     NormScope,
     Op,
     Option,
+    RefineMethod,
     Schedule,
     Side,
     Target,
@@ -67,12 +68,14 @@ from .drivers.aux import (
     scale_row_col, set, set_lambdas,
 )
 from .drivers.chol import (
-    pocondest, posv, posv_mixed, posv_mixed_gmres, potrf, potri, potrs,
-    trtri, trtrm,
+    pocondest, posv, potrf, potri, potrs, trtri, trtrm,
 )
 from .drivers.lu import (
-    gecondest, gerbt, gesv, gesv_mixed, gesv_mixed_gmres, gesv_nopiv,
-    gesv_rbt, getrf, getrf_nopiv, getri, getrs, getrs_nopiv, trcondest,
+    gecondest, gerbt, gesv, gesv_nopiv, gesv_rbt, getrf, getrf_nopiv,
+    getri, getrs, getrs_nopiv, trcondest,
+)
+from .drivers.mixed import (
+    gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,
 )
 from .drivers.qr import (
     cholqr, gelqf, gels, geqrf, ungqr, unmlq, unmqr,
@@ -91,6 +94,9 @@ from .matgen.generate import generate_matrix
 
 # simplified verb API (reference: include/slate/simplified_api.hh)
 from . import simplified
+
+# mixed-precision refinement subsystem (policy / IR / GMRES-IR cores)
+from . import refine
 
 # serving layer (lazy package: costs nothing until the first request)
 from . import serve

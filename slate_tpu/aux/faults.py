@@ -13,13 +13,17 @@ Sites (:data:`SITES`) and where they are checked:
                        (``serve.cache.ExecutableCache.executable``)
     ``execute``        dispatch raises (``cache.run`` / ``direct_call``)
     ``result_corrupt`` NaN poisoned into the first batch item's output
-                       (``cache.run``)
+                       (``cache.run``) / into the low-precision factor
+                       (``drivers/mixed`` factor step — drives the
+                       refinement into its fallback solver)
     ``latency``        injected sleep before dispatch, ``ms=`` spec key
                        (``cache.run`` / ``direct_call``)
     ``worker_death``   the service worker thread dies mid-loop with a
                        batch in flight (``service.SolverService._loop``)
     ``info_nonzero``   the first batch item's ``info`` forced nonzero,
-                       ``info=`` spec key (``cache.run``)
+                       ``info=`` spec key (``cache.run``); also a fake
+                       nonzero factor info in the mixed drivers'
+                       factor step (fallback-solver exercise)
 
 Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
 site, so the fire pattern is a pure function of ``seed`` and the call

@@ -48,7 +48,12 @@ The containment layers report through this registry too: serve/ emits
 ``serve.invalid_input``, and the ``serve.deadline_miss_queued/_late``
 split; ``aux/faults`` counts every injection as
 ``faults.injected.<site>`` — ``tools/chaos_report.py`` joins the
-injected-vs-recovered pair from one JSONL.
+injected-vs-recovered pair from one JSONL.  The mixed-precision
+drivers (drivers/mixed.py over refine/) emit the ``refine.calls`` /
+``refine.iterations`` / ``refine.converged`` / ``refine.fallbacks``
+counters and the ``refine.residual`` gauge, global and per-routine —
+``tools/refine_report.py`` turns one JSONL into the per-routine
+iterations/converged/fallback-rate table.
 """
 
 from __future__ import annotations

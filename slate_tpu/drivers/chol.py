@@ -15,10 +15,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
-from ..enums import Diag, Norm, Op, Option, Side, Uplo
+from ..enums import Diag, Op, Option, Side, Uplo
 from ..exceptions import DimensionError, NumericalError, slate_assert
 from ..matrix.base import BaseMatrix, conj_transpose
 from ..matrix.matrix import HermitianMatrix, Matrix, SymmetricMatrix, TriangularMatrix
@@ -27,7 +26,6 @@ from ..ops import blas2d, chol_kernels
 from ..parallel import spmd_chol
 from ..parallel.layout import eye_splice, tiles_from_global
 from . import blas3
-from .aux import norm as _norm
 
 from ..aux import metrics
 from ..aux.metrics import instrumented
@@ -187,64 +185,11 @@ def potri(L: TriangularMatrix, opts: Optional[Options] = None) -> HermitianMatri
     return trtrm(Linv, opts)
 
 
-@instrumented("posv_mixed")
-def posv_mixed(
-    A: HermitianMatrix,
-    B: Matrix,
-    opts: Optional[Options] = None,
-) -> Tuple[Matrix, jnp.ndarray, int]:
-    """Mixed-precision SPD solve: factor in low precision, iterative
-    refinement in working precision (reference: src/posv_mixed.cc; on TPU
-    the low precision is f32 — an easy win given the MXU's f32/bf16 rates,
-    SURVEY §7 step 5).
-
-    Returns (X, info, iters); iters < 0 means fallback to full precision
-    was used (Option.UseFallbackSolver, gesv_mixed_gmres.cc:100-106).
-    """
-    lo_t = np.complex64 if A.is_complex else np.float32
-    max_it = int(get_option(opts, Option.MaxIterations, 30))
-    use_fallback = bool(get_option(opts, Option.UseFallbackSolver, True))
-
-    A_full = A.full_global()
-    B2 = B.to_global()
-    n = A.n
-    # target accuracy in working precision
-    work_eps = float(jnp.finfo(B2.dtype).eps)
-    anorm = _norm(Norm.Inf, A)
-    tol = float(get_option(opts, Option.Tolerance, np.sqrt(n) * work_eps))
-
-    A_lo = A_full.astype(lo_t)
-    L_lo = chol_kernels.cholesky(A_lo)
-
-    def solve_lo(R):
-        Y = lax.linalg.triangular_solve(
-            L_lo, R.astype(lo_t), left_side=True, lower=True
-        )
-        Z = lax.linalg.triangular_solve(
-            L_lo, Y, left_side=True, lower=True, transpose_a=True,
-            conjugate_a=A.is_complex,
-        )
-        return Z.astype(B2.dtype)
-
-    from .lu import ir_refine_while
-
-    X, iters_dev, converged = ir_refine_while(
-        A_full, B2, solve_lo, tol, anorm, max_it
-    )
-    iters = int(iters_dev)
-    if not bool(converged) and use_fallback:
-        # full-precision fallback (posv_mixed.cc fallback path)
-        Lw = chol_kernels.cholesky(A_full)
-        Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)
-        Xw = lax.linalg.triangular_solve(
-            Lw, Y, left_side=True, lower=True, transpose_a=True,
-            conjugate_a=A.is_complex,
-        )
-        X = Xw
-        iters = -max_it
-    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
-    Xm = B._with(data=tiles_from_global(X.astype(B.dtype), B.layout))
-    return Xm, info, iters
+# Mixed-precision SPD solvers: implementations live in
+# drivers/mixed.py, routed through the refine/ subsystem (policy +
+# IR/GMRES-IR cores); re-exported here for reference-parity import
+# paths (chol.posv_mixed).
+from .mixed import posv_mixed, posv_mixed_gmres  # noqa: E402,F401
 
 
 def pocondest(
@@ -277,40 +222,3 @@ def pocondest(
     return jnp.where(jnp.isfinite(rcond), rcond, 0.0)
 
 
-@instrumented("posv_mixed_gmres")
-def posv_mixed_gmres(
-    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
-) -> Tuple[Matrix, jnp.ndarray, int]:
-    """Mixed-precision SPD solve with GMRES(30) refinement, f32 Cholesky
-    preconditioner (reference: src/posv_mixed_gmres.cc — the SPD variant
-    of gesv_mixed_gmres; shares the GMRES-IR core with the LU variant)."""
-    from .lu import gmres_ir_solve
-
-    lo_t = np.complex64 if A.is_complex else np.float32
-    A_full = A.full_global()
-    B2 = B.to_global()
-    L_lo = chol_kernels.cholesky(A_full.astype(lo_t))
-
-    def precond(R):
-        Y = lax.linalg.triangular_solve(
-            L_lo, R.astype(lo_t), left_side=True, lower=True
-        )
-        Z = lax.linalg.triangular_solve(
-            L_lo, Y, left_side=True, lower=True, transpose_a=True,
-            conjugate_a=A.is_complex,
-        )
-        return Z.astype(B2.dtype)
-
-    def fallback_solve(B2):
-        Lw = chol_kernels.cholesky(A_full)
-        Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)
-        return lax.linalg.triangular_solve(
-            Lw, Y, left_side=True, lower=True, transpose_a=True,
-            conjugate_a=A.is_complex,
-        )
-
-    X, info, iters = gmres_ir_solve(
-        A_full, B2, precond, fallback_solve, _norm(Norm.Inf, A), opts
-    )
-    Xm = B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard()
-    return Xm, info, iters

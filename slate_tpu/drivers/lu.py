@@ -65,24 +65,6 @@ def _padded_global(A: BaseMatrix, splice_diag=True) -> jnp.ndarray:
     return Gp
 
 
-def _lu_dense(A2: jnp.ndarray, nb: int = 32) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """LU of an unpadded square array with platform dispatch; returns
-    (LU[:n,:n], perm[:n]).  Pads to a multiple of nb with a unit diagonal
-    so the native blocked kernel sees static full tiles."""
-    n = A2.shape[0]
-    if lu_kernels.lu_supported(A2.dtype):
-        lu2d, _, perm = lax.linalg.lu(A2)
-        return lu2d, perm.astype(jnp.int32)
-    npad = -(-n // nb) * nb
-    Gp = jnp.pad(A2, ((0, npad - n), (0, npad - n)))
-    Gp = Gp + jnp.diag(
-        jnp.concatenate([jnp.zeros(n), jnp.ones(npad - n)]).astype(A2.dtype)
-    )
-    LU, perm = lu_kernels.blocked_getrf(Gp, nb)
-    # padding rows can never be pivoted into the leading n rows
-    return LU[:n, :n], perm[:n]
-
-
 def _udiag_info(LU: Matrix, lay) -> jnp.ndarray:
     """info code: exact zero / non-finite on U's diagonal.
 
@@ -464,187 +446,14 @@ def getri(LU: Matrix, pivots: Pivots, opts: Optional[Options] = None) -> Matrix:
     return getrs(LU, pivots, eye, opts)
 
 
-def ir_refine_while(A2, B2, solve_lo, tol, anorm, max_it):
-    """Device-resident iterative refinement (reference: the IR loop of
-    src/gesv_mixed.cc:90-160, which runs inside the device schedule).
+# Mixed-precision solvers: implementations live in drivers/mixed.py,
+# routed through the refine/ subsystem (policy + IR/GMRES-IR cores);
+# re-exported here for reference-parity import paths (lu.gesv_mixed).
+from .mixed import gesv_mixed, gesv_mixed_gmres  # noqa: E402,F401
 
-    One lax.while_loop — a single dispatch instead of ~2 per iteration
-    (each of which pays the ~100 ms tunnel latency on this chip); the
-    host reads back only the final (X, iters, converged).  HIGHEST-
-    precision residual matmul (the TPU f64 emulation default
-    accumulates at ~f32 grade, which would stall convergence)."""
-    # real dtype always: a complex anorm would make the <= comparison
-    # below ill-typed for complex systems
-    anorm = jnp.asarray(anorm, jnp.abs(B2).dtype)
-
-    def cond(carry):
-        X, it, done = carry
-        return (~done) & (it < max_it)
-
-    def body(carry):
-        X, it, _ = carry
-        R = B2 - jnp.matmul(A2, X, precision=lax.Precision.HIGHEST)
-        conv = jnp.abs(R).max() <= tol * anorm * jnp.abs(X).max() + 1e-300
-        Xn = jnp.where(conv, X, X + solve_lo(R))
-        # count only actual refinement steps (a run that converges on
-        # the first residual check reports 0, like the host-loop did)
-        return Xn, it + jnp.where(conv, 0, 1), conv
-
-    X0 = solve_lo(B2)
-    X, iters, converged = lax.while_loop(
-        cond, body, (X0, jnp.int32(0), jnp.bool_(False))
-    )
-    return X, iters, converged
-
-
-@instrumented("gesv_mixed")
-def gesv_mixed(
-    A: Matrix, B: Matrix, opts: Optional[Options] = None
-) -> Tuple[Matrix, jnp.ndarray, int]:
-    """Mixed-precision LU solve with iterative refinement (reference:
-    src/gesv_mixed.cc: f32 factor + f64 refinement; easy win on TPU where
-    f32 MXU throughput >> f64 emulation, SURVEY §7 step 5).
-
-    Returns (X, info, iters); iters < 0 => full-precision fallback used."""
-    lo_t = np.complex64 if A.is_complex else np.float32
-    max_it = int(get_option(opts, Option.MaxIterations, 30))
-    use_fallback = bool(get_option(opts, Option.UseFallbackSolver, True))
-    A2 = A.to_global()
-    B2 = B.to_global()
-    work_eps = float(jnp.finfo(B2.dtype).eps)
-    tol = float(get_option(opts, Option.Tolerance, np.sqrt(A.n) * work_eps))
-    anorm = _norm(Norm.Inf, A)
-
-    lu_lo, _, perm = lax.linalg.lu(A2.astype(lo_t))
-
-    def solve_lo(R):
-        Rp = R.astype(lo_t)[perm]
-        Y = lax.linalg.triangular_solve(
-            lu_lo, Rp, left_side=True, lower=True, unit_diagonal=True
-        )
-        Z = lax.linalg.triangular_solve(lu_lo, Y, left_side=True, lower=False)
-        return Z.astype(B2.dtype)
-
-    X, iters_dev, converged = ir_refine_while(
-        A2, B2, solve_lo, tol, anorm, max_it
-    )
-    iters = int(iters_dev)
-    if not bool(converged) and use_fallback:
-        lu_w, perm_w = _lu_dense(A2)
-        Y = lax.linalg.triangular_solve(
-            lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True
-        )
-        X = lax.linalg.triangular_solve(lu_w, Y, left_side=True, lower=False)
-        iters = -max_it
-    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
-    return (
-        B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard(),
-        info,
-        iters,
-    )
-
-
-def gmres_ir_solve(
-    A2: jnp.ndarray,
-    B2: jnp.ndarray,
-    precond,
-    fallback_solve,
-    anorm,
-    opts: Optional[Options] = None,
-    restart: int = 30,
-) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
-    """Shared GMRES(restart)-based iterative refinement core used by
-    gesv_mixed_gmres and posv_mixed_gmres (reference:
-    src/gesv_mixed_gmres.cc:110-165 — right-preconditioned GMRES per
-    column, residual acceptance test, full-precision fallback).
-
-    Returns (X, info, iters); iters < 0 means the fallback ran."""
-    work_eps = float(jnp.finfo(B2.dtype).eps)
-    n = A2.shape[0]
-    tol = float(get_option(opts, Option.Tolerance, np.sqrt(n) * work_eps))
-
-    def gmres_col(b):
-        x0 = precond(b[:, None])[:, 0]
-        r0 = b - A2 @ x0
-        beta = jnp.linalg.norm(r0)
-
-        # right-preconditioned GMRES(restart) — one cycle
-        V = jnp.zeros((restart + 1, n), B2.dtype)
-        H = jnp.zeros((restart + 1, restart), B2.dtype)
-        V = V.at[0].set(r0 / jnp.where(beta == 0, 1, beta))
-
-        def arnoldi(j, carry):
-            V, H = carry
-            w = A2 @ precond(V[j][:, None])[:, 0]
-            # modified Gram-Schmidt
-            def mgs(i, wh):
-                w, H = wh
-                hij = jnp.vdot(V[i], w)
-                H = H.at[i, j].set(hij)
-                return w - hij * V[i], H
-
-            w, H = lax.fori_loop(0, j + 1, mgs, (w, H))
-            hn = jnp.linalg.norm(w)
-            H = H.at[j + 1, j].set(hn)
-            V = V.at[j + 1].set(w / jnp.where(hn == 0, 1, hn))
-            return V, H
-
-        V, H = lax.fori_loop(0, restart, arnoldi, (V, H))
-        e1 = jnp.zeros(restart + 1, B2.dtype).at[0].set(beta)
-        y, *_ = jnp.linalg.lstsq(H, e1)
-        return x0 + precond((V[:restart].T @ y)[:, None])[:, 0]
-
-    X = jax.vmap(gmres_col, in_axes=1, out_axes=1)(B2)
-    # refinement verification + fallback
-    R = B2 - A2 @ X
-    ok = bool(
-        jnp.abs(R).max()
-        <= 10 * tol * float(anorm) * float(jnp.abs(X).max()) + 1e-300
-    )
-    iters = restart
-    if not ok and bool(get_option(opts, Option.UseFallbackSolver, True)):
-        X = fallback_solve(B2)
-        iters = -restart
-    info = jnp.where(jnp.all(jnp.isfinite(X)), 0, 1).astype(jnp.int32)
-    return X, info, iters
-
-
-@instrumented("gesv_mixed_gmres")
-def gesv_mixed_gmres(
-    A: Matrix, B: Matrix, opts: Optional[Options] = None
-) -> Tuple[Matrix, jnp.ndarray, int]:
-    """Mixed-precision solve with GMRES(30)-based refinement, LU
-    preconditioner in low precision (reference: src/gesv_mixed_gmres.cc:
-    restart 30, fallback on divergence).  Single-RHS GMRES applied per
-    column."""
-    A2 = A.to_global()
-    B2 = B.to_global()
-    lo_t = np.complex64 if A.is_complex else np.float32
-    lu_lo, _, perm = lax.linalg.lu(A2.astype(lo_t))
-
-    def precond(R):
-        Rp = R.astype(lo_t)[perm]
-        Y = lax.linalg.triangular_solve(
-            lu_lo, Rp, left_side=True, lower=True, unit_diagonal=True
-        )
-        Z = lax.linalg.triangular_solve(lu_lo, Y, left_side=True, lower=False)
-        return Z.astype(B2.dtype)
-
-    def fallback_solve(B2):
-        lu_w, perm_w = _lu_dense(A2)
-        Y = lax.linalg.triangular_solve(
-            lu_w, B2[perm_w], left_side=True, lower=True, unit_diagonal=True
-        )
-        return lax.linalg.triangular_solve(lu_w, Y, left_side=True, lower=False)
-
-    X, info, iters = gmres_ir_solve(
-        A2, B2, precond, fallback_solve, _norm(Norm.Inf, A), opts
-    )
-    return (
-        B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard(),
-        info,
-        iters,
-    )
+# Back-compat shim for the pre-refine/ helper name (the IR while_loop
+# used to live here; chol.py and external callers imported it).
+from ..refine.ir import ir_refine_while  # noqa: E402,F401
 
 
 @instrumented("gecondest")

@@ -1,0 +1,431 @@
+"""Mixed-precision solve drivers (reference: src/gesv_mixed.cc,
+gesv_mixed_gmres.cc, posv_mixed.cc, posv_mixed_gmres.cc), routed
+through the :mod:`slate_tpu.refine` subsystem.
+
+The shape shared by all four drivers:
+
+1. **Factor once in the cheap precision** (``refine.policy`` picks the
+   pair: f32/c64 for f64/c128 working everywhere, bf16 for f32 on
+   accelerators) — the factor step reuses the schedule-dispatched
+   kernels behind :func:`~slate_tpu.drivers.lu.getrf` /
+   :func:`~slate_tpu.drivers.chol.potrf` (``ops/lu_kernels.lu_global``,
+   ``ops/chol_kernels.cholesky``), so ``Option.Schedule`` routes the
+   low-precision factor exactly like the full-precision one (vendor on
+   CPU, recursive above the crossover on accelerators).
+2. **Refine in working precision**: classical IR
+   (:func:`refine.ir.refine_while`) or restarted GMRES-IR
+   (:func:`refine.gmres.gmres_refine`), per ``Option.RefineMethod``;
+   componentwise-backward-error stopping, residual under
+   ``accurate_matmul`` semantics.
+3. **Fallback**: on non-convergence (or an injected factor fault) and
+   ``Option.UseFallbackSolver`` (default True), demote to one
+   full-precision direct solve and report ``iters < 0``
+   (gesv_mixed_gmres.cc:100-106).  With the fallback disabled, a
+   non-converged solve returns ``info > 0`` — never silent garbage.
+
+Returns follow the reference: ``(X, info, iters)`` with ``iters < 0``
+marking the fallback.  The drivers are **eager** (they read back
+``iters``/``converged`` to run the host-side fallback branch); the
+serving layer's traced executables use :func:`serve_mixed_core`, which
+keeps everything device-resident and NaN-poisons non-converged columns
+so the service's corrupt-result validation re-solves them on the
+full-precision direct path and the bucket breaker demotes persistent
+offenders.
+
+Fault sites (``aux/faults``, zero overhead off): the *factor step*
+checks ``result_corrupt`` (NaN-poisons the low-precision factor) and
+``info_nonzero`` (reports a fake nonzero factor info) — both drive the
+refinement into the fallback path, which is exactly the recovery the
+chaos suite asserts.
+
+Metrics: ``refine.calls`` / ``refine.iterations`` /
+``refine.converged`` / ``refine.fallbacks`` counters plus the
+``refine.residual`` gauge (final componentwise backward error), global
+and per-routine (``refine.gesv_mixed.*`` etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..aux import faults, metrics
+from ..aux.metrics import instrumented
+from ..enums import Option, RefineMethod
+from ..matrix.matrix import HermitianMatrix, Matrix
+from ..options import Options, get_option, resolve_schedule_opts
+from ..ops import chol_kernels, lu_kernels
+from ..parallel.layout import tiles_from_global
+from ..refine import gmres as _gmres
+from ..refine import ir as _ir
+from ..refine import policy as _policy
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def _record(routine: str, iters: int, converged: bool, berr: float) -> None:
+    if not metrics.is_on():
+        return
+    for name in ("refine", f"refine.{routine}"):
+        metrics.inc(f"{name}.calls")
+        metrics.inc(f"{name}.iterations", iters)
+        if converged:
+            metrics.inc(f"{name}.converged")
+        metrics.gauge(f"{name}.residual", berr)
+
+
+def _record_fallback(routine: str) -> None:
+    metrics.inc("refine.fallbacks")
+    metrics.inc(f"refine.{routine}.fallbacks")
+
+
+# ---------------------------------------------------------------------------
+# low-precision factor step (schedule-routed, fault-checked)
+# ---------------------------------------------------------------------------
+
+
+def _inject_factor_faults(factor: jnp.ndarray) -> Tuple[jnp.ndarray, int]:
+    """Factor-step fault sites (eager drivers only; one bool when off):
+    ``result_corrupt`` NaN-poisons the factor, ``info_nonzero`` reports
+    a fake nonzero factor info.  Either way the refinement loop sees a
+    useless factor and the fallback solver is exercised."""
+    if not faults.is_on():
+        return factor, 0
+    factor = jnp.asarray(faults.corrupt("result_corrupt", np.asarray(factor)))
+    finfo = int(faults.poison_info("info_nonzero", np.zeros(1, np.int32))[0])
+    return factor, finfo
+
+
+def _pad_unit_diag(G: jnp.ndarray, npad: int) -> jnp.ndarray:
+    """Embed G in the top-left of an npad x npad array with a unit
+    trailing diagonal (blockdiag(A, I): factors restrict exactly, pad
+    rows are never pivoted into real columns — the serve pad invariant)."""
+    n = G.shape[0]
+    if npad == n:
+        return G
+    Gp = jnp.pad(G, ((0, npad - n), (0, npad - n)))
+    idx = jnp.arange(npad)
+    return Gp.at[idx, idx].add(
+        jnp.where(idx >= n, 1.0, 0.0).astype(G.dtype)
+    )
+
+
+def _lu_solver_lo(
+    A2: jnp.ndarray,
+    pol: _policy.Policy,
+    nb: int,
+    opts: Optional[Options],
+    inject: bool,
+    apply_up: bool = False,
+):
+    """Low-precision LU factor of A2 + the solve closure.  Returns
+    (solve, factor_info).
+
+    ``apply_up=False`` (classical IR) casts the residual down and
+    solves in the factor precision — gesv_mixed.cc semantics, the
+    cheapest correction step.  ``apply_up=True`` (GMRES-IR) upcasts
+    the factors once and applies them in the working precision: the
+    Krylov matvec must see the preconditioned operator exactly in
+    precision u (Carson & Higham SISC 2018) — an eps_factor-perturbed
+    operator stalls GMRES at berr ~ eps_factor, no better than IR."""
+    sched, nb_switch, lookahead = resolve_schedule_opts(opts)
+    n = A2.shape[0]
+    nb = max(min(int(nb), n), 1)
+    npad = -(-n // nb) * nb
+    Gp = _pad_unit_diag(pol.factor_cast(A2), npad)
+    lu_lo, perm = lu_kernels.lu_global(Gp, nb, sched, nb_switch, lookahead)
+    finfo = 0
+    if inject:
+        lu_lo, finfo = _inject_factor_faults(lu_lo)
+    lu_lo = lu_lo[:n, :n]
+    perm = perm[:n]
+    fac = lu_lo.astype(A2.dtype) if apply_up else lu_lo
+
+    def solve(R):
+        Rp = (R if apply_up else pol.factor_cast(R))[perm]
+        Y = lax.linalg.triangular_solve(
+            fac, Rp, left_side=True, lower=True, unit_diagonal=True
+        )
+        Z = lax.linalg.triangular_solve(fac, Y, left_side=True, lower=False)
+        return Z.astype(R.dtype)
+
+    return solve, finfo
+
+
+def _chol_solver_lo(
+    A_full: jnp.ndarray,
+    pol: _policy.Policy,
+    nb: int,
+    opts: Optional[Options],
+    conj: bool,
+    inject: bool,
+    apply_up: bool = False,
+):
+    """Low-precision Cholesky of the (full, Hermitian) A + the solve
+    closure.  Returns (solve, factor_info).  ``apply_up`` as in
+    :func:`_lu_solver_lo`: GMRES-IR applies the upcast factors in the
+    working precision."""
+    sched, nb_switch, lookahead = resolve_schedule_opts(opts)
+    n = A_full.shape[0]
+    nb_kernel = 512 if n >= 2048 else max(min(int(nb), 512), 1)
+    L_lo = chol_kernels.cholesky(
+        pol.factor_cast(A_full), nb_kernel, sched, nb_switch, lookahead
+    )
+    finfo = 0
+    if inject:
+        L_lo, finfo = _inject_factor_faults(L_lo)
+    fac = L_lo.astype(A_full.dtype) if apply_up else L_lo
+
+    def solve(R):
+        Y = lax.linalg.triangular_solve(
+            fac, R if apply_up else pol.factor_cast(R),
+            left_side=True, lower=True,
+        )
+        Z = lax.linalg.triangular_solve(
+            fac, Y, left_side=True, lower=True, transpose_a=True,
+            conjugate_a=conj,
+        )
+        return Z.astype(R.dtype)
+
+    return solve, finfo
+
+
+# ---------------------------------------------------------------------------
+# full-precision fallback solves
+# ---------------------------------------------------------------------------
+
+
+def _full_lu_solve(A2: jnp.ndarray, B2: jnp.ndarray, nb: int) -> jnp.ndarray:
+    n = A2.shape[0]
+    if lu_kernels.lu_supported(A2.dtype):
+        lu_w, _, perm = lax.linalg.lu(A2)
+        perm = perm.astype(jnp.int32)
+    else:
+        npad = -(-n // max(nb, 1)) * max(nb, 1)
+        lu_w, perm = lu_kernels.blocked_getrf(
+            _pad_unit_diag(A2, npad), max(nb, 1)
+        )
+        lu_w, perm = lu_w[:n, :n], perm[:n]
+    Y = lax.linalg.triangular_solve(
+        lu_w, B2[perm], left_side=True, lower=True, unit_diagonal=True
+    )
+    return lax.linalg.triangular_solve(lu_w, Y, left_side=True, lower=False)
+
+
+def _full_chol_solve(A_full: jnp.ndarray, B2: jnp.ndarray, conj: bool) -> jnp.ndarray:
+    Lw = chol_kernels.cholesky(A_full)
+    Y = lax.linalg.triangular_solve(Lw, B2, left_side=True, lower=True)
+    return lax.linalg.triangular_solve(
+        Lw, Y, left_side=True, lower=True, transpose_a=True, conjugate_a=conj
+    )
+
+
+# ---------------------------------------------------------------------------
+# refinement dispatch (shared by all four drivers)
+# ---------------------------------------------------------------------------
+
+
+def _gmres_selected(pol: _policy.Policy) -> bool:
+    """True when the resolved method is GMRES-IR, which needs the
+    preconditioner applied in working precision (``apply_up``): the
+    Krylov matvec must see U^-1 L^-1 A exactly in precision u, or GMRES
+    stalls at berr ~ eps_factor — no better than classical IR."""
+    return pol.method == RefineMethod.GMRES.value
+
+
+def _refine(A2, B2, solve_lo, pol: _policy.Policy):
+    """Run the policy's method; returns (X, iters, steps, converged,
+    berr).  ``iters`` keeps the reference's reporting unit (IR steps,
+    or GMRES *inner* iterations = cycles * restart); ``steps`` is the
+    method-independent refinement-step count (one GMRES cycle == one
+    step) that feeds the iterations counter — refine_report's
+    mean_iters column must not mix units across methods."""
+    if pol.method == RefineMethod.GMRES.value:
+        # one GMRES(restart) cycle is one refinement step, so the
+        # outer-cycle budget is MaxIterations (a converged run exits the
+        # while_loop early; unconverged cost is bounded by the fallback)
+        res = _gmres.gmres_refine(
+            A2, B2, solve_lo, pol.tolerance, pol.restart,
+            max(1, pol.max_iterations),
+        )
+        return res.X, res.cycles * pol.restart, res.cycles, res.converged, res.berr
+    res = _ir.refine_while(A2, B2, solve_lo, pol.tolerance, pol.max_iterations)
+    return res.X, res.iters, res.iters, res.converged, res.berr
+
+
+def _finish(
+    routine: str,
+    B: Matrix,
+    X,
+    iters_dev,
+    steps_dev,
+    conv_dev,
+    berr_dev,
+    finfo: int,
+    pol: _policy.Policy,
+    fallback_solve,
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Host-side epilogue: metrics, fallback, info.  One readback."""
+    iters = int(iters_dev)
+    converged = bool(conv_dev) and finfo == 0
+    _record(routine, int(steps_dev), converged, float(jnp.real(berr_dev)))
+    info = jnp.int32(0)
+    if not converged:
+        if pol.use_fallback:
+            _record_fallback(routine)
+            X = fallback_solve()
+            iters = -max(pol.max_iterations, 1)
+        else:
+            # no fallback requested: a non-converged solve must surface
+            # as a nonzero info, never as silently-wrong finite output
+            info = jnp.int32(max(finfo, pol.max_iterations, 1))
+    info = jnp.where(
+        jnp.all(jnp.isfinite(X)), info, jnp.int32(1)
+    ).astype(jnp.int32)
+    Xm = B._with(data=tiles_from_global(X.astype(B.dtype), B.layout)).shard()
+    return Xm, info, iters
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+@instrumented("gesv_mixed")
+def gesv_mixed(
+    A: Matrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision LU solve with iterative refinement (reference:
+    src/gesv_mixed.cc: low-precision factor + working-precision IR).
+
+    Returns (X, info, iters); iters < 0 => full-precision fallback ran."""
+    A2 = A.to_global()
+    B2 = B.to_global()
+    pol = _policy.select(A2.dtype, A.n, opts)
+    solve_lo, finfo = _lu_solver_lo(
+        A2, pol, A.layout.nb, opts, inject=True, apply_up=_gmres_selected(pol)
+    )
+    X, iters, steps, conv, berr = _refine(A2, B2, solve_lo, pol)
+    return _finish(
+        "gesv_mixed", B, X, iters, steps, conv, berr, finfo, pol,
+        lambda: _full_lu_solve(A2, B2, A.layout.nb),
+    )
+
+
+@instrumented("gesv_mixed_gmres")
+def gesv_mixed_gmres(
+    A: Matrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision solve with restarted GMRES-IR, LU preconditioner
+    in low precision (reference: src/gesv_mixed_gmres.cc: restart 30,
+    fallback on divergence).  Survives ~1/eps_factor more
+    ill-conditioning than gesv_mixed (Carson & Higham SISC 2018)."""
+    A2 = A.to_global()
+    B2 = B.to_global()
+    pol = _policy.select(A2.dtype, A.n, opts, method_default=RefineMethod.GMRES)
+    solve_lo, finfo = _lu_solver_lo(
+        A2, pol, A.layout.nb, opts, inject=True, apply_up=_gmres_selected(pol)
+    )
+    X, iters, steps, conv, berr = _refine(A2, B2, solve_lo, pol)
+    return _finish(
+        "gesv_mixed_gmres", B, X, iters, steps, conv, berr, finfo, pol,
+        lambda: _full_lu_solve(A2, B2, A.layout.nb),
+    )
+
+
+@instrumented("posv_mixed")
+def posv_mixed(
+    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision SPD solve: low-precision Cholesky + working-
+    precision IR (reference: src/posv_mixed.cc)."""
+    A_full = A.full_global()
+    B2 = B.to_global()
+    pol = _policy.select(A_full.dtype, A.n, opts)
+    solve_lo, finfo = _chol_solver_lo(
+        A_full, pol, A.layout.nb, opts, A.is_complex, inject=True,
+        apply_up=_gmres_selected(pol),
+    )
+    X, iters, steps, conv, berr = _refine(A_full, B2, solve_lo, pol)
+    return _finish(
+        "posv_mixed", B, X, iters, steps, conv, berr, finfo, pol,
+        lambda: _full_chol_solve(A_full, B2, A.is_complex),
+    )
+
+
+@instrumented("posv_mixed_gmres")
+def posv_mixed_gmres(
+    A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None
+) -> Tuple[Matrix, jnp.ndarray, int]:
+    """Mixed-precision SPD solve with GMRES-IR, low-precision Cholesky
+    preconditioner (reference: src/posv_mixed_gmres.cc — shares the
+    GMRES-IR core with the LU variant)."""
+    A_full = A.full_global()
+    B2 = B.to_global()
+    pol = _policy.select(
+        A_full.dtype, A.n, opts, method_default=RefineMethod.GMRES
+    )
+    solve_lo, finfo = _chol_solver_lo(
+        A_full, pol, A.layout.nb, opts, A.is_complex, inject=True,
+        apply_up=_gmres_selected(pol),
+    )
+    X, iters, steps, conv, berr = _refine(A_full, B2, solve_lo, pol)
+    return _finish(
+        "posv_mixed_gmres", B, X, iters, steps, conv, berr, finfo, pol,
+        lambda: _full_chol_solve(A_full, B2, A.is_complex),
+    )
+
+
+# ---------------------------------------------------------------------------
+# serving-layer traced core
+# ---------------------------------------------------------------------------
+
+
+def serve_mixed_core(
+    routine: str,
+    Ag: jnp.ndarray,
+    Bg: jnp.ndarray,
+    nb: int,
+    schedule: str = "auto",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fully-traceable mixed-precision core for one serve bucket
+    (``BucketKey(precision="mixed")``): classical IR only (the jit-able
+    ``while_loop`` method), no host branches, no fallback *inside* the
+    trace.  Non-converged solves NaN-poison X instead: the service's
+    corrupt-result validation then re-solves those items on the
+    full-precision direct driver and records a breaker failure, so a
+    bucket whose traffic persistently defeats the mixed path has its
+    breaker opened and is demoted to the direct path — recovery stays
+    in the serving layer where the policy (retry budgets, cooldowns)
+    lives, not in the executable.
+
+    ``posv`` references only the lower triangle of ``Ag`` (the serve
+    contract) — the Hermitian full matrix is rebuilt in-trace for the
+    residual."""
+    opts = {Option.Schedule: schedule}
+    if routine == "posv":
+        T = jnp.tril(Ag)
+        # strictly-upper = conj of strictly-lower; the stored diagonal
+        # is kept exactly (the direct posv core's Hermitian contract)
+        A2 = T + jnp.conj(jnp.tril(Ag, -1)).swapaxes(-1, -2)
+        conj = jnp.issubdtype(Ag.dtype, jnp.complexfloating)
+        pol = _policy.select(Ag.dtype, Ag.shape[0], opts)
+        solve_lo, _ = _chol_solver_lo(A2, pol, nb, opts, bool(conj), inject=False)
+    elif routine == "gesv":
+        A2 = Ag
+        pol = _policy.select(Ag.dtype, Ag.shape[0], opts)
+        solve_lo, _ = _lu_solver_lo(A2, pol, nb, opts, inject=False)
+    else:
+        raise ValueError(
+            f"mixed-precision serving supports gesv/posv, not {routine!r}"
+        )
+    res = _ir.refine_while(A2, Bg, solve_lo, pol.tolerance, pol.max_iterations)
+    nan = jnp.asarray(jnp.nan, res.X.dtype)
+    X = jnp.where(res.converged, res.X, nan)
+    return X, jnp.zeros((), jnp.int32)

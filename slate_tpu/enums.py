@@ -228,6 +228,34 @@ class MethodSVD(_StrParseMixin, enum.Enum):
         return {"*": ("auto",), "Q": ("qr",), "D": ("dc",), "B": ()}[self.value]
 
 
+class RefineMethod(_StrParseMixin, enum.Enum):
+    """Mixed-precision refinement algorithm (slate_tpu extension over
+    the reference's fixed pairing of gesv_mixed = classical IR and
+    gesv_mixed_gmres = GMRES-IR; here one Option selects the method so
+    serve buckets and sweeps can switch without changing routine names):
+
+    * ``IR``    — classical iterative refinement (Wilkinson; reference
+      src/gesv_mixed.cc): correct with the low-precision factors,
+      residual in working precision.  Converges when
+      cond(A) * eps_factor is safely below 1.
+    * ``GMRES`` — restarted GMRES-IR preconditioned by the low-precision
+      factors (reference src/gesv_mixed_gmres.cc; Carson & Higham SISC
+      2018): survives roughly a factor 1/eps_factor more
+      ill-conditioning than classical IR at extra FLOPs per iteration.
+    * ``Auto``  — classical IR (the cheap path; callers wanting the
+      robust path use the ``*_mixed_gmres`` drivers or set GMRES).
+    """
+
+    Auto = "auto"
+    IR = "ir"
+    GMRES = "gmres"
+
+    def aliases(self):
+        return {"auto": ("*",), "ir": ("classical",), "gmres": ("gmres_ir",)}[
+            self.value
+        ]
+
+
 class Schedule(_StrParseMixin, enum.Enum):
     """Factorization schedule family (slate_tpu extension; no reference
     analogue — the reference gets exact-shape trailing updates for free
@@ -309,6 +337,7 @@ class Option(enum.Enum):
     MethodSVD = "method_svd"
     # slate_tpu extensions
     Schedule = "schedule"  # factorization schedule: flat|recursive|auto
+    RefineMethod = "refine_method"  # mixed-precision refinement: ir|gmres|auto
     MaxUnrolledTiles = "max_unrolled_tiles"  # unroll k-loop below this nt
     UseShardMap = "use_shard_map"  # explicit SPMD fast path vs GSPMD
     RequireSpmd = "require_spmd"  # error instead of gathered fallback
@@ -319,6 +348,7 @@ class Option(enum.Enum):
     ServeRetryBackoff = "serve_retry_backoff"  # backoff base, seconds
     ServeBreakerCooldown = "serve_breaker_cooldown"  # open -> half-open, s
     ServeValidate = "serve_validate"  # admission finiteness checks
+    ServePrecision = "serve_precision"  # bucket solve precision: full|mixed
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

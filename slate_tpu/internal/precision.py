@@ -35,6 +35,19 @@ def _has32(x) -> bool:
         return False
 
 
+def _any32(x) -> bool:
+    """Matrix-aware 32-bit scan that also looks INSIDE sequences: the
+    mixed drivers pass factor tuples / lists of matrices, which the old
+    top-level-only scan treated as "no 32-bit operand" — silently
+    running an f32 refinement at bf16-pass precision (the displaced-
+    decorator failure mode the activation counter exists to catch)."""
+    if isinstance(x, (list, tuple)):
+        return any(_any32(e) for e in x)
+    if isinstance(x, dict):
+        return any(_any32(e) for e in x.values())
+    return _has32(x)
+
+
 def fast_f32() -> bool:
     return os.environ.get("SLATE_TPU_FAST_F32", "0") not in ("", "0")
 
@@ -51,7 +64,7 @@ def accurate_matmul(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kw):
         if not fast_f32() and any(
-            _has32(a) for a in list(args) + list(kw.values())
+            _any32(a) for a in list(args) + list(kw.values())
         ):
             from ..aux import metrics
 

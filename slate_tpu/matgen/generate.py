@@ -412,3 +412,34 @@ def generate(
     """Convenience constructor: generate a fresh distributed Matrix."""
     G, _ = generate_2d(kind, m, n, dtype, seed=seed, cond=cond)
     return Matrix.from_global(G, mb, nb, grid=grid)
+
+
+def cond_matrix(
+    n: int,
+    cond: float,
+    dtype=np.float64,
+    seed: int = 42,
+    spd: bool = False,
+) -> np.ndarray:
+    """Deterministic n x n matrix with **specified 2-norm condition
+    number** via scaled-singular-value construction: A = U diag(s) V^H
+    with s geometrically spaced from 1 down to 1/cond (``geo``
+    distribution, generate_sigma.hh:39-130) and Philox-seeded random
+    orthogonal factors — so sigma_max = 1, sigma_min = 1/cond and
+    cond_2(A) = cond *exactly by construction*, bit-reproducible for a
+    given seed.
+
+    ``spd=True`` uses one orthogonal factor (A = U diag(s) U^H, the
+    ``poev`` construction): symmetric/Hermitian positive definite with
+    the same 2-norm condition number.
+
+    The knob the refine/ tests are built on: iterative-refinement
+    convergence (cond such that cond * eps_factor << 1), stall
+    (~1/eps_factor — where GMRES-IR still converges), and divergence +
+    fallback (>> 1/eps_factor) become deterministic properties of the
+    requested cond instead of luck-of-the-draw spectra."""
+    if cond < 1:
+        raise SlateError(f"cond must be >= 1, got {cond}")
+    kind = "poev_geo" if spd else "svd_geo"
+    G, _ = generate_2d(kind, n, n, dtype, seed=seed, cond=float(cond))
+    return np.asarray(G)

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Mapping, Optional, Union
 
-from .enums import Option, Schedule
+from .enums import Option, RefineMethod, Schedule
 from .exceptions import OptionError
 
 OptionKey = Union[Option, str]
@@ -41,6 +41,7 @@ _DEFAULTS = {
     Option.UseShardMap: True,
     Option.RequireSpmd: False,
     Option.Schedule: Schedule.Auto,
+    Option.RefineMethod: RefineMethod.Auto,
     Option.ServeQueueLimit: 128,
     Option.ServeBatchMax: 8,
     Option.ServeBatchWindow: 0.002,
@@ -50,6 +51,7 @@ _DEFAULTS = {
     # how long an open bucket breaker waits before a half-open probe
     Option.ServeBreakerCooldown: 5.0,
     Option.ServeValidate: True,
+    Option.ServePrecision: "full",  # bucket solve precision (full|mixed)
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

@@ -1,0 +1,53 @@
+"""slate_tpu.refine — mixed-precision iterative-refinement solvers.
+
+The fourth major subsystem (alongside ``aux/``, ``serve/``,
+``parallel/``): factor once in a cheap precision, refine the solution
+in the working precision (reference: SLATE's gesv_mixed /
+gesv_mixed_gmres / posv_mixed family, src/gesv_mixed.cc; Carson &
+Higham SISC 2018 for the three-precision framework).  TPUs are the
+single best target for the idea — the MXU runs bf16/f32 passes several
+times faster than the emulated-f64 path the full-precision drivers pay
+end to end.
+
+Layout:
+
+* :mod:`.policy` — precision-pair selection (working/factor/residual),
+  backend-aware, routed through ``Option.MaxIterations`` /
+  ``Option.Tolerance`` / ``Option.UseFallbackSolver`` /
+  ``Option.RefineMethod``.
+* :mod:`.ir` — classical IR: jit-able ``while_loop`` with the residual
+  under ``accurate_matmul`` semantics and a componentwise
+  backward-error stopping test.
+* :mod:`.gmres` — restarted GMRES-IR preconditioned by the
+  low-precision factors (survives ~1/eps_factor more ill-conditioning
+  than classical IR).
+
+The user-facing drivers live in :mod:`slate_tpu.drivers.mixed`
+(``gesv_mixed``, ``posv_mixed``, ``*_mixed_gmres``); the serving layer
+solves warmed buckets in mixed precision via
+``BucketKey(precision="mixed")`` with the circuit breaker demoting to
+the full-precision direct path on repeated non-convergence.
+"""
+
+from .gmres import GmresResult, gmres_refine
+from .ir import RefineResult, backward_error, refine_while
+from .policy import (
+    GMRES_RESTART,
+    Policy,
+    default_tolerance,
+    factor_dtype,
+    select,
+)
+
+__all__ = [
+    "GMRES_RESTART",
+    "GmresResult",
+    "Policy",
+    "RefineResult",
+    "backward_error",
+    "default_tolerance",
+    "factor_dtype",
+    "gmres_refine",
+    "refine_while",
+    "select",
+]

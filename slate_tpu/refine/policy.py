@@ -1,0 +1,156 @@
+"""Precision-pair selection for mixed-precision refinement.
+
+A mixed-precision solve is shaped by three dtypes (Carson & Higham,
+SIAM SISC 2018 — "iterative refinement in three precisions", and the
+reference's gesv_mixed.cc which fixes the pair f64/f32):
+
+* **working** — the dtype of the inputs and of the returned solution;
+  the accuracy contract is stated in this precision's eps.
+* **factor**  — the dtype the O(n^3) factorization runs in.  The whole
+  point: on TPU the MXU runs bf16/f32 passes several times faster than
+  the emulated-f64 path the full-precision drivers pay end to end.
+* **residual** — the dtype the O(n^2) residual is evaluated in.  No
+  wider-than-working dtype exists on this hardware, so the residual is
+  computed *in* working precision but under ``accurate_matmul``
+  semantics (``Precision.HIGHEST`` / ``internal.precision.hdot``),
+  which restores the exact-width accumulation Carson & Higham's
+  u_r <= u^2 analysis wants from a wider format.
+
+Pairs are backend-aware (:func:`factor_dtype`):
+
+    working      TPU/accelerator factor   CPU factor
+    f64 / c128   f32 / c64                f32 / c64
+    f32          bfloat16                 f32 (degenerate pair)
+    c64          c64 (no complex bf16)    c64 (degenerate pair)
+
+A *degenerate* pair (factor == working) is still well-defined: the
+refinement loop converges on the first residual check and the driver
+behaves like the direct solver plus one verification matmul — so
+``gesv_mixed`` is always safe to call, and the serving layer can key
+buckets by precision without per-backend special cases.
+
+Everything is routed through the per-call Options the reference uses
+for its mixed drivers: ``Option.MaxIterations`` (default 30),
+``Option.Tolerance`` (componentwise-backward-error threshold; default
+sqrt(n) * eps_working), ``Option.UseFallbackSolver`` (demote to a
+full-precision direct solve on non-convergence, gesv_mixed_gmres.cc:
+100-106), plus the slate_tpu extension ``Option.RefineMethod``
+(ir | gmres | auto).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..enums import Option, RefineMethod
+from ..options import Options, get_option
+
+#: GMRES restart length (reference: gesv_mixed_gmres.cc restart = 30)
+GMRES_RESTART = 30
+
+_FACTOR_ACCEL = {
+    "float64": "float32",
+    "complex128": "complex64",
+    "float32": "bfloat16",
+    # no complex half format exists; keep the pair degenerate
+    "complex64": "complex64",
+}
+_FACTOR_CPU = {
+    "float64": "float32",
+    "complex128": "complex64",
+    # CPU has no fast bf16 pipe worth a precision cut: degenerate pair
+    "float32": "float32",
+    "complex64": "complex64",
+}
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def factor_dtype(working, backend: Optional[str] = None):
+    """The factorization dtype paired with ``working`` on ``backend``
+    (default: the current jax backend).  Returns a numpy dtype for the
+    real/complex pairs and the string ``"bfloat16"`` for the f32
+    accelerator pair (numpy has no bf16; jnp resolves the name)."""
+    name = np.dtype(working).name
+    table = _FACTOR_CPU if (backend or _backend()) == "cpu" else _FACTOR_ACCEL
+    lo = table.get(name)
+    if lo is None:
+        raise ValueError(f"no mixed-precision pair for dtype {name!r}")
+    return lo if lo == "bfloat16" else np.dtype(lo)
+
+
+def default_tolerance(working, n: int) -> float:
+    """Componentwise-backward-error stopping threshold:
+    sqrt(n) * eps_working (the reference's gesv_mixed tolerance scaling;
+    the refined berr settles at ~eps, so sqrt(n) headroom is ample
+    without admitting an unconverged solution)."""
+    return float(math.sqrt(max(n, 1)) * np.finfo(np.dtype(working)).eps)
+
+
+@dataclass(frozen=True)
+class Policy:
+    """One resolved mixed-precision solve configuration."""
+
+    working: str  # canonical numpy dtype name, e.g. "float64"
+    factor: str  # factorization dtype name (may be "bfloat16")
+    residual: str  # residual dtype name (== working on this hardware)
+    method: str  # "ir" | "gmres"
+    max_iterations: int
+    tolerance: float  # componentwise backward-error threshold
+    use_fallback: bool
+    restart: int = GMRES_RESTART
+
+    @property
+    def degenerate(self) -> bool:
+        """factor == working: no precision cut (CPU f32/c64 pairs)."""
+        return self.factor == self.working
+
+    def factor_cast(self, x):
+        """Cast an array to the factor dtype (resolves "bfloat16"
+        through jnp, which numpy cannot spell)."""
+        import jax.numpy as jnp
+
+        return x.astype(jnp.dtype(self.factor))
+
+
+def select(
+    working,
+    n: int,
+    opts: Optional[Options] = None,
+    method_default: RefineMethod = RefineMethod.Auto,
+    backend: Optional[str] = None,
+) -> Policy:
+    """Resolve the full policy for one solve: the precision pair for
+    ``working`` on the current backend plus the Option-routed knobs.
+    ``method_default`` lets the ``*_mixed_gmres`` drivers force GMRES
+    while still honoring an explicit ``Option.RefineMethod``."""
+    wname = np.dtype(working).name
+    lo = factor_dtype(working, backend)
+    method = get_option(opts, Option.RefineMethod, None)
+    if method is None or method is RefineMethod.Auto or method == "auto":
+        method = method_default
+    if isinstance(method, str):
+        method = RefineMethod.from_string(method)
+    if method is RefineMethod.Auto:
+        method = RefineMethod.IR
+    max_it = int(get_option(opts, Option.MaxIterations, 30))
+    tol = get_option(opts, Option.Tolerance, None)
+    if tol is None:
+        tol = default_tolerance(working, n)
+    return Policy(
+        working=wname,
+        factor=lo if isinstance(lo, str) else np.dtype(lo).name,
+        residual=wname,
+        method=method.value,
+        max_iterations=max_it,
+        tolerance=float(tol),
+        use_fallback=bool(get_option(opts, Option.UseFallbackSolver, True)),
+    )

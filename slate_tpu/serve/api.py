@@ -74,6 +74,7 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         ),
         validate=bool(get_option(opts, Option.ServeValidate)),
         schedule=get_option(opts, Option.Schedule),
+        precision=str(get_option(opts, Option.ServePrecision) or "full"),
         faults_spec=str(get_option(opts, Option.Faults) or ""),
     )
     cfg.update(kw)
@@ -120,28 +121,41 @@ def submit(
     B,
     deadline: Optional[float] = None,
     retries: int = 0,
+    precision: Optional[str] = None,
 ) -> Future:
     """Async entry: enqueue and return the Future (see
-    :meth:`SolverService.submit`)."""
-    return get_service().submit(routine, A, B, deadline=deadline, retries=retries)
+    :meth:`SolverService.submit`).  ``precision`` ("full"|"mixed")
+    overrides the service-wide solve path for this request."""
+    return get_service().submit(
+        routine, A, B, deadline=deadline, retries=retries,
+        precision=precision,
+    )
 
 
-def _sync(routine, A, B, deadline, retries) -> np.ndarray:
-    fut = submit(routine, A, B, deadline=deadline, retries=retries)
+def _sync(routine, A, B, deadline, retries, precision=None) -> np.ndarray:
+    fut = submit(
+        routine, A, B, deadline=deadline, retries=retries,
+        precision=precision,
+    )
     # no result-timeout: the worker resolves every admitted future
     # (deadline expiry included), so blocking here cannot hang
     return fut.result()
 
 
-def gesv(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+def gesv(A, B, deadline: Optional[float] = None, retries: int = 0,
+         precision: Optional[str] = None) -> np.ndarray:
     """Solve A X = B (square, LU with partial pivoting) through the
-    service; returns X (n x nrhs)."""
-    return _sync("gesv", A, B, deadline, retries)
+    service; returns X (n x nrhs).  ``precision="mixed"`` routes the
+    request through a mixed-precision bucket (low-precision factor +
+    iterative refinement; non-converged solves are transparently
+    re-solved on the full-precision direct path)."""
+    return _sync("gesv", A, B, deadline, retries, precision)
 
 
-def posv(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+def posv(A, B, deadline: Optional[float] = None, retries: int = 0,
+         precision: Optional[str] = None) -> np.ndarray:
     """Solve SPD A X = B (Cholesky, lower triangle referenced)."""
-    return _sync("posv", A, B, deadline, retries)
+    return _sync("posv", A, B, deadline, retries, precision)
 
 
 def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:

@@ -41,6 +41,21 @@ import numpy as np
 DIM_FLOOR = 64
 NRHS_FLOOR = 8
 
+#: accepted BucketKey.precision values (the single source of truth —
+#: SolverService validates the service-wide setting and per-submit
+#: overrides against this same check)
+PRECISIONS = ("full", "mixed")
+
+
+def check_precision(precision: str) -> str:
+    """Validate a serving-precision string; returns it unchanged."""
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"unknown serving precision {precision!r} "
+            f"({'|'.join(PRECISIONS)})"
+        )
+    return precision
+
 
 def halving_bucket(h: int, total: int, floor: int = 1) -> int:
     """Smallest S = total / 2^m with S >= h, floored at min(floor, total)
@@ -102,7 +117,17 @@ class BucketKey:
     recursive-schedule deployment precompiles the recursion shapes, not
     the flat ones.  The recursion's halving splits land exactly on this
     module's bucket lattice, so one warmed bucket covers every shape
-    the recursive factor touches."""
+    the recursive factor touches.
+
+    ``precision`` selects the solve path the executable was traced
+    with: ``"full"`` (the direct drivers — the legacy default, so old
+    manifests round-trip unchanged) or ``"mixed"`` (low-precision
+    factor + device-resident iterative refinement,
+    ``drivers/mixed.serve_mixed_core``).  A warmed mixed bucket solves
+    at MXU low-precision rates; non-converged items surface as
+    non-finite X, which the service re-solves on the full-precision
+    direct path while the bucket's circuit breaker demotes persistent
+    offenders."""
 
     routine: str
     m: int  # row bucket
@@ -112,6 +137,7 @@ class BucketKey:
     nb: int  # tile size the executable was built with
     tag: str = ""  # options fingerprint (empty = defaults)
     schedule: str = "auto"  # factorization schedule (Option.Schedule)
+    precision: str = "full"  # solve path: full | mixed
 
     @property
     def label(self) -> str:
@@ -120,6 +146,7 @@ class BucketKey:
             f"{self.routine}.{self.m}x{self.n}x{self.nrhs}.{self.dtype}"
             + (f".{self.tag}" if self.tag else "")
             + (f".{self.schedule}" if self.schedule != "auto" else "")
+            + (f".{self.precision}" if self.precision != "full" else "")
         )
 
     def to_json(self) -> dict:
@@ -127,6 +154,7 @@ class BucketKey:
             "routine": self.routine, "m": self.m, "n": self.n,
             "nrhs": self.nrhs, "dtype": self.dtype, "nb": self.nb,
             "tag": self.tag, "schedule": self.schedule,
+            "precision": self.precision,
         }
 
     @staticmethod
@@ -136,6 +164,7 @@ class BucketKey:
             nrhs=int(d["nrhs"]), dtype=str(d["dtype"]), nb=int(d["nb"]),
             tag=str(d.get("tag", "")),
             schedule=str(d.get("schedule", "auto")),
+            precision=str(d.get("precision", "full")),
         )
 
 
@@ -213,23 +242,32 @@ def bucket_for(
     nrhs_floor: int = NRHS_FLOOR,
     tag: str = "",
     schedule: str = "auto",
+    precision: str = "full",
 ) -> BucketKey:
     """Map one request onto its BucketKey.  gesv/posv are square
     (m == n); gels buckets rows and columns independently (m >= n —
     underdetermined systems are served by the direct path, see api).
-    ``schedule`` keys the executable by factorization schedule."""
+    ``schedule`` keys the executable by factorization schedule;
+    ``precision`` by solve path (full | mixed — mixed is a square-solve
+    feature: gels has no low-precision-factor refinement analogue
+    here, so it stays on the full path)."""
+    check_precision(precision)
     dt = np.dtype(dtype).name
     rb = bucket_dim(nrhs, nrhs_floor)
     if routine in ("gesv", "posv"):
         if m != n:
             raise ValueError(f"{routine} requires square A, got {m}x{n}")
         S = bucket_dim(n, floor)
-        return BucketKey(routine, S, S, rb, dt, _serve_nb(S), tag, schedule)
+        return BucketKey(
+            routine, S, S, rb, dt, _serve_nb(S), tag, schedule, precision
+        )
     if routine == "gels":
         if m < n:
             raise ValueError("gels serving path requires m >= n")
         Mb, Nb = bucket_mn(m, n, floor)
-        return BucketKey(routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule)
+        return BucketKey(
+            routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule, "full"
+        )
     raise ValueError(f"unknown serving routine: {routine!r}")
 
 
@@ -300,7 +338,8 @@ def manifest_dumps(entries) -> str:
             "entries": sorted(
                 ({**k.to_json(), "batch": int(b)} for k, b in entries),
                 key=lambda e: (e["routine"], e["m"], e["n"], e["nrhs"],
-                               e["dtype"], e["tag"], e["batch"]),
+                               e["dtype"], e["tag"], e["schedule"],
+                               e["precision"], e["batch"]),
             ),
         },
         indent=1,

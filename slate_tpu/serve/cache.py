@@ -50,6 +50,29 @@ def _build_core(key: BucketKey) -> Callable:
     nb = key.nb
     opts = {Option.Schedule: key.schedule}
 
+    if key.precision == "mixed":
+        # mixed-precision bucket: low-precision factor + device-resident
+        # IR (drivers/mixed.serve_mixed_core — fully traceable, classical
+        # IR only).  Non-converged solves come back NaN-poisoned; the
+        # service's corrupt-result validation re-solves those items on
+        # the full-precision direct path and the bucket breaker demotes
+        # persistently non-converging buckets — the fallback policy
+        # lives in the service, never in the executable.
+        from ..drivers import mixed as _mixed
+
+        if key.routine not in ("gesv", "posv"):
+            raise ValueError(
+                "mixed-precision serving supports gesv/posv, "
+                f"not {key.routine!r}"
+            )
+
+        def core(Ag, Bg):
+            return _mixed.serve_mixed_core(
+                key.routine, Ag, Bg, nb, key.schedule
+            )
+
+        return core
+
     if key.routine == "gesv":
 
         def core(Ag, Bg):

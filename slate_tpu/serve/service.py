@@ -161,6 +161,16 @@ class SolverService:
         drivers with (Option.Schedule: "auto"|"flat"|"recursive") —
         part of the BucketKey, so manifests and warmup precompile the
         matching shapes; None reads the Option default.
+    precision: solve path for bucket executables ("full"|"mixed";
+        Option.ServePrecision when None) — part of the BucketKey, so
+        manifests warm the mixed executables too.  A mixed bucket
+        factors in low precision and refines on device
+        (drivers/mixed.serve_mixed_core); a request whose system
+        defeats the refinement comes back non-finite and is re-solved
+        on the full-precision direct path (``serve.corrupt_result`` +
+        a breaker failure — persistent offenders demote the bucket to
+        direct until the breaker heals).  ``submit(precision=...)``
+        overrides per request.
     faults_spec: aux/faults grammar string; arms + enables injection
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
@@ -183,6 +193,7 @@ class SolverService:
         retry_seed: int = 0,
         validate: Optional[bool] = None,
         schedule: Optional[str] = None,
+        precision: Optional[str] = None,
         faults_spec: Optional[str] = None,
         start: bool = True,
     ):
@@ -226,6 +237,9 @@ class SolverService:
             schedule.value if isinstance(schedule, Schedule)
             else Schedule.from_string(str(schedule)).value
         )
+        if precision is None:
+            precision = get_option(None, Option.ServePrecision) or "full"
+        self.precision = _bk.check_precision(precision)
         if faults_spec is None:
             faults_spec = get_option(None, Option.Faults) or ""
         # injection state is process-global (like metrics); a service
@@ -306,15 +320,19 @@ class SolverService:
         B,
         deadline: Optional[float] = None,
         retries: int = 0,
+        precision: Optional[str] = None,
     ) -> Future:
         """Enqueue one solve; returns a Future resolving to the cropped
         solution X (n x nrhs ndarray).
 
         ``deadline`` is seconds from now; ``retries`` re-runs the
         batched path (with backoff) on executable failure before
-        falling back.  Raises :class:`Rejected` when the queue is full
-        and :class:`InvalidInput` on non-finite operands (before any
-        queue/compile cost; disable with ``validate=False``)."""
+        falling back.  ``precision`` ("full"|"mixed") overrides the
+        service-wide solve path for this request (gesv/posv only —
+        gels always serves full precision).  Raises :class:`Rejected`
+        when the queue is full and :class:`InvalidInput` on non-finite
+        operands (before any queue/compile cost; disable with
+        ``validate=False``)."""
         A = np.asarray(A)
         B = np.asarray(B)
         if B.ndim == 1:
@@ -336,12 +354,18 @@ class SolverService:
                 ).with_context(routine=routine)
         m, n = A.shape
         nrhs = B.shape[1]
+        # validate even on the keyless direct path (underdetermined
+        # gels) — a typo'd precision must fail loudly on every
+        # routine, not just the bucketed ones
+        prec = _bk.check_precision(
+            precision if precision is not None else self.precision
+        )
         key: Optional[_bk.BucketKey] = None
         if not (routine == "gels" and m < n):
             key = _bk.bucket_for(
                 routine, m, n, nrhs, A.dtype,
                 floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
-                schedule=self.schedule,
+                schedule=self.schedule, precision=prec,
             )
         req = _Request(
             routine=routine, key=key, A=A, B=B, m=m, n=n, nrhs=nrhs,
@@ -674,16 +698,32 @@ class SolverService:
                 ))
                 continue
             X = _bk.crop_result(key, X_b[i], r.n, r.nrhs)
-            if self.validate and not np.all(np.isfinite(X)):
-                # admission validated the inputs finite, so a
-                # non-finite solution is a corrupted executable result
-                # (the result_corrupt fault site, a bad kernel, bit
-                # rot): re-solve this item on the direct driver rather
-                # than deliver garbage (_direct does its own late-miss
-                # accounting — counting here would double it)
-                metrics.inc("serve.corrupt_result")
-                self._note_failure()
-                corrupt += 1
+            mixed = key.precision == "mixed"
+            if (self.validate or mixed) and not np.all(np.isfinite(X)):
+                # a non-finite solution from finite inputs is a
+                # corrupted executable result (the result_corrupt fault
+                # site, a bad kernel, bit rot) — or, on a mixed-
+                # precision bucket, the designed non-convergence signal
+                # (serve_mixed_core NaN-poisons items the refinement
+                # cannot certify; checked even with validate off, it is
+                # the demotion contract): re-solve this item on the
+                # full-precision direct driver rather than deliver
+                # garbage (_direct does its own late-miss accounting —
+                # counting here would double it).  With validate=True
+                # admission proved the inputs finite; with it off,
+                # check them now — garbage *inputs* are the client's
+                # GIGO, not a bucket failure, and must not open the
+                # breaker or masquerade as a refinement stall in the
+                # demotion metrics.
+                inputs_ok = self.validate or (
+                    np.all(np.isfinite(r.A)) and np.all(np.isfinite(r.B))
+                )
+                if inputs_ok:
+                    metrics.inc("serve.corrupt_result")
+                    if mixed:
+                        metrics.inc("serve.refine_demoted")
+                    self._note_failure()
+                    corrupt += 1
                 deliver.append(functools.partial(self._direct, r))
                 continue
             if late:

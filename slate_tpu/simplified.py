@@ -24,6 +24,7 @@ from .drivers import chol as _chol
 from .drivers import eig as _eig
 from .drivers import indefinite as _indef
 from .drivers import lu as _lu
+from .drivers import mixed as _mixed
 from .drivers import qr as _qr
 from .drivers import svd as _svd
 
@@ -148,6 +149,28 @@ def chol_solve_using_factor(L, B, opts=None):
 
 def chol_inverse_using_factor(L, opts=None):
     return _chol.potri(L, opts)
+
+
+def solve_mixed(A, B, opts=None):
+    """Mixed-precision solve with iterative refinement, dispatched on
+    matrix kind (HermitianMatrix -> posv_mixed, else gesv_mixed; the
+    verb-API face of the refine/ subsystem).  Returns only X, so it
+    demands the success contract itself: with the fallback solver on
+    (the default) a non-converging system is re-solved at full
+    precision; with it off, non-convergence raises NumericalError —
+    never a silently-wrong finite X."""
+    from .exceptions import NumericalError
+
+    if isinstance(A, HermitianMatrix):
+        X, info, _iters = _mixed.posv_mixed(A, B, opts)
+    else:
+        X, info, _iters = _mixed.gesv_mixed(A, B, opts)
+    if int(info) != 0:
+        raise NumericalError(
+            f"solve_mixed: refinement did not converge (info={int(info)})",
+            int(info),
+        )
+    return X
 
 
 # ----- indefinite ----------------------------------------------------------

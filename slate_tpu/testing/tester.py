@@ -547,6 +547,42 @@ def _test_posv_mixed(pr: Params):
     return dt, 0.33e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
 
 
+def _test_gesv_mixed_gmres(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = (_rng_matrix("rand", n, n, pr.dtype, pr.seed) + n * np.eye(n)).astype(pr.dtype)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, info, iters = st.gesv_mixed_gmres(
+        st.Matrix.from_global(A0, pr.nb, grid=g),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    return dt, 0.67e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
+def _test_posv_mixed_gmres(pr: Params):
+    import slate_tpu as st
+    from .checks import solve_residual
+
+    g = _grid(pr)
+    n = pr.n
+    A0 = _spd_np(pr, n)
+    B0 = _rng_matrix("rand", n, max(pr.k, 1), pr.dtype, pr.seed + 1)
+    t0 = time.perf_counter()
+    X, info, iters = st.posv_mixed_gmres(
+        st.HermitianMatrix.from_global(A0, pr.nb, grid=g, uplo=st.Uplo.Lower),
+        st.Matrix.from_global(B0, pr.nb, grid=g),
+    )
+    got = np.asarray(X.to_global())
+    dt = time.perf_counter() - t0
+    return dt, 0.33e-9 * n ** 3 / dt, solve_residual(A0, got, B0)
+
+
 def _test_gesv_rbt(pr: Params):
     import slate_tpu as st
     from .checks import solve_residual
@@ -726,6 +762,8 @@ ROUTINES: Dict[str, Callable[[Params], tuple]] = {
     "hegv": _test_hegv,
     "gesv_mixed": _test_gesv_mixed,
     "posv_mixed": _test_posv_mixed,
+    "gesv_mixed_gmres": _test_gesv_mixed_gmres,
+    "posv_mixed_gmres": _test_posv_mixed_gmres,
     "gesv_rbt": _test_gesv_rbt,
     "gesv_calu": _test_gesv_calu,
     "hesv": _test_hesv,
@@ -772,6 +810,7 @@ TOL_FACTOR = {
     "trmm": 30, "getri": 500, "potri": 500, "trtri": 100, "gelqf": 100,
     "cholqr": 50000,
     "hegv": 300, "gesv_mixed": 50, "posv_mixed": 50,
+    "gesv_mixed_gmres": 50, "posv_mixed_gmres": 50,
     "gesv_rbt": 5000, "gesv_calu": 500, "hesv": 500, "condest": 1,
     "steqr": 50, "sterf": 50, "serve": 50,
 }

@@ -1,0 +1,564 @@
+"""refine/ — mixed-precision iterative refinement (ISSUE 5).
+
+Coverage map (acceptance criteria in the ISSUE):
+
+* policy: precision-pair selection per backend, Option routing
+  (MaxIterations / Tolerance / UseFallbackSolver / RefineMethod).
+* parity: gesv_mixed / posv_mixed match the direct f64 solve to the
+  LAPACK-style residual bound on well-conditioned systems (f32 factor
+  precision — the CPU tier-1 pair).
+* convergence bounds on matgen.cond_matrix(cond=1e4) (deterministic
+  spectra, not luck-of-the-draw) — <= 8 IR iterations.
+* divergence at cond >> 1/eps_f32: fallback fires (refine.fallbacks
+  bumped, iters < 0, accurate result) or, with the fallback disabled,
+  a typed nonzero info — never a hang or silent garbage.
+* GMRES-IR converges on a matrix where classical IR stalls
+  (cond ~ 1/eps_f32; Carson & Higham SISC 2018 §4 separation).
+* factor-step fault injection (info_nonzero / result_corrupt)
+  exercises the fallback solver.
+* serve: mixed-precision buckets (BucketKey.precision) stay
+  compile-free in warmed steady state; persistent non-convergence
+  demotes to the full-precision direct path through the breaker.
+* the accurate_matmul sequence-scan fix (displaced-decorator counter).
+
+Heavy parametrizations are marked ``slow`` (tier-1 budget).
+"""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.aux import faults, metrics
+from slate_tpu.enums import Option, RefineMethod
+from slate_tpu.matgen import cond_matrix
+from slate_tpu.matrix.matrix import HermitianMatrix, Matrix
+from slate_tpu.refine import policy
+from slate_tpu.refine.ir import backward_error, refine_while
+from slate_tpu.testing import checks
+
+EPS64 = float(np.finfo(np.float64).eps)
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    """refine.* counters are part of the subsystem contract; collect
+    them for every test and restore the prior state after."""
+    was_on = metrics.is_on()
+    metrics.on()
+    yield
+    if not was_on:
+        metrics.off()
+
+
+@pytest.fixture(autouse=True)
+def _faults_clean():
+    yield
+    faults.reset()
+
+
+def _rhs(n, nrhs=2, seed=7):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, nrhs))
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_pairs_cpu():
+    assert policy.factor_dtype(np.float64, "cpu") == np.dtype(np.float32)
+    assert policy.factor_dtype(np.complex128, "cpu") == np.dtype(np.complex64)
+    # CPU has no fast bf16 pipe: the f32 pair is degenerate
+    assert policy.factor_dtype(np.float32, "cpu") == np.dtype(np.float32)
+    pol = policy.select(np.float32, 64, backend="cpu")
+    assert pol.degenerate
+
+
+def test_policy_pairs_accelerator():
+    # TPU/accelerator: f32 factors in bf16 (the MXU single-pass dtype)
+    assert policy.factor_dtype(np.float32, "tpu") == "bfloat16"
+    assert policy.factor_dtype(np.float64, "tpu") == np.dtype(np.float32)
+    pol = policy.select(np.float32, 64, backend="tpu")
+    assert pol.factor == "bfloat16" and not pol.degenerate
+
+
+def test_policy_option_routing():
+    pol = policy.select(np.float64, 64)
+    assert pol.method == "ir" and pol.max_iterations == 30
+    assert pol.use_fallback
+    assert pol.tolerance == pytest.approx(8 * EPS64)
+    pol = policy.select(
+        np.float64, 64,
+        {Option.RefineMethod: "gmres", Option.MaxIterations: 5,
+         Option.Tolerance: 1e-10, Option.UseFallbackSolver: False},
+    )
+    assert pol.method == "gmres" and pol.max_iterations == 5
+    assert pol.tolerance == 1e-10 and not pol.use_fallback
+    # method_default only fills the Auto slot; explicit options win
+    pol = policy.select(
+        np.float64, 64, {Option.RefineMethod: RefineMethod.IR},
+        method_default=RefineMethod.GMRES,
+    )
+    assert pol.method == "ir"
+    pol = policy.select(np.float64, 64, method_default=RefineMethod.GMRES)
+    assert pol.method == "gmres"
+
+
+def test_policy_unknown_dtype_rejected():
+    with pytest.raises(ValueError):
+        policy.factor_dtype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# matgen cond= knob
+# ---------------------------------------------------------------------------
+
+
+def test_cond_matrix_specified_condition():
+    A = cond_matrix(48, 1e4)
+    assert np.linalg.cond(A) == pytest.approx(1e4, rel=1e-6)
+    # bit-deterministic for a seed; different seed, different matrix
+    assert np.array_equal(A, cond_matrix(48, 1e4))
+    assert not np.array_equal(A, cond_matrix(48, 1e4, seed=1))
+
+
+def test_cond_matrix_spd():
+    S = cond_matrix(32, 1e6, spd=True)
+    assert np.abs(S - S.T).max() < 1e-14
+    w = np.linalg.eigvalsh(S)
+    assert w.min() > 0
+    assert w.max() / w.min() == pytest.approx(1e6, rel=1e-6)
+
+
+def test_cond_matrix_rejects_bad_cond():
+    from slate_tpu.exceptions import SlateError
+
+    with pytest.raises(SlateError):
+        cond_matrix(8, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# IR core
+# ---------------------------------------------------------------------------
+
+
+def test_backward_error_of_exact_solution():
+    import jax.numpy as jnp
+
+    A = cond_matrix(32, 10.0)
+    X = _rhs(32, 2, seed=1)
+    B = A @ X
+    berr = float(backward_error(jnp.asarray(A), jnp.asarray(X), jnp.asarray(B)))
+    assert berr < 64 * EPS64
+
+
+def test_refine_while_counts_steps():
+    import jax.numpy as jnp
+
+    A = jnp.asarray(cond_matrix(32, 10.0))
+    B = jnp.asarray(_rhs(32))
+    res = refine_while(A, B, lambda R: jnp.linalg.solve(A, R), 1e-14, 10)
+    # an (essentially) exact inner solve converges on the first check
+    assert bool(res.converged) and int(res.iters) <= 1
+
+
+# ---------------------------------------------------------------------------
+# drivers: parity, iteration bounds, fallback
+# ---------------------------------------------------------------------------
+
+
+def test_gesv_mixed_parity_direct_f64():
+    n = 64
+    A0 = cond_matrix(n, 1e3)
+    B0 = _rhs(n, 3)
+    X, info, iters = st.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    )
+    assert int(info) == 0 and iters >= 0
+    got = np.asarray(X.to_global())
+    assert checks.solve_residual(A0, got, B0) < 50 * EPS64
+    # matches the direct f64 solve to the residual bound
+    ref = np.linalg.solve(A0, B0)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 1e3 * n * EPS64
+
+
+def test_posv_mixed_parity_direct_f64():
+    n = 64
+    A0 = cond_matrix(n, 1e3, spd=True)
+    B0 = _rhs(n, 3)
+    X, info, iters = st.posv_mixed(
+        HermitianMatrix.from_global(A0, 16, uplo=st.Uplo.Lower),
+        Matrix.from_global(B0, 16),
+    )
+    assert int(info) == 0 and iters >= 0
+    assert checks.solve_residual(A0, np.asarray(X.to_global()), B0) < 50 * EPS64
+
+
+@pytest.mark.parametrize("spd", [False, True], ids=["gesv", "posv"])
+def test_mixed_converges_within_8_iters_at_cond_1e4(spd):
+    n = 96
+    A0 = cond_matrix(n, 1e4, spd=spd)
+    B0 = _rhs(n, 2)
+    if spd:
+        X, info, iters = st.posv_mixed(
+            HermitianMatrix.from_global(A0, 32, uplo=st.Uplo.Lower),
+            Matrix.from_global(B0, 32),
+        )
+    else:
+        X, info, iters = st.gesv_mixed(
+            Matrix.from_global(A0, 32), Matrix.from_global(B0, 32)
+        )
+    assert int(info) == 0
+    # ISSUE acceptance: converge in <= 8 IR iterations at cond=1e4
+    assert 0 <= iters <= 8, iters
+    assert checks.solve_residual(A0, np.asarray(X.to_global()), B0) < 50 * EPS64
+
+
+def test_gesv_mixed_divergence_falls_back():
+    n = 64
+    A0 = cond_matrix(n, 1e9)  # cond * eps_f32 ~ 1e2: classical IR diverges
+    B0 = _rhs(n)
+    before = metrics.counters().get("refine.fallbacks", 0)
+    X, info, iters = st.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    )
+    assert iters < 0  # the fallback solver ran
+    assert int(info) == 0  # ... and produced a usable full-precision solve
+    assert metrics.counters().get("refine.fallbacks", 0) == before + 1
+    got = np.asarray(X.to_global())
+    assert np.all(np.isfinite(got))
+    assert checks.solve_residual(A0, got, B0) < 100 * EPS64
+
+
+def test_gesv_mixed_no_fallback_is_typed_not_garbage():
+    n = 64
+    A0 = cond_matrix(n, 1e9)
+    B0 = _rhs(n)
+    X, info, iters = st.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16),
+        {Option.UseFallbackSolver: False},
+    )
+    # no silent garbage: non-convergence surfaces as nonzero info
+    assert int(info) != 0
+    assert iters >= 0
+    with pytest.raises(st.NumericalError):
+        st.simplified.solve_mixed(
+            Matrix.from_global(A0, 16), Matrix.from_global(B0, 16),
+            {Option.UseFallbackSolver: False},
+        )
+
+
+def test_gmres_ir_converges_where_classical_ir_stalls():
+    n = 64
+    A0 = cond_matrix(n, 1e9)
+    B0 = _rhs(n)
+    opts = {Option.UseFallbackSolver: False}
+    _X, info_ir, _ = st.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16), opts
+    )
+    assert int(info_ir) != 0  # classical IR stalls at cond ~ 1/eps_f32...
+    Xg, info_g, iters_g = st.gesv_mixed_gmres(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16), opts
+    )
+    assert int(info_g) == 0 and iters_g > 0  # ...GMRES-IR converges
+    got = np.asarray(Xg.to_global())
+    ref = np.linalg.solve(A0, B0)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 1e-6
+
+
+def test_refine_metrics_recorded():
+    n = 64
+    A0 = cond_matrix(n, 1e3)
+    B0 = _rhs(n)
+    with metrics.deltas() as d:
+        st.gesv_mixed(Matrix.from_global(A0, 16), Matrix.from_global(B0, 16))
+    assert d.get("refine.calls") == 1
+    assert d.get("refine.gesv_mixed.calls") == 1
+    assert d.get("refine.converged") == 1
+    assert d.get("refine.iterations") >= 1
+    assert metrics.gauges().get("refine.residual") is not None
+
+
+# ---------------------------------------------------------------------------
+# factor-step fault injection -> fallback solver
+# ---------------------------------------------------------------------------
+
+
+def test_factor_fault_info_nonzero_exercises_fallback():
+    n = 48
+    A0 = cond_matrix(n, 10.0)
+    B0 = _rhs(n)
+    faults.arm("info_nonzero", once=True)
+    faults.on()
+    with metrics.deltas() as d:
+        X, info, iters = st.gesv_mixed(
+            Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+        )
+    assert iters < 0 and int(info) == 0
+    assert d.get("refine.fallbacks") == 1
+    assert d.get("faults.injected.info_nonzero") == 1
+    assert checks.solve_residual(A0, np.asarray(X.to_global()), B0) < 100 * EPS64
+
+
+def test_factor_fault_result_corrupt_exercises_fallback():
+    n = 48
+    A0 = cond_matrix(n, 10.0, spd=True)
+    B0 = _rhs(n)
+    faults.arm("result_corrupt", once=True)
+    faults.on()
+    with metrics.deltas() as d:
+        X, info, iters = st.posv_mixed(
+            HermitianMatrix.from_global(A0, 16, uplo=st.Uplo.Lower),
+            Matrix.from_global(B0, 16),
+        )
+    assert iters < 0 and int(info) == 0
+    assert d.get("refine.fallbacks") == 1
+    assert checks.solve_residual(A0, np.asarray(X.to_global()), B0) < 100 * EPS64
+
+
+# ---------------------------------------------------------------------------
+# serve integration: mixed-precision buckets
+# ---------------------------------------------------------------------------
+
+FLOOR, NRHS_FLOOR = 16, 4
+
+
+def _mk_service(cache=None, **kw):
+    from slate_tpu.serve.cache import ExecutableCache
+    from slate_tpu.serve.service import SolverService
+
+    return SolverService(
+        cache=cache if cache is not None else ExecutableCache(manifest_path=None),
+        batch_max=4, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+        precision="mixed", **kw,
+    )
+
+
+def test_bucketkey_precision_manifest_roundtrip():
+    from slate_tpu.serve.buckets import (
+        BucketKey, bucket_for, manifest_dumps, manifest_loads,
+    )
+
+    k = bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                   nrhs_floor=NRHS_FLOOR, precision="mixed")
+    assert k.precision == "mixed" and k.label.endswith(".mixed")
+    (k2, b2), = manifest_loads(manifest_dumps([(k, 4)]))
+    assert k2 == k and b2 == 4
+    # legacy manifests (no precision field) default to the full path
+    legacy = BucketKey.from_json(
+        {"routine": "gesv", "m": 16, "n": 16, "nrhs": 4,
+         "dtype": "float64", "nb": 16}
+    )
+    assert legacy.precision == "full" and "mixed" not in legacy.label
+    with pytest.raises(ValueError):
+        bucket_for("gesv", 12, 12, 2, np.float64, precision="half")
+    # gels has no mixed path: stays full regardless of the service-wide
+    # setting instead of building an executable that cannot exist
+    kg = bucket_for("gels", 24, 12, 2, np.float64, floor=FLOOR,
+                    nrhs_floor=NRHS_FLOOR, precision="mixed")
+    assert kg.precision == "full"
+
+
+def test_serve_mixed_bucket_parity_and_steady_state(tmp_path):
+    """ISSUE acceptance: serve mixed buckets stay compile-free in
+    warmed steady state, and padded-and-cropped mixed results meet the
+    direct drivers' bound."""
+    from slate_tpu.serve.cache import ExecutableCache, direct_call
+
+    rng = np.random.default_rng(0)
+    n = 12
+    A1 = rng.standard_normal((n, n)) + n * np.eye(n)
+    B1 = rng.standard_normal((n, 2))
+    G = rng.standard_normal((n, n))
+    A2 = G @ G.T + n * np.eye(n)
+
+    manifest = str(tmp_path / "warm_mixed.json")
+    s1 = _mk_service(start=False)
+    futs = [s1.submit("gesv", A1 + i * 0.01 * np.eye(n), B1) for i in range(4)]
+    futs.append(s1.submit("posv", A2, B1))
+    s1.start()
+    for f in futs:
+        f.result(timeout=300)
+    s1.stop()
+    s1.cache.save_manifest(manifest)
+
+    # fresh cache: the manifest must round-trip the precision field and
+    # warm the MIXED executables, after which a stream never compiles
+    cache2 = ExecutableCache(manifest_path=None)
+    s2 = _mk_service(cache=cache2, start=False)
+    assert cache2.warmup(manifest, batch_max=4) >= 4
+    with metrics.deltas() as d:
+        futs = []
+        for i in range(5):
+            futs.append(s2.submit("gesv", A1 + i * 1e-3 * np.eye(n), B1))
+            futs.append(s2.submit("posv", A2 + i * 1e-3 * np.eye(n), B1))
+        s2.start()
+        for f in futs:
+            f.result(timeout=300)
+        for _ in range(2):  # lone sequential requests hit the b1 point
+            got = s2.submit("gesv", A1, B1).result(timeout=300)
+        assert d.get("serve.requests") >= 12
+        assert d.get("jit.compilations") == 0, "warmed mixed bucket compiled"
+        assert d.get("serve.corrupt_result") == 0  # everything converged
+    ref = direct_call("gesv", A1, B1)
+    assert np.abs(got - ref).max() < 50 * EPS64 * max(np.abs(ref).max(), 1)
+    s2.stop()
+
+
+def test_serve_mixed_demotes_to_direct_on_persistent_stall():
+    """A mixed bucket whose traffic defeats the refinement re-solves
+    each item on the full-precision direct path (corrupt-result
+    validation sees the NaN poison) and the breaker opens after
+    degrade_after failures — the demotion the ISSUE asks for."""
+    from slate_tpu.serve import buckets as bk
+
+    n = 14
+    A0 = cond_matrix(n, 1e9)  # stalls classical IR with an f32 factor
+    B0 = _rhs(n)
+    svc = _mk_service(degrade_after=2, breaker_cooldown_s=60.0, start=False)
+    futs = [svc.submit("gesv", A0, B0) for _ in range(2)]
+    with metrics.deltas() as d:
+        svc.start()
+        for f in futs:
+            X = f.result(timeout=300)
+            # delivered result is the full-precision direct re-solve
+            assert np.all(np.isfinite(X))
+            assert checks.solve_residual(A0, X, B0) < 200 * EPS64
+        # third request: breaker is open, routes direct without even
+        # touching the batched mixed path
+        X = svc.submit("gesv", A0, B0).result(timeout=300)
+        assert checks.solve_residual(A0, X, B0) < 200 * EPS64
+        assert d.get("serve.corrupt_result") >= 1
+        assert d.get("serve.refine_demoted") >= 1
+        assert d.get("serve.fallbacks") >= 1
+    health = svc.health()
+    assert any(
+        s == bk.BREAKER_OPEN and lbl.endswith(".mixed")
+        for lbl, s in health["breakers"].items()
+    ), health["breakers"]
+    svc.stop()
+
+
+def test_serve_per_request_precision_override():
+    svc = _mk_service(start=False)
+    try:
+        rng = np.random.default_rng(3)
+        n = 12
+        A = rng.standard_normal((n, n)) + n * np.eye(n)
+        B = rng.standard_normal((n, 1))
+        f_full = svc.submit("gesv", A, B, precision="full")
+        f_mixed = svc.submit("gesv", A, B)
+        svc.start()
+        Xf, Xm = f_full.result(timeout=300), f_mixed.result(timeout=300)
+        assert checks.solve_residual(A, Xf, B) < 50 * EPS64
+        assert checks.solve_residual(A, Xm, B) < 50 * EPS64
+        labels = set(svc.health()["breakers"]) | {
+            k.label for (k, _b) in svc.cache.entries()
+        }
+        assert any(l.endswith(".mixed") for l in labels)
+        assert any(not l.endswith(".mixed") for l in labels)
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# accurate_matmul sequence-scan regression (small fix)
+# ---------------------------------------------------------------------------
+
+
+def test_accurate_matmul_scans_sequences_of_matrices():
+    import jax.numpy as jnp
+
+    from slate_tpu.internal.precision import accurate_matmul
+
+    @accurate_matmul
+    def apply_factors(factors):
+        L, U = factors
+        return L @ U
+
+    assert apply_factors._accurate_matmul  # marker attr survives
+
+    f32s = (jnp.eye(4, dtype=jnp.float32), jnp.eye(4, dtype=jnp.float32))
+    f64s = [jnp.eye(4, dtype=jnp.float64), jnp.eye(4, dtype=jnp.float64)]
+    with metrics.deltas() as d:
+        apply_factors(f32s)  # 32-bit operands INSIDE a tuple must count
+        assert d.get("precision.accurate_matmul_activations") == 1
+        apply_factors(f64s)  # pure f64 must not
+        assert d.get("precision.accurate_matmul_activations") == 1
+        apply_factors(factors=f32s)  # and inside kwargs sequences
+        assert d.get("precision.accurate_matmul_activations") == 2
+
+
+# ---------------------------------------------------------------------------
+# heavier parametrizations (slow: tier-1 budget)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", [np.complex128], ids=["c128"])
+def test_mixed_complex_parity_slow(dtype):
+    rng = np.random.default_rng(5)
+    n = 64
+    A0 = (rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+          + n * np.eye(n)).astype(dtype)
+    B0 = (rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+          ).astype(dtype)
+    X, info, iters = st.gesv_mixed(
+        Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+    )
+    assert int(info) == 0 and iters >= 0
+    assert checks.solve_residual(A0, np.asarray(X.to_global()), B0) < 50 * EPS64
+    S0 = (A0 @ A0.conj().T + n * np.eye(n)).astype(dtype)
+    X2, info2, it2 = st.posv_mixed_gmres(
+        HermitianMatrix.from_global(S0, 16, uplo=st.Uplo.Lower),
+        Matrix.from_global(B0, 16),
+    )
+    assert int(info2) == 0
+    assert checks.solve_residual(S0, np.asarray(X2.to_global()), B0) < 50 * EPS64
+
+
+@pytest.mark.slow
+def test_gmres_restart_cycles_slow():
+    """GMRES-IR pays extra cycles (not a fallback) as conditioning
+    grows: iteration counts are monotone-ish in cond and stay positive
+    until the Carson-Higham limit."""
+    n = 64
+    for cexp, max_iters in ((3, 90), (6, 240), (9, 900)):
+        A0 = cond_matrix(n, 10.0 ** cexp)
+        B0 = _rhs(n)
+        _X, info, iters = st.gesv_mixed_gmres(
+            Matrix.from_global(A0, 16), Matrix.from_global(B0, 16)
+        )
+        assert int(info) == 0 and 0 < iters <= max_iters, (cexp, iters)
+
+
+@pytest.mark.slow
+def test_serve_mixed_with_chaos_slow():
+    """Mixed buckets + execute faults: every future resolves (result or
+    typed error) and the stream recovers — the refine path composes
+    with the PR4 containment layers."""
+    from slate_tpu.exceptions import SlateError
+
+    rng = np.random.default_rng(9)
+    n = 12
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, 2))
+    svc = _mk_service(
+        start=False, retry_backoff_s=0.002, breaker_cooldown_s=0.02,
+        faults_spec="execute:p=0.3,seed=5",
+    )
+    futs = [svc.submit("gesv", A + i * 1e-3 * np.eye(n), B, retries=2)
+            for i in range(18)]
+    svc.start()
+    ok = typed = 0
+    for f in futs:
+        try:
+            X = f.result(timeout=300)
+            assert np.all(np.isfinite(X))
+            ok += 1
+        except SlateError:
+            typed += 1
+    assert ok + typed == len(futs)
+    assert ok > 0
+    svc.stop()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Per-routine mixed-precision refinement report over a metrics JSONL.
+
+Reads a ``SLATE_TPU_METRICS`` dump from a run that exercised the
+``*_mixed`` drivers and prints one row per routine from the
+``refine.<routine>.*`` counter family:
+
+    routine            calls  mean_iters  converged  fallbacks  fb_rate
+
+``mean_iters`` counts refinement steps per call in method-independent
+units (one IR correction or one GMRES cycle), ``converged`` the calls whose
+componentwise backward error passed the tolerance on the refine path,
+``fallbacks`` the calls demoted to the full-precision direct solve
+(``Option.UseFallbackSolver``).  The ``refine.<routine>.residual``
+gauge (last backward error) is shown when present.
+
+Exit status gates CI: nonzero when any routine's fallback rate exceeds
+``--max-fallback-rate`` (default 0.5) — a deployment whose mixed path
+falls back more often than it converges is paying the low-precision
+factor *plus* the full-precision solve on most requests, i.e. strictly
+worse than the direct driver, and should switch precision pairs,
+method (GMRES-IR survives ~1/eps_factor more conditioning), or back to
+the full path.
+
+Usage:
+    SLATE_TPU_METRICS=/tmp/refine.jsonl python my_workload.py
+    python tools/refine_report.py /tmp/refine.jsonl [--max-fallback-rate 0.5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _load(path: str):
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("type") == "counter":
+                counters[row["name"]] = float(row.get("value", 0))
+            elif row.get("type") == "gauge":
+                gauges[row["name"]] = float(row.get("value", 0))
+    return counters, gauges
+
+
+def analyze(path: str) -> List[dict]:
+    """One row per routine seen in the refine.<routine>.* counters."""
+    counters, gauges = _load(path)
+    routines = sorted(
+        name[len("refine."):-len(".calls")]
+        for name in counters
+        if name.startswith("refine.")
+        and name.endswith(".calls")
+        and name != "refine.calls"
+    )
+    rows = []
+    for r in routines:
+        calls = counters.get(f"refine.{r}.calls", 0)
+        fallbacks = counters.get(f"refine.{r}.fallbacks", 0)
+        rows.append({
+            "routine": r,
+            "calls": int(calls),
+            "iterations": int(counters.get(f"refine.{r}.iterations", 0)),
+            "converged": int(counters.get(f"refine.{r}.converged", 0)),
+            "fallbacks": int(fallbacks),
+            "fallback_rate": (fallbacks / calls) if calls else 0.0,
+            "residual": gauges.get(f"refine.{r}.residual"),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a *_mixed run")
+    ap.add_argument(
+        "--max-fallback-rate", type=float, default=0.5,
+        help="fail (exit 1) when any routine's fallbacks/calls exceeds "
+             "this (default 0.5)",
+    )
+    args = ap.parse_args(argv)
+
+    rows = analyze(args.jsonl)
+    if not rows:
+        print("no refine.<routine>.* counters in this JSONL "
+              "(no *_mixed drivers ran, or metrics were off)")
+        return 0
+    hdr = (f"{'routine':18} {'calls':>6} {'mean_iters':>11} "
+           f"{'converged':>10} {'fallbacks':>10} {'fb_rate':>8} "
+           f"{'last_berr':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    over = []
+    for r in rows:
+        mean_it = r["iterations"] / r["calls"] if r["calls"] else 0.0
+        berr = f"{r['residual']:10.2e}" if r["residual"] is not None else f"{'-':>10}"
+        print(
+            f"{r['routine']:18} {r['calls']:6d} {mean_it:11.1f} "
+            f"{r['converged']:10d} {r['fallbacks']:10d} "
+            f"{r['fallback_rate']:8.2f} {berr}"
+        )
+        if r["fallback_rate"] > args.max_fallback_rate:
+            over.append(r["routine"])
+    if over:
+        print(
+            f"\nfallback rate over {args.max_fallback_rate:.2f} for: "
+            f"{', '.join(over)} — the mixed path is paying factor+direct "
+            "on most requests; change the pair/method or serve at full "
+            "precision"
+        )
+        return 1
+    print("\nall routines within the fallback-rate budget")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
