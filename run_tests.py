@@ -195,14 +195,46 @@ print(f"chaos driver: {ok} solved, {typed} typed errors, 0 hangs")
 svc.stop()
 """
 
+# Artifact leg of the --chaos gate: SLATE_TPU_FAULTS arms the three
+# artifact sites (env path, read at import), a store is warmed (misses
+# never advance the fault sites — the ladder starts after a successful
+# read), then four loads eat one injection each and the fourth proves
+# the store healthy.  chaos_report joins faults.injected.artifact_*
+# against the detection counters.
+_CHAOS_ARTIFACT_DRIVER = """
+import os
+import tempfile
+import jax
+jax.config.update("jax_enable_x64", True)  # the production f64/x64 config
+import numpy as np
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+
+td = tempfile.mkdtemp(prefix="slate_chaos_art_")
+cache = ExecutableCache(manifest_path=os.path.join(td, "m.json"),
+                        artifact_dir=os.path.join(td, "a"))
+key = bk.bucket_for("gesv", 10, 10, 2, np.float64, floor=16,
+                    nrhs_floor=4, schedule="recursive")
+cache.ensure_manifest(key, (1,))
+cache.warmup(batch_max=1)  # builds + persists the export artifact
+st = cache.artifacts
+outcomes = []
+for i in range(4):  # corrupt, stale, load_fail fire once each, then clean
+    outcomes.append(st.load(key, 1) is not None)
+assert outcomes == [False, False, False, True], outcomes
+print("chaos artifact driver: 3 injected loads degraded, 4th verified clean")
+"""
+
 
 def chaos() -> int:
-    """Chaos gate, two legs: (1) the fault-injection suite — every
+    """Chaos gate, three legs: (1) the fault-injection suite — every
     site x hardening combination including the slow-marked sustained
     streams; (2) an env-activated faulty stream (SLATE_TPU_FAULTS +
     SLATE_TPU_METRICS, the production path) whose JSONL is joined by
     tools/chaos_report.py — a fault site with injections but no
-    recovery signal fails the gate."""
+    recovery signal fails the gate; (3) the same join over the three
+    artifact-store load sites (artifact_corrupt/_stale/_load_fail),
+    run as its own pass so the per-site attribution is airtight."""
     import tempfile
 
     here = os.path.dirname(os.path.abspath(__file__)) or "."
@@ -214,25 +246,214 @@ def chaos() -> int:
                          cwd=here)
     if rc != 0:
         return rc
-    jsonl = os.path.join(tempfile.gettempdir(), f"chaos_{os.getpid()}.jsonl")
-    env = dict(
-        os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
-        SLATE_TPU_FAULTS="execute:p=0.3,seed=3;worker_death:every=7",
+    legs = (
+        (_CHAOS_DRIVER, "execute:p=0.3,seed=3;worker_death:every=7"),
+        (_CHAOS_ARTIFACT_DRIVER,
+         "artifact_corrupt:once;artifact_stale:once;"
+         "artifact_load_fail:once"),
     )
-    try:
-        rc = subprocess.call([sys.executable, "-c", _CHAOS_DRIVER], env=env,
-                             cwd=here)
+    for i, (driver, faults_spec) in enumerate(legs):
+        jsonl = os.path.join(
+            tempfile.gettempdir(), f"chaos_{os.getpid()}_{i}.jsonl"
+        )
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
+            SLATE_TPU_FAULTS=faults_spec,
+        )
+        try:
+            rc = subprocess.call(
+                [sys.executable, "-c", driver], env=env, cwd=here
+            )
+            if rc == 0:
+                rc = subprocess.call(
+                    [sys.executable,
+                     os.path.join("tools", "chaos_report.py"), jsonl],
+                    cwd=here,
+                )
+            if rc != 0:
+                return rc
+        finally:
+            try:
+                os.unlink(jsonl)
+            except OSError:
+                pass
+    return 0
+
+
+# Restart-drill drivers for the --coldstart gate.  Each runs in its OWN
+# subprocess so the restore leg is a true fresh interpreter: nothing
+# carries over but the artifact dir + manifest on disk.
+
+_COLDSTART_WARM = """
+import sys
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+art, man = sys.argv[1], sys.argv[2]
+rng = np.random.default_rng(0)
+n1, n2 = 10, 20
+A1 = rng.standard_normal((n1, n1)) + n1 * np.eye(n1)
+B1 = rng.standard_normal((n1, 2))
+G = rng.standard_normal((n2, n2))
+A2 = G @ G.T + n2 * np.eye(n2)
+B2 = rng.standard_normal((n2, 3))
+
+cache = ExecutableCache(manifest_path=man, artifact_dir=art)
+# schedule="recursive": pure-JAX kernels whose exported modules are
+# custom-call free, so every bucket lands on the export rung (auto
+# routes to vendor LAPACK on CPU -> cache_seed, no zero-compile leg)
+svc = SolverService(cache=cache, batch_max=4, batch_window_s=0.005,
+                    dim_floor=16, nrhs_floor=4, schedule="recursive")
+assert svc.wait_ready(120), svc.health()
+futs = [svc.submit("gesv", A1 + i * 0.01 * np.eye(n1), B1)
+        for i in range(4)]
+futs += [svc.submit("posv", A2, B2)]
+for f in futs:
+    assert np.all(np.isfinite(f.result(timeout=300)))
+# build + persist BOTH batch points of both buckets (traffic above
+# registered them in the manifest; warmup bakes the rest to artifacts)
+compiled = cache.warmup(batch_max=4)
+svc.stop()
+import os
+n_art = len([f for f in os.listdir(art) if f.endswith(".slate_exe")])
+assert n_art >= 4, f"expected >= 4 artifacts, found {n_art}"
+print(f"coldstart warm: {compiled} warmup compiles, {n_art} artifacts")
+"""
+
+_COLDSTART_RESTORE = """
+import sys
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+art, man, leg = sys.argv[1], sys.argv[2], sys.argv[3]
+rng = np.random.default_rng(1)
+n1, n2 = 10, 20
+A1 = rng.standard_normal((n1, n1)) + n1 * np.eye(n1)
+B1 = rng.standard_normal((n1, 2))
+G = rng.standard_normal((n2, n2))
+A2 = G @ G.T + n2 * np.eye(n2)
+B2 = rng.standard_normal((n2, 3))
+
+cache = ExecutableCache(manifest_path=man, artifact_dir=art)
+svc = SolverService(cache=cache, batch_max=4, batch_window_s=0.005,
+                    dim_floor=16, nrhs_floor=4,
+                    schedule="recursive")  # restores on start
+assert svc.wait_ready(300), svc.health()
+h = svc.health()
+assert h["ready"] and h["phase"] == "ready", h
+res = h["restore"]
+assert res is not None and res["failed"] == 0, res
+if leg == "clean":
+    # every entry must come from a verified artifact, zero recompiles
+    assert res["compiled"] == 0 and res["restored"] >= 4, res
+elif leg == "flipped":
+    # the byte-flipped artifact must be detected and recompiled
+    assert res["compiled"] >= 1, res
+    assert metrics.counters().get("serve.artifact_corrupt", 0) >= 1
+elif leg == "chaos":
+    # once-per-site injection: corrupt, stale, load_fail each eat one
+    # load; the fourth restores clean
+    assert res["compiled"] == 3 and res["restored"] == 1, res
+
+with metrics.deltas() as d:
+    futs = []
+    for i in range(4):
+        futs.append(svc.submit("gesv", A1 + i * 1e-3 * np.eye(n1), B1))
+        futs.append(svc.submit("posv", A2 + i * 1e-3 * np.eye(n2), B2))
+    for f in futs:
+        assert np.all(np.isfinite(f.result(timeout=300)))
+    for i in range(12):
+        X1 = svc.submit("gesv", A1, B1).result(timeout=300)
+    X2 = svc.submit("posv", A2, B2).result(timeout=300)
+    assert d.get("serve.requests") >= 20
+    assert d.get("jit.compilations") == 0, (
+        "restored steady state must not compile: "
+        f"{d.get('jit.compilations')}")
+svc.stop()
+# correctness vs numpy (no slate dispatch: keeps the window honest)
+assert np.abs(X1 - np.linalg.solve(A1, B1)).max() < 1e-9
+assert np.abs(X2 - np.linalg.solve(A2, B2)).max() < 1e-9
+print(f"coldstart {leg}: ready via {res}, "
+      f"{int(d.get('serve.requests'))} requests, 0 compiles"
+      if leg == "clean" else
+      f"coldstart {leg}: ready via {res}, recovered correctly")
+"""
+
+
+def coldstart() -> int:
+    """Cold-start gate, three legs sharing one artifact dir: (1) the
+    artifact suite; (2) the ISSUE restart drill — warm a service in
+    one process, restore in a FRESH process with zero compiles in a
+    >= 20-request steady-state stream, then byte-flip one artifact and
+    drill again expecting a counted recompile; (3) a chaos pass arming
+    the three artifact fault sites, gated by tools/artifact_report.py
+    (nonzero when any injected fault escaped verification)."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_artifacts.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_coldstart_") as td:
+        art = os.path.join(td, "artifacts")
+        man = os.path.join(td, "warmup.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("SLATE_TPU_FAULTS", None)
+
+        def run(code, *argv, **extra_env):
+            e = dict(env, **extra_env)
+            return subprocess.call(
+                [sys.executable, "-c", code, *argv], env=e, cwd=here
+            )
+
+        rc = run(_COLDSTART_WARM, art, man)
+        if rc != 0:
+            return rc
+        rc = run(_COLDSTART_RESTORE, art, man, "clean",
+                 SLATE_TPU_METRICS=os.path.join(td, "clean.jsonl"))
+        if rc != 0:
+            return rc
+        # byte-flip drill: corrupt one artifact payload on disk
+        victims = sorted(
+            f for f in os.listdir(art) if f.endswith(".slate_exe")
+        )
+        path = os.path.join(art, victims[0])
+        blob = bytearray(open(path, "rb").read())
+        blob[-3] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        rc = run(_COLDSTART_RESTORE, art, man, "flipped",
+                 SLATE_TPU_METRICS=os.path.join(td, "flipped.jsonl"))
+        if rc != 0:
+            return rc
+        # chaos leg: every artifact fault site injected once, then the
+        # report joins injected-vs-detected from the JSONL
+        jsonl = os.path.join(td, "chaos.jsonl")
+        rc = run(
+            _COLDSTART_RESTORE, art, man, "chaos",
+            SLATE_TPU_METRICS=jsonl,
+            SLATE_TPU_FAULTS=(
+                "artifact_corrupt:once;artifact_stale:once;"
+                "artifact_load_fail:once"
+            ),
+        )
         if rc != 0:
             return rc
         return subprocess.call(
-            [sys.executable, os.path.join("tools", "chaos_report.py"), jsonl],
+            [sys.executable, os.path.join("tools", "artifact_report.py"),
+             jsonl],
             cwd=here,
         )
-    finally:
-        try:
-            os.unlink(jsonl)
-        except OSError:
-            pass
 
 
 def main() -> int:
@@ -249,6 +470,11 @@ def main() -> int:
     ap.add_argument("--refine", action="store_true",
                     help="run the mixed-precision refinement suite + the "
                          "refine_report fallback-rate gate")
+    ap.add_argument("--coldstart", action="store_true",
+                    help="run the artifact suite + the restart drill "
+                         "(fresh-process restore with 0 compiles, "
+                         "byte-flip recovery) + the artifact_report "
+                         "chaos gate")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -265,6 +491,8 @@ def main() -> int:
         return chaos()
     if args.refine:
         return refine_gate()
+    if args.coldstart:
+        return coldstart()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

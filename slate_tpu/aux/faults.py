@@ -24,6 +24,16 @@ Sites (:data:`SITES`) and where they are checked:
                        ``info=`` spec key (``cache.run``); also a fake
                        nonzero factor info in the mixed drivers'
                        factor step (fallback-solver exercise)
+    ``artifact_corrupt``   a loaded executable artifact's payload gets
+                       one byte flipped before the checksum runs, so
+                       the integrity check must catch it
+                       (``serve.artifacts.ArtifactStore.load``)
+    ``artifact_stale`` the load-time fingerprint is perturbed, as if
+                       the artifact were written by a different
+                       jaxlib/device/x64 environment (``ArtifactStore.load``)
+    ``artifact_load_fail`` deserialization of a verified artifact
+                       raises (``ArtifactStore.load``) — the degrade
+                       ladder must fall through to a recompile
 
 Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
 site, so the fire pattern is a pure function of ``seed`` and the call
@@ -82,6 +92,9 @@ SITES = (
     "latency",
     "worker_death",
     "info_nonzero",
+    "artifact_corrupt",
+    "artifact_stale",
+    "artifact_load_fail",
 )
 
 

@@ -349,6 +349,7 @@ class Option(enum.Enum):
     ServeBreakerCooldown = "serve_breaker_cooldown"  # open -> half-open, s
     ServeValidate = "serve_validate"  # admission finiteness checks
     ServePrecision = "serve_precision"  # bucket solve precision: full|mixed
+    ServeArtifacts = "serve_artifacts"  # executable artifact dir (cold start)
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

@@ -52,6 +52,7 @@ _DEFAULTS = {
     Option.ServeBreakerCooldown: 5.0,
     Option.ServeValidate: True,
     Option.ServePrecision: "full",  # bucket solve precision (full|mixed)
+    Option.ServeArtifacts: "",  # executable artifact dir ("" = env/off)
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

@@ -2,8 +2,11 @@
 
 Shape-bucketed dispatch (`buckets`), an executable cache with a
 persistent warmup manifest (`cache`, ``SLATE_TPU_WARMUP=/path.json``),
-a deadline-aware batching service (`service`), and thin sync wrappers
-(`api`): ``serve.gesv/posv/gels``, ``serve.submit``, ``serve.warmup``.
+a durable executable artifact store for crash-safe cold starts
+(`artifacts`, ``SLATE_TPU_ARTIFACTS=/dir``), a deadline-aware batching
+service with a cold/restoring/ready readiness phase (`service`), and
+thin sync wrappers (`api`): ``serve.gesv/posv/gels``,
+``serve.submit``, ``serve.warmup``, ``serve.restore``.
 
 Attribute access is lazy (PEP 562): importing ``slate_tpu.serve`` (or
 ``serve.buckets`` from the drivers) never pulls the driver stack, so
@@ -16,19 +19,24 @@ from __future__ import annotations
 import importlib
 
 _API = (
-    "gesv", "posv", "gels", "submit", "warmup", "configure", "shutdown",
-    "get_service", "get_cache", "health", "InvalidInput",
+    "gesv", "posv", "gels", "submit", "warmup", "restore", "wait_ready",
+    "configure", "shutdown", "get_service", "get_cache", "health",
+    "InvalidInput",
 )
 _SERVICE = (
     "SolverService", "Rejected", "DeadlineExceeded", "decorrelated_backoff",
+    "PHASE_COLD", "PHASE_RESTORING", "PHASE_READY",
 )
 _CACHE = ("ExecutableCache", "direct_call", "WARMUP_ENV")
 _BUCKETS = (
     "BucketKey", "Breaker", "bucket_for", "bucket_dim", "halving_bucket",
     "size_bucket_runs", "batch_bucket",
 )
+_ARTIFACTS = ("ArtifactStore", "ARTIFACTS_ENV", "store_from_env")
 
-__all__ = list(_API + _SERVICE + _CACHE + _BUCKETS) + ["api", "buckets"]
+__all__ = list(_API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS) + [
+    "api", "buckets", "artifacts",
+]
 
 
 def __getattr__(name: str):
@@ -40,4 +48,8 @@ def __getattr__(name: str):
         return getattr(importlib.import_module(".cache", __name__), name)
     if name in _BUCKETS:
         return getattr(importlib.import_module(".buckets", __name__), name)
+    if name in _ARTIFACTS:
+        return getattr(
+            importlib.import_module(".artifacts", __name__), name
+        )
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

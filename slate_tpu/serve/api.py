@@ -115,6 +115,29 @@ def warmup(
     )
 
 
+def restore(verbose: bool = False) -> dict:
+    """Bring the warmed executable set live artifact-first (the
+    cold-start path: each manifest entry is loaded from the
+    ``SLATE_TPU_ARTIFACTS`` store where a verified artifact exists,
+    compiled otherwise, and primed).  Returns the cache's restore
+    summary ``{"entries", "restored", "compiled", "failed",
+    "skipped"}``.  A
+    service with an artifact store runs this automatically on start —
+    poll ``health()["phase"]`` (cold -> restoring -> ready) or call
+    :func:`wait_ready` to gate traffic on it.  Any start-time pass is
+    waited out first, so this never races it (already-live entries
+    make the explicit pass a cheap no-op)."""
+    svc = get_service()
+    svc.wait_ready()
+    return svc.cache.restore(batch_max=svc.batch_max, verbose=verbose)
+
+
+def wait_ready(timeout: Optional[float] = None) -> bool:
+    """Block until the process service reaches the ``ready`` phase
+    (its start-time restore pass finished); False on timeout."""
+    return get_service().wait_ready(timeout)
+
+
 def submit(
     routine: str,
     A,

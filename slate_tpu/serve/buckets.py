@@ -32,6 +32,7 @@ cycle through the lazy ``serve`` package.
 
 from __future__ import annotations
 
+import hashlib
 import json
 from dataclasses import dataclass
 from typing import Iterator, Sequence, Tuple
@@ -328,6 +329,30 @@ def pad_waste(key: BucketKey, m: int, n: int, nrhs: int) -> int:
     true = m * n + m * nrhs
     padded = key.m * key.n + key.m * key.nrhs
     return max(padded - true, 0)
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting (the durable-artifact identity, serve/artifacts.py)
+# ---------------------------------------------------------------------------
+
+
+def content_fields(key: BucketKey, batch: int) -> dict:
+    """The *content* half of an executable artifact's identity: every
+    BucketKey field (schedule and precision included — two executables
+    traced from different schedules or solve paths are different
+    programs) plus the batch point.  Pure and canonical; the *runtime*
+    half (jaxlib/backend version, device kind, x64 mode) is appended by
+    ``serve/artifacts.py``, which may import jax."""
+    return {**key.to_json(), "batch": int(batch)}
+
+
+def fingerprint(fields: dict) -> str:
+    """Stable hex digest of a fingerprint field dict: sha256 over the
+    canonical (sorted-key, compact) JSON encoding, so any drift in any
+    field — bucket shape, schedule, precision, jaxlib, device kind,
+    x64 — produces a different artifact identity."""
+    blob = json.dumps(fields, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
 
 
 def manifest_dumps(entries) -> str:

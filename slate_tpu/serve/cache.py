@@ -9,6 +9,14 @@ deployment's steady-state bucket set accumulates across runs and
 stream of requests in warmed buckets is compile-free (the
 ``jit.compilations`` counter stays flat).
 
+With ``SLATE_TPU_ARTIFACTS=/dir`` (or an explicit ``artifact_dir``)
+the cache also consults a durable
+:class:`~slate_tpu.serve.artifacts.ArtifactStore` before every cold
+build and persists every build back to it, so a *fresh process*
+pointed at the same directory restores the warmed executable set
+(``restore()``) instead of recompiling it — the manifest stays the
+recipe, the artifact store is the baked result.
+
 Executable shape: ``fn(A_batch, B_batch) -> (X_batch, info_batch)``
 with ``A: (batch, Mb, Nb)``, ``B: (batch, Mb, nrhs_b)`` — the drivers
 vmapped over the leading axis (Matrix construction from the padded
@@ -23,15 +31,22 @@ from __future__ import annotations
 import os
 import threading
 import time
+import warnings
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from ..aux import faults, metrics
 from ..exceptions import NumericalError
+from .artifacts import ArtifactStore, store_from_env
 from .buckets import BucketKey, manifest_dumps, manifest_loads
 
 WARMUP_ENV = "SLATE_TPU_WARMUP"
+
+#: manifest paths already warned about this process (warn once, not per
+#: ExecutableCache — a fleet of services sharing one bad path should
+#: not spam)
+_warned_manifests: Set[str] = set()
 
 
 def _build_core(key: BucketKey) -> Callable:
@@ -154,13 +169,24 @@ def _warm_inputs(key: BucketKey, batch: int) -> Tuple[np.ndarray, np.ndarray]:
 
 class ExecutableCache:
     """(BucketKey, batch) -> compiled executable, with manifest
-    persistence.  Thread-safe: the service worker and warmup() may race
-    on first build."""
+    persistence and (``artifact_dir`` / ``SLATE_TPU_ARTIFACTS``) an
+    :class:`~slate_tpu.serve.artifacts.ArtifactStore` consulted
+    *before* every cold build — restore beats recompile.  Thread-safe:
+    the service worker, warmup() and restore() may race on first
+    build."""
 
-    def __init__(self, manifest_path: Optional[str] = None):
+    def __init__(
+        self,
+        manifest_path: Optional[str] = None,
+        artifact_dir: Optional[str] = None,
+    ):
         self._lock = threading.RLock()
         self._exes: Dict[Tuple[BucketKey, int], Callable] = {}
         self._entries: Set[Tuple[BucketKey, int]] = set()
+        # how each live executable came to be: "artifact" (export blob
+        # deserialized) or "compile" (built here) — restore() reports it
+        self._origin: Dict[Tuple[BucketKey, int], str] = {}
+        self.artifacts: Optional[ArtifactStore] = store_from_env(artifact_dir)
         self.manifest_path = (
             manifest_path
             if manifest_path is not None
@@ -170,8 +196,20 @@ class ExecutableCache:
             try:
                 with open(self.manifest_path) as f:
                     self._entries.update(manifest_loads(f.read()))
-            except (OSError, ValueError, KeyError):
-                pass  # a corrupt manifest must never block serving
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                # a corrupt manifest must never block serving — but a
+                # silently ignored one hides that every bucket will pay
+                # a cold compile: count it and warn once per path
+                metrics.inc("serve.manifest_corrupt")
+                if self.manifest_path not in _warned_manifests:
+                    _warned_manifests.add(self.manifest_path)
+                    warnings.warn(
+                        f"corrupt warmup manifest at {self.manifest_path!r}"
+                        f" ({type(e).__name__}: {e}); starting with an "
+                        "empty bucket set — steady state will recompile",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
 
     # -- manifest ----------------------------------------------------------
 
@@ -224,31 +262,81 @@ class ExecutableCache:
 
     # -- executables -------------------------------------------------------
 
+    def _arg_specs(self, key: BucketKey, batch: int):
+        """ShapeDtypeStructs of one executable's padded batch operands
+        (the jax.export symbol table for save/load)."""
+        import jax
+
+        dt = np.dtype(key.dtype)
+        return (
+            jax.ShapeDtypeStruct((batch, key.m, key.n), dt),
+            jax.ShapeDtypeStruct((batch, key.m, key.nrhs), dt),
+        )
+
     def executable(self, key: BucketKey, batch: int) -> Callable:
-        """Get (building + recording on miss) the compiled executable."""
+        """Get the compiled executable: memory cache, then the artifact
+        store (a verified ``jax.export`` blob re-jits without retracing
+        the drivers), then a cold build — which is persisted back to
+        the store so the *next* replica restores instead.  Every
+        artifact-verification failure (stale/corrupt/load_fail) has
+        already been counted by the store and lands here on the build
+        path: the degradation is a recompile, never an error."""
         with self._lock:
             exe = self._exes.get((key, batch))
             if exe is not None:
                 return exe
-        faults.check("compile")  # cold builds only: a cache hit never fires
         import jax
 
-        core = _build_core(key)
         name = f"serve.{key.label}.b{batch}"
-        # donate the padded batch operands on accelerators: run() always
-        # builds them fresh from the request's host arrays, so the
-        # factorizations work in place instead of paying a batch-sized
-        # copy per dispatch (XLA:CPU has no donation and would warn).
-        jit_kw = {}
-        if jax.default_backend() != "cpu":
-            jit_kw["donate_argnums"] = (0, 1)
+        origin = "compile"
+        jitted = None
+        if self.artifacts is not None:
+            call = self.artifacts.load(key, batch)
+            if call is not None:
+                # re-jit of deserialized StableHLO: no Python retrace,
+                # no jax lowering; the backend compile is served by the
+                # store-seeded persistent XLA cache.  (Donation is not
+                # re-applied — exported modules own their buffers.)
+                jitted = jax.jit(call)
+                origin = "artifact"
+        if jitted is None:
+            faults.check("compile")  # cold builds only: loads never fire
+            core = _build_core(key)
+            # donate the padded batch operands on accelerators: run()
+            # always builds them fresh from the request's host arrays,
+            # so the factorizations work in place instead of paying a
+            # batch-sized copy per dispatch (XLA:CPU has no donation
+            # and would warn).
+            jit_kw = {}
+            if jax.default_backend() != "cpu":
+                jit_kw["donate_argnums"] = (0, 1)
+            jitted = jax.jit(jax.vmap(core), **jit_kw)
+            if self.artifacts is not None and not (
+                self.artifacts.verified_cache_seed(key, batch)
+            ):
+                # persist for the next replica — exporting a NON-donated
+                # jit of the same core (jax.export refuses donated
+                # computations, which would demote every accelerator
+                # bucket to the cache_seed rung; the loaded artifact
+                # re-jits without donation anyway).  A load that just
+                # verified a cache_seed entry for this fingerprint is
+                # NOT re-saved: the rewrite would be byte-identical and
+                # the export attempt is a full retrace on the worker
+                # thread.
+                export_target = (
+                    jax.jit(jax.vmap(core)) if jit_kw else jitted
+                )
+                self.artifacts.save(
+                    key, batch, export_target, self._arg_specs(key, batch)
+                )
         # capture_cost=False: the AOT second compile would double every
         # warmup (metrics still splits compile-vs-run wall per bucket)
-        exe = metrics.instrument_jit(
-            jax.jit(jax.vmap(core), **jit_kw), name, capture_cost=False
-        )
+        exe = metrics.instrument_jit(jitted, name, capture_cost=False)
         with self._lock:
-            exe = self._exes.setdefault((key, batch), exe)
+            prev = self._exes.setdefault((key, batch), exe)
+            if prev is exe:
+                self._origin[(key, batch)] = origin
+            exe = prev
         self._record(key, batch)
         return exe
 
@@ -280,9 +368,12 @@ class ExecutableCache:
         verbose: bool = False,
     ) -> int:
         """Pre-compile every manifest entry (plus ``path``'s entries if
-        given).  Returns the number of executables compiled.  Per-bucket
-        compile walls land in the ``serve.<bucket>.b<batch>.compile``
-        timers; the whole pass under the ``serve.warmup`` timer."""
+        given).  Returns the number of executables compiled — entries
+        that ``executable()`` served from the artifact store instead
+        are not counted (zero compiles happened; ``restore()`` is the
+        pass that reports restores).  Per-bucket compile walls land in
+        the ``serve.<bucket>.b<batch>.compile`` timers; the whole pass
+        under the ``serve.warmup`` timer."""
         with self._lock:  # the worker may add entries concurrently
             todo = list(self._entries)
         if path is not None and os.path.exists(path):
@@ -301,7 +392,8 @@ class ExecutableCache:
                 t0 = time.perf_counter()
                 A, B = _warm_inputs(key, batch)
                 X, info = self.run(key, A, B)
-                compiled += 1
+                if self._origin.get((key, batch)) != "artifact":
+                    compiled += 1  # an artifact hit compiled nothing
                 if verbose:
                     print(
                         f"[serve.warmup] {key.label} b{batch}: "
@@ -310,3 +402,72 @@ class ExecutableCache:
         metrics.gauge("serve.warmup_s", ph.seconds)
         metrics.inc("serve.warmup_compiles", compiled)
         return compiled
+
+    # -- restore (artifact-first cold start) -------------------------------
+
+    def restore(
+        self,
+        batch_max: Optional[int] = None,
+        verbose: bool = False,
+        stop_check: Optional[Callable[[], bool]] = None,
+    ) -> Dict[str, int]:
+        """Bring every manifest entry live, artifact-first: load (or,
+        where the store has nothing valid, compile) each executable and
+        prime it with one dummy dispatch, so a subsequent steady-state
+        stream never traces or compiles.  This is the cold-start path a
+        fresh replica runs before reporting ``ready``.
+
+        Per-entry failures (a fault-injected load, an execute fault on
+        the priming dispatch, a poisoned artifact dir) are counted and
+        skipped, never raised — a damaged store degrades the replica to
+        recompiles-on-traffic, it does not keep it from coming up.
+
+        Returns ``{"entries", "restored", "compiled", "failed",
+        "skipped"}`` (restored = served from an export artifact;
+        compiled = any other rung of the ladder, including cache_seed
+        recompiles; skipped = already live when the pass reached it —
+        e.g. traffic served while restoring built it first — so
+        ``entries == restored + compiled + failed + skipped`` always
+        holds).
+
+        ``stop_check`` is polled between entries; True abandons the
+        rest of the pass (the service passes its stopped flag so a
+        replica torn down mid-restore does not keep compiling a large
+        manifest for minutes on a daemon thread)."""
+        with self._lock:
+            todo = sorted(self._entries, key=lambda e: (e[0].label, e[1]))
+        out = {
+            "entries": 0, "restored": 0, "compiled": 0, "failed": 0,
+            "skipped": 0,
+        }
+        with metrics.phase("serve.restore", always=True) as ph:
+            for key, batch in todo:
+                if stop_check is not None and stop_check():
+                    metrics.inc("serve.restore_stopped")
+                    break
+                if batch_max is not None and batch > batch_max:
+                    continue
+                out["entries"] += 1
+                with self._lock:
+                    if (key, batch) in self._exes:
+                        out["skipped"] += 1  # already live (a race won)
+                        continue
+                t0 = time.perf_counter()
+                try:
+                    A, B = _warm_inputs(key, batch)
+                    self.run(key, A, B)  # loads-or-builds, then primes
+                except Exception:  # noqa: BLE001 — degrade, never crash
+                    out["failed"] += 1
+                    metrics.inc("serve.restore_failed")
+                    continue
+                origin = self._origin.get((key, batch), "compile")
+                out["restored" if origin == "artifact" else "compiled"] += 1
+                if verbose:
+                    print(
+                        f"[serve.restore] {key.label} b{batch}: {origin} "
+                        f"{time.perf_counter() - t0:.2f}s"
+                    )
+        metrics.gauge("serve.restore_s", ph.seconds)
+        metrics.inc("serve.restore_restored", out["restored"])
+        metrics.inc("serve.restore_compiled", out["compiled"])
+        return out

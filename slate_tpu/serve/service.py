@@ -47,7 +47,16 @@ scaling PRs widen into multi-host dispatch / priority tiers):
   item on the direct driver instead of delivering garbage.
 * :meth:`SolverService.health` returns a liveness/readiness snapshot
   (queue depth, worker liveness + restarts, per-bucket breaker states,
-  recent failure rate) for external probes.
+  recent failure rate) for external probes — including the cold-start
+  **readiness phase** ``cold`` -> ``restoring`` -> ``ready``: a
+  service whose cache has an artifact store (``SLATE_TPU_ARTIFACTS``)
+  restores every manifest entry on :meth:`start` in a background
+  thread (serve/artifacts degrade ladder: verified artifact ->
+  manifest recompile -> cold compile) before reporting ``ready``, so
+  an orchestrator can gate traffic until the warmed executable set is
+  live.  Requests submitted while ``restoring`` are still served
+  (possibly paying a compile); the phase is a gate for callers, not an
+  admission check.
 
 Every exception set on a future carries structured context
 (``routine``/``bucket``/``attempt``, :meth:`SlateError.with_context`).
@@ -94,6 +103,14 @@ class DeadlineExceeded(SlateError):
 
 #: ceiling for one decorrelated-jitter backoff step, seconds
 BACKOFF_CAP_S = 2.0
+
+#: readiness phases (health()["phase"]): cold = constructed, warmed
+#: set not live; restoring = the start-time artifact/manifest restore
+#: pass is running; ready = the restore pass finished (or there was
+#: nothing to restore) — orchestrators gate traffic on "ready"
+PHASE_COLD = "cold"
+PHASE_RESTORING = "restoring"
+PHASE_READY = "ready"
 
 
 def decorrelated_backoff(
@@ -175,6 +192,13 @@ class SolverService:
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
         :meth:`stop`.
+    restore_on_start: run the cache's artifact/manifest restore pass
+        in a background thread on :meth:`start`, holding
+        ``health()["phase"]`` at ``"restoring"`` until it completes.
+        None (default) = auto: restore exactly when the cache has an
+        artifact store configured (``SLATE_TPU_ARTIFACTS``).  The
+        pass never raises — a damaged store degrades to
+        recompile-on-traffic and the service still reaches ``ready``.
     start: set False to build paused (tests; call :meth:`start`).
     """
 
@@ -195,6 +219,7 @@ class SolverService:
         schedule: Optional[str] = None,
         precision: Optional[str] = None,
         faults_spec: Optional[str] = None,
+        restore_on_start: Optional[bool] = None,
         start: bool = True,
     ):
         # None -> the Serve* Option defaults (one source of truth with
@@ -202,7 +227,13 @@ class SolverService:
         from ..enums import Option, Schedule
         from ..options import get_option
 
-        self.cache = cache if cache is not None else ExecutableCache()
+        if cache is None:
+            # default cache: Option.ServeArtifacts names the artifact
+            # dir (SLATE_TPU_ARTIFACTS env inside the cache otherwise)
+            cache = ExecutableCache(
+                artifact_dir=get_option(None, Option.ServeArtifacts) or None
+            )
+        self.cache = cache
         self.max_queue = int(
             max_queue if max_queue is not None
             else get_option(None, Option.ServeQueueLimit)
@@ -249,6 +280,10 @@ class SolverService:
         if faults_spec:
             faults.configure(faults_spec)
             faults.on()
+        self._restore_on_start = restore_on_start
+        self._phase = PHASE_COLD
+        self._restore_result: Optional[Dict[str, int]] = None
+        self._restore_thread: Optional[threading.Thread] = None
         self._rng = random.Random(retry_seed)
         self._q: Deque[_Request] = deque()
         self._cond = threading.Condition()
@@ -272,7 +307,75 @@ class SolverService:
             self._running = True
             self._stopped = False
         self._spawn_worker()
+        self._begin_restore()
         return self
+
+    def _begin_restore(self) -> None:
+        """Kick the one-time cold-start restore pass (phase cold ->
+        restoring -> ready).  Runs once per service: a stop()/start()
+        cycle keeps the already-ready phase (the executables are still
+        in memory)."""
+        want = (
+            self._restore_on_start
+            if self._restore_on_start is not None
+            else self.cache.artifacts is not None
+        )
+        with self._cond:
+            if self._phase != PHASE_COLD:
+                return
+            if not want:
+                self._phase = PHASE_READY
+                return
+            self._phase = PHASE_RESTORING
+            t = threading.Thread(
+                target=self._run_restore, name="slate-serve-restore",
+                daemon=True,
+            )
+            self._restore_thread = t
+        t.start()
+
+    def _run_restore(self) -> None:
+        try:
+            result = self.cache.restore(
+                batch_max=self.batch_max,
+                stop_check=lambda: self._stopped,
+            )
+        except Exception:  # noqa: BLE001 — a broken store must not block ready
+            # distinct from the per-entry serve.restore_failed counter:
+            # the whole pass died before/outside the entry loop.  The
+            # sentinel keeps health()["restore"] distinguishable from
+            # "restore was never configured" (None).
+            metrics.inc("serve.restore_crashed")
+            result = {
+                "entries": 0, "restored": 0, "compiled": 0,
+                "failed": 0, "skipped": 0, "crashed": True,
+            }
+        with self._cond:
+            self._restore_result = result
+            self._phase = PHASE_READY
+            self._cond.notify_all()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the readiness phase reaches ``ready`` (True) or
+        the timeout elapses (False) — the in-process analogue of an
+        orchestrator polling ``health()["phase"]``.  A service built
+        paused (``start=False``) and never started returns False
+        immediately: nothing will ever advance its phase."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        with self._cond:
+            while self._phase != PHASE_READY:
+                if not self._running and self._phase == PHASE_COLD:
+                    return False  # never started; no restore coming
+                left = (
+                    deadline - time.monotonic()
+                    if deadline is not None else 0.1
+                )
+                if deadline is not None and left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.1) if left > 0 else 0.1)
+            return True
 
     def _spawn_worker(self) -> None:
         t = threading.Thread(
@@ -297,6 +400,12 @@ class SolverService:
             with self._cond:
                 if self._thread is t:
                     self._thread = None
+        # the restore thread polls _stopped between entries; bounded
+        # join so faults.reset() below never runs under a live pass
+        with self._cond:
+            rt = self._restore_thread
+        if rt is not None and rt.is_alive():
+            rt.join(timeout)
         for r in leftovers:
             _resolve_exc(r.future, Rejected("service stopped"), req=r)
         if self._owns_faults:
@@ -416,8 +525,15 @@ class SolverService:
             inflight = len(self._inflight)
             breakers = {k.label: b.state for k, b in self._breakers.items()}
             recent = [t for t in self._recent_fail if now - t <= window_s]
+            phase = self._phase
+            restore_result = (
+                dict(self._restore_result) if self._restore_result else None
+            )
         return {
             "ok": running and alive,
+            "phase": phase,
+            "ready": bool(running and alive and phase == PHASE_READY),
+            "restore": restore_result,
             "running": running,
             "worker_alive": alive,
             "worker_restarts": restarts,

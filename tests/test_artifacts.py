@@ -1,0 +1,543 @@
+"""Durable executable artifact tests: slate_tpu/serve/artifacts.
+
+Covers the fingerprint (content + runtime halves, sensitivity to every
+field), the integrity-verification ladder (miss / corrupt / stale /
+load_fail / cache_seed — each counted, none fatal), the cross-process
+write lock with stale-break, the cache integration (restore before
+compile, save after build, self-heal after corruption), the three new
+chaos sites, and the service readiness phases (cold -> restoring ->
+ready) with the in-process restart drill: a fresh cache on a warmed
+artifact dir serves a steady-state stream with ZERO compiles.
+
+A module-scoped warmed store is shared so the expensive builds happen
+once; corruption tests copy artifacts into per-test dirs rather than
+poisoning the shared store.  The true cross-process drill (new
+interpreter, same artifact dir) lives in ``run_tests.py --coldstart``.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.artifacts import (
+    ARTIFACTS_ENV,
+    ArtifactStore,
+    _FileLock,
+    runtime_fields,
+    store_from_env,
+)
+from slate_tpu.serve.cache import ExecutableCache, _warm_inputs, direct_call
+from slate_tpu.serve.service import (
+    PHASE_COLD,
+    PHASE_READY,
+    SolverService,
+)
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def clean_env():
+    """Metrics on (the artifact counters are the contract under test),
+    faults disarmed before AND after."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    faults.reset()
+    yield
+    faults.reset()
+    metrics.off()
+    metrics.reset()
+
+
+def _key(nrhs=2):
+    # schedule="recursive": the PR3 pure-JAX kernels trace custom-call
+    # free, so jax.export persists a module a FRESH process can run
+    # (schedule="auto" routes to vendor LAPACK on CPU, whose custom
+    # calls the portability guard sends to the cache_seed rung)
+    return bk.bucket_for(
+        "gesv", 10, 10, nrhs, np.float64, floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, schedule="recursive",
+    )
+
+
+@pytest.fixture(scope="module")
+def warmed(tmp_path_factory):
+    """One warmed (manifest + artifact dir) pair for the module: the
+    gesv 16x16x4 f64 bucket at both batch points, built once."""
+    root = tmp_path_factory.mktemp("artifacts")
+    man = str(root / "warmup.json")
+    art = str(root / "store")
+    metrics.on()  # records the builds; per-test fixture resets after
+    cache = ExecutableCache(manifest_path=man, artifact_dir=art)
+    cache.ensure_manifest(_key(), (1, 4))
+    cache.warmup(batch_max=4)
+    assert sorted(
+        n for n in os.listdir(art) if n.endswith(".slate_exe")
+    ), "warmup must have persisted artifacts"
+    return {"man": man, "art": art, "key": _key()}
+
+
+def _problem(n=10, nrhs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+def _copy_store(warmed, tmp_path):
+    dst = str(tmp_path / "store")
+    shutil.copytree(warmed["art"], dst)
+    # the copied lock/xla-cache dirs are fine; only .slate_exe matters
+    return dst
+
+
+def _artifact_path(store_dir, key, batch):
+    return ArtifactStore(store_dir).path_for(key, batch)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_content_fields_cover_schedule_precision_batch():
+    k = bk.BucketKey(
+        "gesv", 16, 16, 4, "float64", 16,
+        schedule="recursive", precision="mixed",
+    )
+    f = bk.content_fields(k, 4)
+    assert f["schedule"] == "recursive"
+    assert f["precision"] == "mixed"
+    assert f["batch"] == 4
+    base = bk.fingerprint(f)
+    for field, other in (
+        ("schedule", "flat"), ("precision", "full"), ("batch", 1),
+        ("dtype", "float32"), ("m", 32), ("nb", 8),
+    ):
+        assert bk.fingerprint({**f, field: other}) != base, field
+
+
+def test_runtime_fields_shape():
+    f = runtime_fields()
+    assert set(f) == {"jax", "jaxlib", "backend", "device_kind", "x64"}
+    assert f["backend"] == "cpu"
+    assert f["x64"] is True  # conftest enables x64
+
+
+def test_store_fingerprint_includes_runtime(tmp_path):
+    st = ArtifactStore(str(tmp_path / "s"))
+    fp, fields = st.fingerprint(_key(), 1)
+    assert fields["jaxlib"] and "batch" in fields and "x64" in fields
+    assert fp == bk.fingerprint(fields)
+
+
+# ---------------------------------------------------------------------------
+# store: save/load ladder
+# ---------------------------------------------------------------------------
+
+
+def test_load_miss_on_empty_store(tmp_path):
+    st = ArtifactStore(str(tmp_path / "s"))
+    with metrics.deltas() as d:
+        assert st.load(_key(), 1) is None
+    assert d.get("serve.artifact_miss") == 1
+    assert d.get(f"serve.artifact.{_key().label}.b1.miss") == 1
+
+
+def test_save_load_roundtrip_executes(warmed):
+    import jax
+
+    st = ArtifactStore(warmed["art"])
+    with metrics.deltas() as d:
+        call = st.load(warmed["key"], 1)
+    assert call is not None
+    assert d.get("serve.artifact_hit") == 1
+    A, B = _warm_inputs(warmed["key"], 1)
+    X, info = jax.jit(call)(A, B)
+    assert np.all(np.isfinite(np.asarray(X)))
+
+
+def test_corrupt_byte_flip_detected(warmed, tmp_path):
+    dst = _copy_store(warmed, tmp_path)
+    path = _artifact_path(dst, warmed["key"], 1)
+    blob = bytearray(open(path, "rb").read())
+    blob[-3] ^= 0xFF  # payload byte, past the header line
+    open(path, "wb").write(bytes(blob))
+    st = ArtifactStore(dst)
+    with metrics.deltas() as d:
+        assert st.load(warmed["key"], 1) is None
+    assert d.get("serve.artifact_corrupt") == 1
+    assert d.get("serve.artifact_hit") == 0
+
+
+def test_truncated_and_garbage_artifacts_are_corrupt(warmed, tmp_path):
+    dst = _copy_store(warmed, tmp_path)
+    path = _artifact_path(dst, warmed["key"], 1)
+    blob = open(path, "rb").read()
+    st = ArtifactStore(dst)
+    with metrics.deltas() as d:
+        open(path, "wb").write(blob[: len(blob) // 2])  # truncated payload
+        assert st.load(warmed["key"], 1) is None
+        open(path, "wb").write(b"not an artifact at all")  # garbage header
+        assert st.load(warmed["key"], 1) is None
+        open(path, "wb").write(b"")  # zero-length file
+        assert st.load(warmed["key"], 1) is None
+    assert d.get("serve.artifact_corrupt") == 3
+
+
+def test_stale_fingerprint_detected(warmed, tmp_path):
+    """A header written by a 'different' environment (here: its
+    fingerprint rewritten) must read as stale — checksum alone passing
+    is not enough to load."""
+    dst = _copy_store(warmed, tmp_path)
+    path = _artifact_path(dst, warmed["key"], 1)
+    blob = open(path, "rb").read()
+    nl = blob.index(b"\n")
+    header = json.loads(blob[:nl].decode())
+    header["fingerprint"] = "0" * 64  # stale: some other jaxlib/device
+    open(path, "wb").write(
+        (json.dumps(header, sort_keys=True) + "\n").encode() + blob[nl + 1:]
+    )
+    st = ArtifactStore(dst)
+    with metrics.deltas() as d:
+        assert st.load(warmed["key"], 1) is None
+    assert d.get("serve.artifact_stale") == 1
+    assert d.get("serve.artifact_corrupt") == 0
+
+
+def test_cache_seed_fallback_when_export_refuses(tmp_path, monkeypatch):
+    """Computations jax.export cannot serialize (donated/sharded) must
+    still produce a durable entry — mode cache_seed — and load as a
+    counted recompile, never an error."""
+    import jax
+
+    def boom(*a, **kw):
+        raise NotImplementedError("export unsupported for this computation")
+
+    monkeypatch.setattr(jax.export, "export", boom)
+    st = ArtifactStore(str(tmp_path / "s"))
+    key = _key()
+    jitted = jax.jit(lambda a, b: (a, np.int32(0)))
+    mode = st.save(key, 1, jitted, ())
+    assert mode == "cache_seed"
+    entry = [e for e in st.entries() if "error" not in e][0]
+    assert entry["mode"] == "cache_seed" and entry["payload_bytes"] == 0
+    monkeypatch.undo()
+    with metrics.deltas() as d:
+        assert st.load(key, 1) is None  # recompile rung, XLA-cache warmed
+    assert d.get("serve.artifact_cache_seed") == 1
+    assert d.get("serve.artifact_corrupt") == 0
+
+
+def test_nonportable_custom_calls_take_cache_seed_rung(tmp_path):
+    """An executable whose exported module embeds vendor custom calls
+    (jnp.linalg.solve lowers to LAPACK ffi calls on CPU) must NOT be
+    persisted as an export blob — a deserialized vendor call can
+    segfault in a fresh process, which no checksum catches.  The guard
+    routes it to cache_seed and records why."""
+    import jax
+    import jax.numpy as jnp
+
+    st = ArtifactStore(str(tmp_path / "s"))
+    key = bk.bucket_for(
+        "gesv", 10, 10, 2, np.float64, floor=FLOOR, nrhs_floor=NRHS_FLOOR
+    )  # schedule="auto" -> vendor LAPACK on CPU
+    jitted = jax.jit(
+        lambda a, b: (jnp.linalg.solve(a, b), jnp.zeros((1,), jnp.int32))
+    )
+    specs = (
+        jax.ShapeDtypeStruct((1, 16, 16), np.float64),
+        jax.ShapeDtypeStruct((1, 16, NRHS_FLOOR), np.float64),
+    )
+    with metrics.deltas() as d:
+        assert st.save(key, 1, jitted, specs) == "cache_seed"
+    assert d.get("serve.artifact_saved_cache_seed") == 1
+    [entry] = [e for e in st.entries() if "error" not in e]
+    assert entry["mode"] == "cache_seed" and entry["payload_bytes"] == 0
+    assert any("lapack" in t for t in entry["nonportable"]), entry
+
+
+def test_save_never_raises_on_unwritable_root(tmp_path):
+    st = ArtifactStore(str(tmp_path / "s"))
+    st.root = str(tmp_path / "s" / "gone" / "deeper")  # invalid mid-flight
+    with metrics.deltas() as d:
+        st.save(_key(), 1, None, ())  # jitted=None would also explode
+    assert d.get("serve.artifact_save_error") == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: the three new fault sites
+# ---------------------------------------------------------------------------
+
+
+def test_fault_site_artifact_corrupt(warmed):
+    st = ArtifactStore(warmed["art"])
+    faults.arm("artifact_corrupt", once=True)
+    faults.on()
+    with metrics.deltas() as d:
+        assert st.load(warmed["key"], 1) is None  # injected flip caught
+        assert st.load(warmed["key"], 1) is not None  # once => healthy after
+    assert d.get("serve.artifact_corrupt") == 1
+    assert d.get("faults.injected.artifact_corrupt") == 1
+    assert d.get("serve.artifact_hit") == 1
+
+
+def test_fault_site_artifact_stale(warmed):
+    st = ArtifactStore(warmed["art"])
+    faults.arm("artifact_stale", once=True)
+    faults.on()
+    with metrics.deltas() as d:
+        assert st.load(warmed["key"], 1) is None
+        assert st.load(warmed["key"], 1) is not None
+    assert d.get("serve.artifact_stale") == 1
+    assert d.get("faults.injected.artifact_stale") == 1
+
+
+def test_fault_site_artifact_load_fail(warmed):
+    st = ArtifactStore(warmed["art"])
+    faults.arm("artifact_load_fail", once=True)
+    faults.on()
+    with metrics.deltas() as d:
+        assert st.load(warmed["key"], 1) is None  # deserialize raised
+        assert st.load(warmed["key"], 1) is not None
+    assert d.get("serve.artifact_load_fail") == 1
+    assert d.get("faults.injected.artifact_load_fail") == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-process lock
+# ---------------------------------------------------------------------------
+
+
+def test_filelock_acquire_release(tmp_path):
+    p = str(tmp_path / ".lock")
+    with _FileLock(p):
+        assert os.path.exists(p)
+    assert not os.path.exists(p)
+
+
+def test_filelock_breaks_stale_lock(tmp_path):
+    p = str(tmp_path / ".lock")
+    open(p, "w").write("12345\n")
+    old = time.time() - 3600
+    os.utime(p, (old, old))  # a crashed writer's leftover
+    t0 = time.monotonic()
+    with _FileLock(p, timeout_s=5.0):
+        assert time.monotonic() - t0 < 1.0  # broke it, didn't wait out
+        assert os.path.exists(p)
+    assert not os.path.exists(p)
+
+
+def test_filelock_times_out_without_wedging(tmp_path):
+    p = str(tmp_path / ".lock")
+    open(p, "w").write("12345\n")  # fresh lock, never released
+    with metrics.deltas() as d:
+        t0 = time.monotonic()
+        with _FileLock(p, timeout_s=0.1, stale_s=3600):
+            pass  # proceeds unlocked: rename keeps writes atomic anyway
+        assert 0.1 <= time.monotonic() - t0 < 2.0
+    assert d.get("serve.artifact_lock_timeout") == 1
+    os.unlink(p)
+
+
+# ---------------------------------------------------------------------------
+# cache integration + readiness (the in-process restart drill)
+# ---------------------------------------------------------------------------
+
+
+def test_env_activation(tmp_path, monkeypatch):
+    monkeypatch.delenv(ARTIFACTS_ENV, raising=False)
+    assert store_from_env() is None
+    assert ExecutableCache(manifest_path=None).artifacts is None
+    monkeypatch.setenv(ARTIFACTS_ENV, str(tmp_path / "a"))
+    c = ExecutableCache(manifest_path=None)
+    assert c.artifacts is not None
+    assert c.artifacts.root == str(tmp_path / "a")
+
+
+def test_restart_drill_restore_then_zero_compiles(warmed):
+    """The acceptance drill, in-process: a FRESH cache pointed at the
+    warmed artifact dir restores (not recompiles), reaches ready, and
+    a >= 20-request steady-state stream pays zero jit compiles."""
+    cache = ExecutableCache(
+        manifest_path=warmed["man"], artifact_dir=warmed["art"]
+    )
+    svc = SolverService(
+        cache=cache, batch_max=4, batch_window_s=0.005,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, schedule="recursive",
+        start=False,
+    )
+    assert svc.health()["phase"] == PHASE_COLD
+    with metrics.deltas() as d:
+        svc.start()
+        assert svc.wait_ready(timeout=120)
+    h = svc.health()
+    assert h["phase"] == PHASE_READY and h["ready"]
+    assert h["restore"]["entries"] == 2
+    assert h["restore"]["restored"] == 2, h["restore"]
+    assert h["restore"]["compiled"] == 0 and h["restore"]["failed"] == 0
+    assert d.get("serve.artifact_hit") == 2
+    A, B = _problem()
+    with metrics.deltas() as d:
+        futs = []
+        for i in range(4):  # coalesced: the b4 batch point
+            futs.append(svc.submit("gesv", A + i * 1e-3 * np.eye(10), B))
+        for f in futs:
+            assert np.all(np.isfinite(f.result(timeout=120)))
+        for i in range(16):  # lone sequential: the b1 batch point
+            X = svc.submit("gesv", A, B).result(timeout=120)
+        assert d.get("serve.requests") == 20
+        assert d.get("jit.compilations") == 0, "restored steady state compiled"
+    ref = direct_call("gesv", A, B)
+    assert np.abs(X - ref).max() < 1e-9 * max(np.abs(ref).max(), 1.0)
+    svc.stop()
+
+
+def test_corrupt_artifact_recompiles_and_self_heals(warmed, tmp_path):
+    """Byte-flip drill: the corrupted entry falls back to a counted
+    recompile (results stay correct), the rebuild overwrites the bad
+    file, and the NEXT restore loads everything again."""
+    dst = _copy_store(warmed, tmp_path)
+    path = _artifact_path(dst, warmed["key"], 1)
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0x10
+    open(path, "wb").write(bytes(blob))
+
+    cache = ExecutableCache(manifest_path=warmed["man"], artifact_dir=dst)
+    with metrics.deltas() as d:
+        out = cache.restore(batch_max=4)
+    assert out == {"entries": 2, "restored": 1, "compiled": 1,
+                   "failed": 0, "skipped": 0}
+    assert d.get("serve.artifact_corrupt") == 1
+    assert d.get("serve.artifact_saved") == 1  # the self-heal rewrite
+    A, B = _problem()
+    svc = SolverService(
+        cache=cache, batch_max=4, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+        schedule="recursive",
+    )
+    X = svc.submit("gesv", A, B).result(timeout=120)
+    ref = direct_call("gesv", A, B)
+    assert np.abs(X - ref).max() < 1e-9 * max(np.abs(ref).max(), 1.0)
+    svc.stop()
+
+    cache2 = ExecutableCache(manifest_path=warmed["man"], artifact_dir=dst)
+    with metrics.deltas() as d:
+        out2 = cache2.restore(batch_max=4)
+    assert out2["restored"] == 2 and out2["compiled"] == 0  # healed
+    assert d.get("serve.artifact_corrupt") == 0
+
+
+def test_warmup_from_artifacts_counts_zero_compiles(warmed):
+    """warmup() on a fully-persisted store restores every entry, so it
+    must report 0 compiles (the compile accounting feeds alerting)."""
+    cache = ExecutableCache(
+        manifest_path=warmed["man"], artifact_dir=warmed["art"]
+    )
+    with metrics.deltas() as d:
+        assert cache.warmup(batch_max=4) == 0
+    assert d.get("serve.warmup_compiles") == 0
+    assert d.get("serve.artifact_hit") == 2
+
+
+def test_cache_seed_verdict_skips_redundant_resave(tmp_path, monkeypatch):
+    """A bucket whose artifact is (and stays) cache_seed must not pay
+    a jax.export retrace + byte-identical rewrite on every replica's
+    cold build — load() verified the entry; executable() trusts it."""
+    import jax
+
+    man = str(tmp_path / "m.json")
+    art = str(tmp_path / "a")
+    key = _key()
+    with monkeypatch.context() as m:
+        def boom(*a, **kw):
+            raise NotImplementedError("export unsupported")
+
+        m.setattr(jax.export, "export", boom)
+        c1 = ExecutableCache(manifest_path=man, artifact_dir=art)
+        c1.ensure_manifest(key, (1,))
+        c1.warmup(batch_max=1)  # persists a cache_seed entry
+    c2 = ExecutableCache(manifest_path=man, artifact_dir=art)
+    with metrics.deltas() as d:
+        c2.restore(batch_max=1)  # load -> cache_seed -> recompile
+    assert d.get("serve.artifact_cache_seed") == 1
+    assert d.get("serve.artifact_saved") == 0  # no byte-identical rewrite
+
+
+def test_wait_ready_false_on_never_started_service(warmed):
+    cache = ExecutableCache(
+        manifest_path=warmed["man"], artifact_dir=warmed["art"]
+    )
+    svc = SolverService(
+        cache=cache, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    t0 = time.time()
+    assert svc.wait_ready(timeout=30) is False  # immediate, not a hang
+    assert time.time() - t0 < 5.0
+    assert svc.health()["phase"] == PHASE_COLD
+
+
+def test_ready_immediately_without_artifact_store():
+    svc = SolverService(
+        cache=ExecutableCache(manifest_path=None),
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, start=False,
+    )
+    assert svc.health()["phase"] == PHASE_COLD
+    assert not svc.health()["ready"]
+    svc.start()
+    assert svc.wait_ready(timeout=10)
+    h = svc.health()
+    assert h["phase"] == PHASE_READY and h["ready"] and h["restore"] is None
+    svc.stop()
+
+
+def test_restore_on_start_false_skips_restore(warmed):
+    cache = ExecutableCache(
+        manifest_path=warmed["man"], artifact_dir=warmed["art"]
+    )
+    with metrics.deltas() as d:
+        svc = SolverService(
+            cache=cache, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+            restore_on_start=False,
+        )
+        assert svc.wait_ready(timeout=10)
+        assert svc.health()["restore"] is None
+        assert d.get("serve.artifact_hit") == 0
+    svc.stop()
+
+
+def test_restore_chaos_degrades_but_reaches_ready(warmed, tmp_path):
+    """All three artifact sites armed during a restore: every rung
+    degrades to a recompile, the service still reaches ready, and the
+    stream serves correct results."""
+    dst = _copy_store(warmed, tmp_path)
+    # first load: corrupt fires (and returns before the stale rung
+    # evaluates); second load: corrupt is spent, stale fires on its
+    # own first evaluation
+    faults.configure("artifact_corrupt:once;artifact_stale:once")
+    faults.on()
+    cache = ExecutableCache(manifest_path=warmed["man"], artifact_dir=dst)
+    svc = SolverService(
+        cache=cache, batch_max=4, dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+        schedule="recursive", start=False,
+    )
+    with metrics.deltas() as d:
+        svc.start()
+        assert svc.wait_ready(timeout=240)
+        h = svc.health()
+        assert h["restore"]["failed"] == 0
+        assert h["restore"]["compiled"] == 2  # both loads were injected
+        A, B = _problem()
+        X = svc.submit("gesv", A, B).result(timeout=120)
+    assert d.get("serve.artifact_corrupt") == 1
+    assert d.get("serve.artifact_stale") == 1
+    ref = direct_call("gesv", A, B)
+    assert np.abs(X - ref).max() < 1e-9 * max(np.abs(ref).max(), 1.0)
+    svc.stop()

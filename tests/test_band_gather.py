@@ -135,6 +135,7 @@ def test_heev_native_path_residual(rng):
     np.testing.assert_allclose(w, np.linalg.eigvalsh(A0), atol=1e-11 * n)
 
 
+@pytest.mark.slow
 def test_heev_spmd_two_stage_gather_free(rng, grid22, monkeypatch):
     """Distributed heev through the two-stage path never materializes a
     dense global array: stage 1 is the spmd pipeline, the stage gather

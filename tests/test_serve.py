@@ -5,6 +5,7 @@ A module-scoped ExecutableCache is shared across tests so each
 are built per test (cheap — one thread) against small bucket floors.
 """
 
+import json
 import os
 import time
 
@@ -95,6 +96,70 @@ def test_bucketkey_manifest_roundtrip(tmp_path):
     assert k1 == bk.BucketKey.from_json(k1.to_json())
 
 
+def _legacy_entry(**drop):
+    """One manifest entry as a pre-PR3/PR5 writer would have produced
+    it: no schedule and/or no precision key."""
+    e = {
+        "routine": "gesv", "m": 16, "n": 16, "nrhs": 4,
+        "dtype": "float64", "nb": 16, "tag": "", "batch": 1,
+        "schedule": "flat", "precision": "mixed",
+    }
+    for k in drop:
+        del e[k]
+    return e
+
+
+@pytest.mark.parametrize(
+    "drop", [("schedule",), ("precision",), ("schedule", "precision")],
+    ids=["no-schedule", "no-precision", "neither"],
+)
+def test_legacy_manifest_roundtrip_defaults(drop):
+    """Entries from manifests that predate the PR3 ``schedule`` and
+    PR5 ``precision`` BucketKey fields must load with the documented
+    defaults ("auto"/"full") and re-serialize canonically (both keys
+    present, so the manifest upgrades in place on the next flush)."""
+    legacy = _legacy_entry(**{k: 1 for k in drop})
+    text = json.dumps({"version": 1, "entries": [legacy]})
+    [(key, batch)] = bk.manifest_loads(text)
+    assert key.schedule == ("auto" if "schedule" in drop else "flat")
+    assert key.precision == ("full" if "precision" in drop else "mixed")
+    assert batch == 1
+    canon = json.loads(bk.manifest_dumps([(key, batch)]))
+    [entry] = canon["entries"]
+    assert entry["schedule"] == key.schedule  # re-serialized explicitly
+    assert entry["precision"] == key.precision
+    # and the canonical form round-trips to the identical key
+    assert bk.manifest_loads(json.dumps(canon)) == [(key, batch)]
+
+
+def test_corrupt_manifest_counts_and_warns_once(tmp_path):
+    """A corrupt warmup manifest must never block serving — but it is
+    counted (serve.manifest_corrupt) and warned about once per path,
+    not silently swallowed."""
+    path = str(tmp_path / "broken.json")
+    with open(path, "w") as f:
+        f.write('{"version": 1, "entries": [{"routine": "gesv"')  # torn
+    with metrics.deltas() as d:
+        with pytest.warns(RuntimeWarning, match="broken.json"):
+            c = ExecutableCache(manifest_path=path)
+        assert c.entries() == []  # serving continues, recipe empty
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():  # second open: counted, no spam
+            _warnings.simplefilter("error")
+            c2 = ExecutableCache(manifest_path=path)
+        assert c2.entries() == []
+    assert d.get("serve.manifest_corrupt") == 2
+    # entries missing required keys are also a corrupt manifest, not a
+    # crash (KeyError path)
+    with open(path, "w") as f:
+        f.write('{"version": 1, "entries": [{"routine": "gesv"}]}')
+    with metrics.deltas() as d:
+        c3 = ExecutableCache(manifest_path=path)
+        assert c3.entries() == []
+    assert d.get("serve.manifest_corrupt") == 1
+
+
 # ---------------------------------------------------------------------------
 # pad correctness: padded-then-cropped == direct driver (ISSUE satellite)
 # ---------------------------------------------------------------------------
@@ -160,7 +225,12 @@ def test_gels_underdetermined_direct(svc):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_steady_state_compile_free_after_warmup(shared_cache, tmp_path):
+    # slow: 18.5 s of tier-1 wall (full warmup of both buckets' batch
+    # points); the tier-1 zero-compile acceptance now rides on
+    # test_artifacts.test_restart_drill_restore_then_zero_compiles,
+    # and run_tests.py --coldstart drills the cross-process version
     rng = np.random.default_rng(0)
     n1, n2 = 10, 20
     A1 = rng.standard_normal((n1, n1)) + n1 * np.eye(n1)

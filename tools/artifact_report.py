@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Per-bucket artifact-store outcome table from a metrics JSONL.
+
+    python tools/artifact_report.py out.jsonl
+
+Rows come from the ``serve.artifact.<bucket>.b<batch>.<outcome>``
+counters the artifact store emits on every load
+(slate_tpu/serve/artifacts.py): ``hit`` (verified export artifact
+deserialized — zero retrace/compile), ``miss`` (nothing persisted),
+``stale`` (fingerprint drift: different jaxlib/device/x64/schedule),
+``corrupt`` (checksum or header verification failed), ``load_fail``
+(verified bytes failed to deserialize), ``cache_seed`` (recompile rung
+warmed by the persistent XLA cache).
+
+Exit status is the **integrity verdict**: when fault injection is on
+(``SLATE_TPU_FAULTS`` with the ``artifact_corrupt`` /
+``artifact_stale`` / ``artifact_load_fail`` sites), every injected
+fault must show up in the matching detection counter — an injected
+corruption that no verification rung caught means a corrupt artifact
+was *loaded unverified*, and the report exits nonzero.  That is the
+``run_tests.py --coldstart`` chaos gate.
+
+Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
+serving workload with ``SLATE_TPU_ARTIFACTS`` set.
+"""
+
+import argparse
+import json
+import re
+import sys
+
+OUTCOMES = ("hit", "miss", "stale", "corrupt", "load_fail", "cache_seed")
+
+_ROW_RE = re.compile(
+    r"^serve\.artifact\.(?P<bucket>.+)\.b(?P<batch>\d+)"
+    r"\.(?P<outcome>" + "|".join(OUTCOMES) + r")$"
+)
+
+#: fault site -> the detection counter that must absorb every injection
+SITE_DETECTORS = {
+    "artifact_corrupt": "serve.artifact_corrupt",
+    "artifact_stale": "serve.artifact_stale",
+    "artifact_load_fail": "serve.artifact_load_fail",
+}
+
+
+def load_counters(path):
+    # counter rows are cumulative snapshots: last value wins (same
+    # semantics as tools/chaos_report.py — summing would inflate any
+    # JSONL that metrics.dump() wrote more than once into)
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if r.get("type") == "counter":
+                out[r["name"]] = r.get("value", 0)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="artifact_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS output)")
+    args = ap.parse_args(argv)
+
+    counters = load_counters(args.jsonl)
+    rows = {}
+    for name, value in counters.items():
+        m = _ROW_RE.match(name)
+        if not m:
+            continue
+        key = (m.group("bucket"), int(m.group("batch")))
+        rows.setdefault(key, dict.fromkeys(OUTCOMES, 0))
+        rows[key][m.group("outcome")] += int(value)
+
+    if rows:
+        hdr = (f"{'bucket':44} {'batch':>5} " + " ".join(
+            f"{o:>10}" for o in OUTCOMES
+        ))
+        print(hdr)
+        print("-" * len(hdr))
+        for (bucket, batch), r in sorted(rows.items()):
+            print(f"{bucket:44} {batch:5d} " + " ".join(
+                f"{r[o]:10d}" for o in OUTCOMES
+            ))
+    else:
+        print("(no serve.artifact.* counters in this JSONL — was "
+              "SLATE_TPU_ARTIFACTS set?)")
+
+    saved = int(counters.get("serve.artifact_saved", 0))
+    if saved:
+        print(f"\n{saved} artifact(s) saved this run "
+              f"({int(counters.get('serve.artifact_saved_export', 0))} "
+              f"export, "
+              f"{int(counters.get('serve.artifact_saved_cache_seed', 0))} "
+              f"cache_seed)"
+              + (f", {int(counters.get('serve.artifact_save_error', 0))} "
+                 f"save error(s)"
+                 if counters.get("serve.artifact_save_error") else ""))
+
+    # the integrity verdict: injected artifact faults vs detections
+    rc = 0
+    for site, detector in SITE_DETECTORS.items():
+        injected = int(counters.get(f"faults.injected.{site}", 0))
+        detected = int(counters.get(detector, 0))
+        if injected == 0:
+            continue
+        verdict = "verified" if detected >= injected else "UNVERIFIED"
+        print(f"{site}: injected={injected} detected={detected} "
+              f"[{verdict}]")
+        if detected < injected:
+            # a corrupt/stale/unloadable artifact got past verification
+            rc = 1
+    if rc:
+        print("FAIL: injected artifact faults escaped the integrity "
+              "checks — a bad artifact was loaded unverified")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,15 @@ counters that should have absorbed it:
     latency        -> serve.deadline_miss_late
     worker_death   -> serve.worker_restarts
     info_nonzero   -> serve.numerical_errors
+    artifact_corrupt   -> serve.artifact_corrupt
+    artifact_stale     -> serve.artifact_stale
+    artifact_load_fail -> serve.artifact_load_fail
+
+For the artifact sites the detection counter IS the containment
+signal: an injected corruption that the verification ladder counted
+was, by construction, degraded to a recompile instead of loaded
+(serve/artifacts.py); an injection with no detection means a bad
+artifact was served unverified.
 
 A site with injections but NO recovery signal is flagged — either the
 containment path regressed or the site is not wired to one — and the
@@ -52,6 +61,11 @@ RECOVERY = {
     "latency": ("serve.deadline_miss_late",),
     "worker_death": ("serve.worker_restarts",),
     "info_nonzero": ("serve.numerical_errors",),
+    # detection == containment for the artifact load ladder: a counted
+    # rung means the bad artifact was recompiled, not served
+    "artifact_corrupt": ("serve.artifact_corrupt",),
+    "artifact_stale": ("serve.artifact_stale",),
+    "artifact_load_fail": ("serve.artifact_load_fail",),
 }
 
 #: sites whose zero-recovery outcome is legitimate (see module doc)
@@ -115,7 +129,7 @@ def main(argv=None) -> int:
     if not rows:
         print("no faults.injected.* counters in this JSONL (faults off?)")
         return 0
-    hdr = f"{'site':16} {'injected':>9} {'recovered':>10}  status / signals"
+    hdr = f"{'site':18} {'injected':>9} {'recovered':>10}  status / signals"
     print(hdr)
     print("-" * len(hdr))
     flagged = 0
@@ -131,7 +145,7 @@ def main(argv=None) -> int:
             )
             if r["shared_with"]:
                 status += f"  [shared with {', '.join(r['shared_with'])}]"
-        print(f"{r['site']:16} {r['injected']:9d} {r['recovered']:10d}  {status}")
+        print(f"{r['site']:18} {r['injected']:9d} {r['recovered']:10d}  {status}")
     if flagged:
         print(f"\n{flagged} site(s) injected faults with no recovery signal")
         return 1

@@ -3,12 +3,19 @@
 
     python tools/warmup_report.py out.jsonl [--manifest warmup.json]
 
-Rows come from the ``serve.<routine>.<MxNxR>.<dtype>[.tag].b<batch>``
+Rows come from the
+``serve.<routine>.<MxNxR>.<dtype>[.tag][.schedule][.precision].b<batch>``
 compile/run timers that the serving cache's instrumented executables
-record (slate_tpu/serve/cache.py); with ``--manifest`` the table is
-joined against the warmup manifest so buckets that were never compiled
-in this JSONL (stale manifest entries) and compiles missing from the
-manifest (warmup gap — the next cold start pays them) are both flagged.
+record (slate_tpu/serve/cache.py) — the ``schedule`` (PR3) and
+``precision`` (PR5) BucketKey fields are part of the bucket label
+(omitted at their defaults "auto"/"full") and get their own columns
+here.  With ``--manifest`` the table is joined against the warmup
+manifest so buckets that were never compiled in this JSONL (stale
+manifest entries) and compiles missing from the manifest (warmup gap —
+the next cold start pays them) are both flagged; manifest entries that
+predate the schedule/precision fields are flagged ``legacy(...)`` —
+they load with the documented defaults and re-serialize canonically on
+the next manifest flush.
 
 Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
 serving workload (examples/ex16_serving.py shows the whole loop).
@@ -19,7 +26,14 @@ import json
 import re
 import sys
 
-_BUCKET_RE = re.compile(r"^serve\.(?P<bucket>.+)\.b(?P<batch>\d+)\.(?P<kind>compile|run)$")
+_BUCKET_RE = re.compile(
+    r"^serve\.(?P<bucket>.+)\.b(?P<batch>\d+)\.(?P<kind>compile|run)$"
+)
+
+#: non-default label suffixes (buckets.BucketKey.label appends schedule
+#: when != "auto" and precision when != "full", in that order)
+_SCHEDULES = ("flat", "recursive")
+_PRECISIONS = ("mixed",)
 
 
 def load_jsonl(path):
@@ -30,6 +44,20 @@ def load_jsonl(path):
             if line:
                 out.append(json.loads(line))
     return out
+
+
+def split_label(bucket):
+    """(schedule, precision) parsed off a bucket label's tail — the
+    JSONL-only fallback when no manifest is given (a tag that collides
+    with a schedule/precision literal is misread here; the manifest
+    join is the ground truth)."""
+    parts = bucket.split(".")
+    schedule, precision = "auto", "full"
+    if parts and parts[-1] in _PRECISIONS:
+        precision = parts.pop()
+    if parts and parts[-1] in _SCHEDULES:
+        schedule = parts.pop()
+    return schedule, precision
 
 
 def bucket_rows(records):
@@ -54,16 +82,30 @@ def bucket_rows(records):
     return rows
 
 
-def manifest_keys(path):
+def manifest_index(path):
+    """{(bucket_label, batch): {"schedule", "precision", "legacy"}} —
+    ``legacy`` lists the BucketKey fields this entry's manifest JSON
+    omitted (pre-PR3 ``schedule`` / pre-PR5 ``precision`` writers), so
+    defaulted entries are visibly flagged rather than silently joined."""
     with open(path) as f:
         doc = json.load(f)
-    keys = set()
+    idx = {}
     for e in doc.get("entries", []):
+        legacy = [k for k in ("schedule", "precision") if k not in e]
+        schedule = str(e.get("schedule", "auto"))
+        precision = str(e.get("precision", "full"))
         bucket = f"{e['routine']}.{e['m']}x{e['n']}x{e['nrhs']}.{e['dtype']}"
         if e.get("tag"):
             bucket += f".{e['tag']}"
-        keys.add((bucket, int(e.get("batch", 1))))
-    return keys
+        # mirror BucketKey.label: defaults are omitted from the label
+        if schedule != "auto":
+            bucket += f".{schedule}"
+        if precision != "full":
+            bucket += f".{precision}"
+        idx[(bucket, int(e.get("batch", 1)))] = {
+            "schedule": schedule, "precision": precision, "legacy": legacy,
+        }
+    return idx
 
 
 def main(argv=None):
@@ -75,40 +117,61 @@ def main(argv=None):
 
     records = load_jsonl(args.jsonl)
     rows = bucket_rows(records)
-    mkeys = manifest_keys(args.manifest) if args.manifest else None
+    midx = manifest_index(args.manifest) if args.manifest else None
 
-    all_keys = sorted(set(rows) | (mkeys or set()))
+    all_keys = sorted(set(rows) | (set(midx) if midx else set()))
     if not all_keys:
         print("(no serve.* bucket timers in this JSONL)")
         return 0
 
-    hdr = (f"{'bucket':44} {'batch':>5} {'compiles':>8} {'compile(s)':>11} "
-           f"{'runs':>6} {'mean_run(ms)':>13} {'note':>10}")
+    hdr = (f"{'bucket':44} {'batch':>5} {'schedule':>9} {'precision':>9} "
+           f"{'compiles':>8} {'compile(s)':>11} {'runs':>6} "
+           f"{'mean_run(ms)':>13} {'note':>16}")
     print(hdr)
     print("-" * len(hdr))
+    legacy_total = 0
     for key in all_keys:
         bucket, batch = key
         row = rows.get(key)
-        note = ""
-        if mkeys is not None:
-            if key not in mkeys:
-                note = "unlisted"  # compiled here, missing from manifest
+        mentry = midx.get(key) if midx is not None else None
+        if mentry is not None:
+            schedule, precision = mentry["schedule"], mentry["precision"]
+        else:
+            schedule, precision = split_label(bucket)
+        notes = []
+        if midx is not None:
+            if mentry is None:
+                notes.append("unlisted")  # compiled here, not in manifest
             elif row is None or row["compiles"] == 0:
-                note = "stale?"  # in manifest, never compiled in this JSONL
+                notes.append("stale?")  # in manifest, never compiled here
+            if mentry is not None and mentry["legacy"]:
+                legacy_total += 1
+                notes.append(
+                    "legacy(%s)" % (
+                        "both" if len(mentry["legacy"]) == 2
+                        else mentry["legacy"][0]
+                    )
+                )
+        note = ",".join(notes)
         if row is None:
-            print(f"{bucket:44} {batch:5d} {0:8d} {'-':>11} {0:6d} "
-                  f"{'-':>13} {note:>10}")
+            print(f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
+                  f"{0:8d} {'-':>11} {0:6d} {'-':>13} {note:>16}")
             continue
         mean_run = (row["run_s"] / row["runs"] * 1e3) if row["runs"] else 0.0
         print(
-            f"{bucket:44} {batch:5d} {row['compiles']:8d} "
-            f"{row['compile_s']:11.2f} {row['runs']:6d} {mean_run:13.2f} "
-            f"{note:>10}"
+            f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
+            f"{row['compiles']:8d} {row['compile_s']:11.2f} "
+            f"{row['runs']:6d} {mean_run:13.2f} {note:>16}"
         )
     total_c = sum(r["compile_s"] for r in rows.values())
     print(f"\ntotal compile wall: {total_c:.2f}s over "
           f"{sum(r['compiles'] for r in rows.values())} compiles; "
           f"warmed steady-state pays none of it")
+    if legacy_total:
+        print(f"{legacy_total} manifest entr"
+              f"{'y' if legacy_total == 1 else 'ies'} predate the "
+              "schedule/precision fields (defaulted to auto/full); "
+              "re-save the manifest to upgrade in place")
     return 0
 
 
