@@ -454,6 +454,62 @@ def main(argv=None):
     run_entry("dgesv_mixed", entry_mixed("gesv"))
     run_entry("dposv_mixed", entry_mixed("posv"))
 
+    # -- serving scale-out: the same warmed request stream at
+    # replicas=1 vs replicas=N (fake CPU devices here, real chips when
+    # available — on one physical CPU the replicas share cores, so the
+    # honest headline is the dispatch spread + requests/s pair, not a
+    # speedup claim; BENCH_r06 tracks the curve) ----------------------
+    def entry_serve_scaling():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.serve import buckets as _bk
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.placement import PlacementPolicy
+        from slate_tpu.serve.service import SolverService
+
+        ndev = len(jax.devices())
+        nrep = max(2, min(4, ndev))
+        nserve = 512 if on_tpu else 64
+        reqs = 48
+        rng = np.random.default_rng(0)
+        probs = [
+            (rng.standard_normal((nserve, nserve)) + nserve * np.eye(nserve),
+             rng.standard_normal((nserve, 4)))
+            for _ in range(8)
+        ]
+        out = {"n": nserve, "requests": reqs, "devices": ndev}
+        rates = {}
+        for nrep_i in (1, nrep):
+            svc = SolverService(
+                cache=ExecutableCache(manifest_path=None), batch_max=8,
+                batch_window_s=0.001,
+                placement=PlacementPolicy(replicas=nrep_i),
+            )
+            key = _bk.bucket_for("gesv", nserve, nserve, 4, np.float64)
+            svc.cache.ensure_manifest(key, (1, 8))
+            svc.warmup()  # compile-free stream: rates measure dispatch
+            c0 = _m.counters().get("serve.replicated_dispatch", 0)
+            t0 = time.perf_counter()
+            futs = [
+                svc.submit("gesv", *probs[i % len(probs)])
+                for i in range(reqs)
+            ]
+            for f in futs:
+                assert np.all(np.isfinite(f.result(timeout=600)))
+            dt = time.perf_counter() - t0
+            svc.stop()
+            rates[nrep_i] = reqs / dt
+            out[f"replicas_{nrep_i}"] = {
+                "requests_per_s": round(reqs / dt, 1),
+                "seconds": round(dt, 3),
+                "replicated_dispatch": int(
+                    _m.counters().get("serve.replicated_dispatch", 0) - c0
+                ),
+            }
+        out["scaling_x"] = round(rates[nrep] / max(rates[1], 1e-9), 2)
+        return out
+
+    run_entry("serve_scaling", entry_serve_scaling)
+
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
 

@@ -280,6 +280,104 @@ def chaos() -> int:
     return 0
 
 
+# Env-activated placement stream for the --sharded gate: a forced
+# 8-fake-device CPU mesh (XLA_FLAGS in the gate env, set before jax
+# imports), a replica-pool service with an spmd submesh, a warmed mixed
+# small/large stream that must stay compile-free, and an atexit metrics
+# dump tools/placement_report.py joins (nonzero on a starved replica).
+_SHARDED_DRIVER = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.placement import PlacementPolicy
+from slate_tpu.serve.service import SolverService
+
+assert len(jax.devices()) >= 8, jax.devices()
+rng = np.random.default_rng(0)
+svc = SolverService(
+    cache=ExecutableCache(manifest_path=None), batch_max=4,
+    batch_window_s=0.002, dim_floor=16, nrhs_floor=4,
+    placement=PlacementPolicy(replicas=3, mesh="2x2", shard_threshold=40),
+)
+key_s = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=16, nrhs_floor=4)
+key_l = bk.bucket_for("gesv", 50, 50, 2, np.float64, floor=16, nrhs_floor=4,
+                      mesh="2x2")
+svc.cache.ensure_manifest(key_s, (1, 4))
+svc.cache.ensure_manifest(key_l, (1,))
+svc.warmup()  # primes all 3 replica devices + the spmd executable
+
+def prob(n, seed):
+    r = np.random.default_rng(seed)
+    return (r.standard_normal((n, n)) + n * np.eye(n),
+            r.standard_normal((n, 2)))
+
+probs = [prob(12, i) for i in range(18)] + [prob(50, 100 + i)
+                                            for i in range(2)]
+with metrics.deltas() as d:
+    futs = [svc.submit("gesv", A, B) for A, B in probs]
+    for (A, B), f in zip(probs, futs):
+        X = f.result(timeout=600)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+    assert d.get("jit.compilations") == 0, (
+        "warmed placement stream compiled: %d" % d.get("jit.compilations"))
+    assert d.get("serve.routed_sharded") == 2
+    assert d.get("serve.replicated_dispatch") == 18
+busy = [r["name"] for r in svc.health()["replicas"] if r["dispatched"] > 0]
+assert len(busy) >= 2, busy
+print(f"sharded driver: 18 replicated over replicas {busy}, "
+      "2 sharded on 2x2, 0 steady-state compiles")
+svc.stop()
+"""
+
+
+def sharded() -> int:
+    """Sharded-serving gate, two legs: (1) the placement suite
+    (policy units + the 8-fake-device acceptance stream); (2) an
+    env-activated placement stream (SLATE_TPU_METRICS, forced device
+    count — the production activation path) whose JSONL is joined by
+    tools/placement_report.py — a starved replica fails the gate."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_placement.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    jsonl = os.path.join(
+        tempfile.gettempdir(), f"placement_{os.getpid()}.jsonl"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
+        XLA_FLAGS=(
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    )
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-c", _SHARDED_DRIVER], env=env, cwd=here
+        )
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "placement_report.py"),
+             jsonl],
+            cwd=here,
+        )
+    finally:
+        try:
+            os.unlink(jsonl)
+        except OSError:
+            pass
+
+
 # Restart-drill drivers for the --coldstart gate.  Each runs in its OWN
 # subprocess so the restore leg is a true fresh interpreter: nothing
 # carries over but the artifact dir + manifest on disk.
@@ -475,6 +573,10 @@ def main() -> int:
                          "(fresh-process restore with 0 compiles, "
                          "byte-flip recovery) + the artifact_report "
                          "chaos gate")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the placement suite (replica scale-out + "
+                         "spmd routing on a forced 8-device CPU mesh) + "
+                         "the placement_report starvation gate")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -493,6 +595,8 @@ def main() -> int:
         return refine_gate()
     if args.coldstart:
         return coldstart()
+    if args.sharded:
+        return sharded()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -350,6 +350,9 @@ class Option(enum.Enum):
     ServeValidate = "serve_validate"  # admission finiteness checks
     ServePrecision = "serve_precision"  # bucket solve precision: full|mixed
     ServeArtifacts = "serve_artifacts"  # executable artifact dir (cold start)
+    ServeReplicas = "serve_replicas"  # data-parallel replica worker count
+    ServeMesh = "serve_mesh"  # spmd submesh "PxQ" for sharded routing
+    ServeShardThreshold = "serve_shard_threshold"  # n >= this routes sharded
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

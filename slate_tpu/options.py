@@ -12,6 +12,7 @@ from typing import Any, Mapping, Optional, Union
 
 from .enums import Option, RefineMethod, Schedule
 from .exceptions import OptionError
+from .serve.buckets import DEFAULT_SHARD_THRESHOLD  # import-pure module
 
 OptionKey = Union[Option, str]
 Options = Mapping[OptionKey, Any]
@@ -53,6 +54,15 @@ _DEFAULTS = {
     Option.ServeValidate: True,
     Option.ServePrecision: "full",  # bucket solve precision (full|mixed)
     Option.ServeArtifacts: "",  # executable artifact dir ("" = env/off)
+    # placement (serve/placement.py): 1 replica + no mesh = the
+    # single-device service, bit-identical to the pre-placement tier
+    Option.ServeReplicas: 1,  # data-parallel replica workers
+    Option.ServeMesh: "",  # "PxQ" spmd submesh ("" = sharded routing off)
+    # requests with n >= this route to the spmd drivers when a mesh is
+    # configured (the Clipper-style split: small -> replicas for
+    # throughput, large -> the SLATE process grid for capability);
+    # one value with PlacementPolicy's constructor default
+    Option.ServeShardThreshold: DEFAULT_SHARD_THRESHOLD,
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

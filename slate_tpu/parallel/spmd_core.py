@@ -1,0 +1,148 @@
+"""Thin traceable entry over the spmd drivers for the serving cache.
+
+The serving tier's sharded buckets (``BucketKey.mesh == "PxQ"``) need
+one jit-able callable ``core(Ag, Bg) -> (Xg, info)`` over padded global
+arrays — the same contract as the single-device serve cores
+(serve/cache._build_core) — but executing the explicit mesh algorithms
+from this package under ``shard_map``: distributed LU / Cholesky of the
+tile array, pivot row exchange, and the trsm pipelines, never a
+gathered global factorization.
+
+The cache traces these per bucket exactly like the replicated cores, so
+the warmed executable set, manifest, and artifact fingerprints all key
+by mesh shape (serve/buckets.content_fields carries ``mesh``).  Inputs
+arrive as whole (replicated) global arrays; ``tiles_from_global`` packs
+them into the storage-order tile layout and GSPMD moves the shards onto
+the mesh at the ``shard_map`` boundary — the serving boundary stays
+"plain arrays in, plain arrays out" while the math runs distributed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import DistributedException
+from .grid import ProcessGrid
+from .layout import TileLayout, eye_splice, tiles_from_global, tiles_to_global
+
+#: ProcessGrid per mesh string — grids wrap jax.sharding.Mesh objects
+#: whose identity matters for shard_map tracing caches, so each mesh
+#: shape maps to ONE grid per process (the lock keeps concurrent
+#: builders — e.g. the restore thread racing a sharded worker's cold
+#: build — from creating duplicate Mesh objects that would split the
+#: tracing caches)
+_grids: Dict[Tuple[str, int], ProcessGrid] = {}
+_grids_lock = threading.Lock()
+
+
+def grid_for(mesh: str) -> ProcessGrid:
+    """The process-wide ProcessGrid for a ``"PxQ"`` mesh string, built
+    over the first P*Q visible devices (cached per shape)."""
+    from ..serve.buckets import parse_mesh
+
+    p, q = parse_mesh(mesh)
+    if p == 0:
+        raise ValueError("grid_for requires a non-empty mesh shape")
+    import jax
+
+    devs = jax.devices()
+    if p * q > len(devs):
+        raise DistributedException(
+            f"mesh {mesh} needs {p * q} devices, only {len(devs)} visible"
+        )
+    key = (f"{p}x{q}", id(devs[0].client) if hasattr(devs[0], "client") else 0)
+    with _grids_lock:
+        grid = _grids.get(key)
+        if grid is None:
+            grid = _grids[key] = ProcessGrid.from_devices(
+                devs[: p * q], p=p, q=q
+            )
+    return grid
+
+
+def _diag_info(T: jnp.ndarray, lay: TileLayout) -> jnp.ndarray:
+    """info code from an LU-packed tile array: exact zero / non-finite
+    on U's diagonal (the tile-array twin of drivers/lu._udiag_info —
+    a masked reduction GSPMD lowers to local work + psum)."""
+    dmin = min(lay.m, lay.n)
+    gr = jnp.asarray(lay.global_rows_np)[:, None, :, None]
+    gc = jnp.asarray(lay.global_cols_np)[None, :, None, :]
+    dmask = (gr == gc) & (gr < dmin)
+    if jnp.issubdtype(T.dtype, jnp.complexfloating):
+        bad = (T == 0) | ~(
+            jnp.isfinite(jnp.real(T)) & jnp.isfinite(jnp.imag(T))
+        )
+    else:
+        bad = (T == 0) | ~jnp.isfinite(T)
+    return jnp.where(jnp.any(bad & dmask), 1, 0).astype(jnp.int32)
+
+
+def build_solve_core(
+    routine: str, grid: ProcessGrid, n: int, nrhs: int, nb: int
+) -> Callable:
+    """``core(Ag, Bg) -> (Xg, info)`` solving one padded square system
+    on the mesh: gesv = spmd tournament-free LU + pivot exchange + two
+    trsm pipelines; posv = spmd right-looking Cholesky + the L / L^H
+    pipelines.  ``Ag`` is the serve-padded (n, n) global (identity
+    trailing block from buckets.pad_square keeps the padded rows
+    pivot-inert), ``Bg`` the (n, nrhs) padded right-hand sides."""
+    from . import spmd_chol, spmd_lu, spmd_trsm
+
+    if routine not in ("gesv", "posv"):
+        raise ValueError(f"no sharded serving core for {routine!r}")
+    layA = TileLayout(n, n, nb, nb, grid.p, grid.q)
+    layB = TileLayout(n, nrhs, nb, nb, grid.p, grid.q)
+
+    if routine == "gesv":
+
+        def core(Ag, Bg):
+            T = eye_splice(layA, tiles_from_global(Ag, layA))
+            Td, perm = spmd_lu.spmd_getrf(grid, T, layA)
+            TB = tiles_from_global(Bg, layB)
+            TB = spmd_trsm.spmd_permute_rows(grid, TB, layB, perm)
+            TT = eye_splice(layA, Td)
+            Y = spmd_trsm.spmd_trsm_left(
+                grid, TT, layA, TB, layB,
+                lower=True, trans=False, conj=False, unit_diag=True,
+            )
+            X = spmd_trsm.spmd_trsm_left(
+                grid, TT, layA, Y, layB,
+                lower=False, trans=False, conj=False, unit_diag=False,
+            )
+            return tiles_to_global(X, layB), _diag_info(Td, layA)
+
+        return core
+
+    def core(Ag, Bg):
+        # posv reads the lower triangle only (serve pads SPD systems
+        # with an identity trailing block, itself SPD)
+        T = eye_splice(layA, tiles_from_global(Ag, layA))
+        Ld = spmd_chol.spmd_potrf_lower(grid, T, layA)
+        # non-SPD surfaces as NaNs out of the diagonal-tile Cholesky and
+        # propagates through the trailing updates (drivers/chol checks
+        # the whole tile array the same way)
+        info = jnp.where(jnp.all(jnp.isfinite(Ld)), 0, 1).astype(jnp.int32)
+        TT = eye_splice(layA, Ld)
+        TB = tiles_from_global(Bg, layB)
+        Y = spmd_trsm.spmd_trsm_left(
+            grid, TT, layA, TB, layB,
+            lower=True, trans=False, conj=False, unit_diag=False,
+        )
+        X = spmd_trsm.spmd_trsm_left(
+            grid, TT, layA, Y, layB,
+            lower=True, trans=True, conj=True, unit_diag=False,
+        )
+        return tiles_to_global(X, layB), info
+
+    return core
+
+
+def serve_core(key) -> Callable:
+    """The sharded serving core for one mesh-keyed BucketKey — what
+    serve/cache traces when ``key.mesh`` is set."""
+    grid = grid_for(key.mesh)
+    return build_solve_core(key.routine, grid, key.n, key.nrhs, key.nb)

@@ -3,10 +3,13 @@
 Shape-bucketed dispatch (`buckets`), an executable cache with a
 persistent warmup manifest (`cache`, ``SLATE_TPU_WARMUP=/path.json``),
 a durable executable artifact store for crash-safe cold starts
-(`artifacts`, ``SLATE_TPU_ARTIFACTS=/dir``), a deadline-aware batching
-service with a cold/restoring/ready readiness phase (`service`), and
-thin sync wrappers (`api`): ``serve.gesv/posv/gels``,
-``serve.submit``, ``serve.warmup``, ``serve.restore``.
+(`artifacts`, ``SLATE_TPU_ARTIFACTS=/dir``), a mesh-aware placement
+tier — replica scale-out + spmd submesh routing (`placement`,
+``Option.ServeReplicas/ServeMesh/ServeShardThreshold``) — a
+deadline-aware batching service with a cold/restoring/ready readiness
+phase (`service`), and thin sync wrappers (`api`):
+``serve.gesv/posv/gels``, ``serve.submit``, ``serve.warmup``,
+``serve.restore``.
 
 Attribute access is lazy (PEP 562): importing ``slate_tpu.serve`` (or
 ``serve.buckets`` from the drivers) never pulls the driver stack, so
@@ -33,10 +36,12 @@ _BUCKETS = (
     "size_bucket_runs", "batch_bucket",
 )
 _ARTIFACTS = ("ArtifactStore", "ARTIFACTS_ENV", "store_from_env")
+_PLACEMENT = ("PlacementPolicy",)
+_SUBMODULES = ("api", "buckets", "cache", "service", "artifacts", "placement")
 
-__all__ = list(_API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS) + [
-    "api", "buckets", "artifacts",
-]
+__all__ = list(
+    _API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS + _PLACEMENT
+) + list(_SUBMODULES)
 
 
 def __getattr__(name: str):
@@ -52,4 +57,12 @@ def __getattr__(name: str):
         return getattr(
             importlib.import_module(".artifacts", __name__), name
         )
+    if name in _PLACEMENT:
+        return getattr(
+            importlib.import_module(".placement", __name__), name
+        )
+    if name in _SUBMODULES:
+        # the advertised submodules themselves (serve.placement,
+        # serve.buckets, ...) — lazily importable like the names
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -64,6 +64,8 @@ def get_service() -> SolverService:
 
 
 def _make_service(opts: Optional[Options], **kw) -> SolverService:
+    from .placement import PlacementPolicy
+
     cfg = dict(
         max_queue=int(get_option(opts, Option.ServeQueueLimit)),
         batch_max=int(get_option(opts, Option.ServeBatchMax)),
@@ -78,6 +80,13 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         faults_spec=str(get_option(opts, Option.Faults) or ""),
     )
     cfg.update(kw)
+    if cfg.get("placement") is None:
+        # build the policy AFTER kw lands so the replicas shorthand is
+        # honored (an eager placement= in cfg would make SolverService
+        # ignore it — the policy argument wins by contract)
+        cfg["placement"] = PlacementPolicy.from_options(
+            opts, replicas=cfg.pop("replicas", None)
+        )
     return SolverService(**cfg)
 
 
@@ -110,9 +119,7 @@ def warmup(
     the number compiled.  After this, requests whose buckets are in the
     manifest are steady-state compile-free."""
     svc = get_service()
-    return svc.cache.warmup(
-        path=path, batch_max=svc.batch_max, verbose=verbose
-    )
+    return svc.warmup(path=path, verbose=verbose)
 
 
 def restore(verbose: bool = False) -> dict:
@@ -129,7 +136,7 @@ def restore(verbose: bool = False) -> dict:
     make the explicit pass a cheap no-op)."""
     svc = get_service()
     svc.wait_ready()
-    return svc.cache.restore(batch_max=svc.batch_max, verbose=verbose)
+    return svc.restore(verbose=verbose)
 
 
 def wait_ready(timeout: Optional[float] = None) -> bool:
@@ -145,20 +152,24 @@ def submit(
     deadline: Optional[float] = None,
     retries: int = 0,
     precision: Optional[str] = None,
+    sharded: Optional[bool] = None,
 ) -> Future:
     """Async entry: enqueue and return the Future (see
     :meth:`SolverService.submit`).  ``precision`` ("full"|"mixed")
-    overrides the service-wide solve path for this request."""
+    overrides the service-wide solve path for this request;
+    ``sharded`` overrides the placement policy (True forces the spmd
+    submesh, False the replicated tier, None routes by size)."""
     return get_service().submit(
         routine, A, B, deadline=deadline, retries=retries,
-        precision=precision,
+        precision=precision, sharded=sharded,
     )
 
 
-def _sync(routine, A, B, deadline, retries, precision=None) -> np.ndarray:
+def _sync(routine, A, B, deadline, retries, precision=None,
+          sharded=None) -> np.ndarray:
     fut = submit(
         routine, A, B, deadline=deadline, retries=retries,
-        precision=precision,
+        precision=precision, sharded=sharded,
     )
     # no result-timeout: the worker resolves every admitted future
     # (deadline expiry included), so blocking here cannot hang
@@ -166,19 +177,23 @@ def _sync(routine, A, B, deadline, retries, precision=None) -> np.ndarray:
 
 
 def gesv(A, B, deadline: Optional[float] = None, retries: int = 0,
-         precision: Optional[str] = None) -> np.ndarray:
+         precision: Optional[str] = None,
+         sharded: Optional[bool] = None) -> np.ndarray:
     """Solve A X = B (square, LU with partial pivoting) through the
     service; returns X (n x nrhs).  ``precision="mixed"`` routes the
     request through a mixed-precision bucket (low-precision factor +
     iterative refinement; non-converged solves are transparently
-    re-solved on the full-precision direct path)."""
-    return _sync("gesv", A, B, deadline, retries, precision)
+    re-solved on the full-precision direct path).  ``sharded=True``
+    forces the spmd submesh (Option.ServeMesh) — large-n requests
+    route there automatically past Option.ServeShardThreshold."""
+    return _sync("gesv", A, B, deadline, retries, precision, sharded)
 
 
 def posv(A, B, deadline: Optional[float] = None, retries: int = 0,
-         precision: Optional[str] = None) -> np.ndarray:
+         precision: Optional[str] = None,
+         sharded: Optional[bool] = None) -> np.ndarray:
     """Solve SPD A X = B (Cholesky, lower triangle referenced)."""
-    return _sync("posv", A, B, deadline, retries, precision)
+    return _sync("posv", A, B, deadline, retries, precision, sharded)
 
 
 def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:

@@ -23,10 +23,13 @@ the runtime half (jax/jaxlib version, backend, device kind, x64 mode —
   skipping Python retracing and jax lowering entirely (and, with the
   seeded XLA cache below, the backend compile too).
 * ``"cache_seed"`` — ``jax.export`` refused the computation (donated
-  or sharded executables are version-dependent), or the exported
+  or sharded executables are version-dependent), the exported
   module embeds non-portable custom calls (vendor LAPACK on CPU,
   pallas — loading those in a fresh process can segfault, which no
-  integrity check can catch); the payload is empty
+  integrity check can catch), or the bucket is **mesh-sharded**
+  (``BucketKey.mesh`` — shard_map programs are never trusted across
+  processes; the entry is still keyed by its mesh shape, so it cannot
+  collide with the single-device fingerprint); the payload is empty
   and the entry records that the build itself seeded the persistent
   XLA compilation cache under ``<root>/xla-cache``, so a fresh
   replica's recompile is a disk hit instead of a cold backend compile.
@@ -296,22 +299,35 @@ class ArtifactStore:
             mode = MODE_EXPORT
             payload = b""
             nonportable: list = []
-            try:
-                from jax import export as _export
-
-                exported = _export.export(jitted)(*arg_specs)
-                nonportable = nonportable_custom_calls(exported)
-                if nonportable:
-                    # vendor LAPACK / pallas custom calls deserialize
-                    # but can segfault at execution in a fresh process
-                    # (observed: lapack_dgetrf_ffi on this jaxlib) —
-                    # a crash-safe store must not persist them
-                    mode = MODE_CACHE_SEED
-                else:
-                    payload = exported.serialize()
-            except Exception:  # noqa: BLE001 — unsupported computation
+            if getattr(key, "mesh", ""):
+                # mesh-sharded executables always take the cache_seed
+                # rung: a serialized shard_map program binds a device
+                # assignment this jaxlib gives no cross-process
+                # stability guarantee for (the same trust boundary as
+                # the vendor-LAPACK segfault below).  The entry is
+                # still KEYED by its mesh shape (content_fields carries
+                # BucketKey.mesh), so it never collides with the
+                # single-device fingerprint and its build still seeds
+                # the persistent XLA cache for the next replica.
                 mode = MODE_CACHE_SEED
-                payload = b""
+                nonportable = [f"sharded-mesh:{key.mesh}"]
+            else:
+                try:
+                    from jax import export as _export
+
+                    exported = _export.export(jitted)(*arg_specs)
+                    nonportable = nonportable_custom_calls(exported)
+                    if nonportable:
+                        # vendor LAPACK / pallas custom calls deserialize
+                        # but can segfault at execution in a fresh process
+                        # (observed: lapack_dgetrf_ffi on this jaxlib) —
+                        # a crash-safe store must not persist them
+                        mode = MODE_CACHE_SEED
+                    else:
+                        payload = exported.serialize()
+                except Exception:  # noqa: BLE001 — unsupported computation
+                    mode = MODE_CACHE_SEED
+                    payload = b""
             header = {
                 "magic": MAGIC,
                 "schema": SCHEMA,

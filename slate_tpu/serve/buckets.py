@@ -42,6 +42,13 @@ import numpy as np
 DIM_FLOOR = 64
 NRHS_FLOOR = 8
 
+#: default size-routing threshold for the sharded serving tier: a
+#: request with n >= this routes to the spmd submesh when one is
+#: configured.  Defined HERE (the one import-pure serving module) so
+#: Option.ServeShardThreshold (options.py) and a directly-constructed
+#: PlacementPolicy share one value instead of two drifting literals.
+DEFAULT_SHARD_THRESHOLD = 2048
+
 #: accepted BucketKey.precision values (the single source of truth —
 #: SolverService validates the service-wide setting and per-submit
 #: overrides against this same check)
@@ -56,6 +63,43 @@ def check_precision(precision: str) -> str:
             f"({'|'.join(PRECISIONS)})"
         )
     return precision
+
+
+def parse_mesh(mesh: str) -> Tuple[int, int]:
+    """Parse a mesh-shape string ``"PxQ"`` into (p, q); ``""`` (the
+    single-device placement) parses to (0, 0).  The grammar lives here
+    (pure, no jax) so BucketKey validation, the placement policy, and
+    the warmup/restore mesh filters all share one parser."""
+    if not mesh:
+        return (0, 0)
+    parts = str(mesh).lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(f"bad mesh shape {mesh!r} (want 'PxQ', e.g. '2x4')")
+    try:
+        p, q = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"bad mesh shape {mesh!r} (want 'PxQ', e.g. '2x4')"
+        ) from None
+    if p <= 0 or q <= 0:
+        raise ValueError(f"mesh dims must be positive, got {mesh!r}")
+    return p, q
+
+
+def check_mesh(mesh: str) -> str:
+    """Validate a BucketKey mesh string; returns it canonicalized
+    (``""`` for single-device, ``"PxQ"`` otherwise)."""
+    p, q = parse_mesh(mesh)
+    return "" if p == 0 else f"{p}x{q}"
+
+
+def mesh_fits(mesh: str, device_count: int) -> bool:
+    """True when a mesh-shape string is realizable with ``device_count``
+    devices — the warmup/restore filter: a replica warms only the
+    manifest entries its own mesh can run (a 2x4 entry on a 1-device
+    box is skipped, not crashed on)."""
+    p, q = parse_mesh(mesh)
+    return p * q <= max(int(device_count), 0)
 
 
 def halving_bucket(h: int, total: int, floor: int = 1) -> int:
@@ -128,7 +172,18 @@ class BucketKey:
     at MXU low-precision rates; non-converged items surface as
     non-finite X, which the service re-solves on the full-precision
     direct path while the bucket's circuit breaker demotes persistent
-    offenders."""
+    offenders.
+
+    ``mesh`` is the *placement* of the executable: ``""`` (the legacy
+    default, so old manifests round-trip unchanged) means one device —
+    the data-parallel replicated case — while ``"PxQ"`` means the
+    executable was traced through the ``parallel/`` spmd drivers under
+    ``shard_map`` on a P x Q submesh (serve/placement routes large-n
+    or explicitly-sharded requests there).  A first-class key field:
+    the same bucket shape traced for different mesh shapes is a
+    different program, so manifests warm — and the artifact store
+    fingerprints — per mesh shape (ROADMAP item 2's remnant: sharded
+    executables no longer collide with the single-device key)."""
 
     routine: str
     m: int  # row bucket
@@ -139,6 +194,7 @@ class BucketKey:
     tag: str = ""  # options fingerprint (empty = defaults)
     schedule: str = "auto"  # factorization schedule (Option.Schedule)
     precision: str = "full"  # solve path: full | mixed
+    mesh: str = ""  # placement: "" = single device | "PxQ" spmd submesh
 
     @property
     def label(self) -> str:
@@ -148,6 +204,7 @@ class BucketKey:
             + (f".{self.tag}" if self.tag else "")
             + (f".{self.schedule}" if self.schedule != "auto" else "")
             + (f".{self.precision}" if self.precision != "full" else "")
+            + (f".mesh{self.mesh}" if self.mesh else "")
         )
 
     def to_json(self) -> dict:
@@ -155,7 +212,7 @@ class BucketKey:
             "routine": self.routine, "m": self.m, "n": self.n,
             "nrhs": self.nrhs, "dtype": self.dtype, "nb": self.nb,
             "tag": self.tag, "schedule": self.schedule,
-            "precision": self.precision,
+            "precision": self.precision, "mesh": self.mesh,
         }
 
     @staticmethod
@@ -166,6 +223,7 @@ class BucketKey:
             tag=str(d.get("tag", "")),
             schedule=str(d.get("schedule", "auto")),
             precision=str(d.get("precision", "full")),
+            mesh=check_mesh(str(d.get("mesh", ""))),
         )
 
 
@@ -218,10 +276,20 @@ class Breaker:
         self.streak = 0
         return was_probe
 
+    def cooling_down(self, now: float, cooldown_s: float) -> bool:
+        """True while this breaker is OPEN and its cooldown has not yet
+        elapsed — the ONE definition of the cooldown window, shared by
+        :meth:`try_half_open` and the service's admission-side replica
+        exclusion (an excluded lane must become selectable the moment
+        a probe could fire, or it would stay open forever)."""
+        return self.state == BREAKER_OPEN and now - self.opened_at < cooldown_s
+
     def try_half_open(self, now: float, cooldown_s: float) -> bool:
         """Move an open breaker whose cooldown has elapsed to
         half-open; returns True on that transition."""
-        if self.state == BREAKER_OPEN and now - self.opened_at >= cooldown_s:
+        if self.state == BREAKER_OPEN and not self.cooling_down(
+            now, cooldown_s
+        ):
             self.state = BREAKER_HALF_OPEN
             return True
         return False
@@ -244,6 +312,7 @@ def bucket_for(
     tag: str = "",
     schedule: str = "auto",
     precision: str = "full",
+    mesh: str = "",
 ) -> BucketKey:
     """Map one request onto its BucketKey.  gesv/posv are square
     (m == n); gels buckets rows and columns independently (m >= n —
@@ -251,20 +320,33 @@ def bucket_for(
     ``schedule`` keys the executable by factorization schedule;
     ``precision`` by solve path (full | mixed — mixed is a square-solve
     feature: gels has no low-precision-factor refinement analogue
-    here, so it stays on the full path)."""
+    here, so it stays on the full path).  ``mesh`` keys the executable
+    by placement: ``"PxQ"`` routes it through the spmd drivers on that
+    submesh (gesv/posv full-precision only — the sharded solvers have
+    no mixed or least-squares trace; serve/placement enforces the
+    routing policy, this validates the combination)."""
     check_precision(precision)
+    mesh = check_mesh(mesh)
     dt = np.dtype(dtype).name
     rb = bucket_dim(nrhs, nrhs_floor)
     if routine in ("gesv", "posv"):
         if m != n:
             raise ValueError(f"{routine} requires square A, got {m}x{n}")
+        if mesh and precision != "full":
+            raise ValueError(
+                "sharded serving is full-precision only "
+                f"(mesh={mesh!r}, precision={precision!r})"
+            )
         S = bucket_dim(n, floor)
         return BucketKey(
-            routine, S, S, rb, dt, _serve_nb(S), tag, schedule, precision
+            routine, S, S, rb, dt, _serve_nb(S), tag, schedule, precision,
+            mesh,
         )
     if routine == "gels":
         if m < n:
             raise ValueError("gels serving path requires m >= n")
+        if mesh:
+            raise ValueError("gels has no sharded serving path")
         Mb, Nb = bucket_mn(m, n, floor)
         return BucketKey(
             routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule, "full"
@@ -338,9 +420,9 @@ def pad_waste(key: BucketKey, m: int, n: int, nrhs: int) -> int:
 
 def content_fields(key: BucketKey, batch: int) -> dict:
     """The *content* half of an executable artifact's identity: every
-    BucketKey field (schedule and precision included — two executables
-    traced from different schedules or solve paths are different
-    programs) plus the batch point.  Pure and canonical; the *runtime*
+    BucketKey field (schedule, precision AND mesh included — two
+    executables traced from different schedules, solve paths or mesh
+    placements are different programs) plus the batch point.  Pure and canonical; the *runtime*
     half (jaxlib/backend version, device kind, x64 mode) is appended by
     ``serve/artifacts.py``, which may import jax."""
     return {**key.to_json(), "batch": int(batch)}
@@ -364,7 +446,7 @@ def manifest_dumps(entries) -> str:
                 ({**k.to_json(), "batch": int(b)} for k, b in entries),
                 key=lambda e: (e["routine"], e["m"], e["n"], e["nrhs"],
                                e["dtype"], e["tag"], e["schedule"],
-                               e["precision"], e["batch"]),
+                               e["precision"], e["mesh"], e["batch"]),
             ),
         },
         indent=1,

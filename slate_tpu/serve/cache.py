@@ -39,9 +39,15 @@ import numpy as np
 from ..aux import faults, metrics
 from ..exceptions import NumericalError
 from .artifacts import ArtifactStore, store_from_env
-from .buckets import BucketKey, manifest_dumps, manifest_loads
+from .buckets import BucketKey, manifest_dumps, manifest_loads, mesh_fits
 
 WARMUP_ENV = "SLATE_TPU_WARMUP"
+
+
+def _device_id(device):
+    """Stable priming identity of a dispatch placement (None = the
+    default placement)."""
+    return None if device is None else getattr(device, "id", device)
 
 #: manifest paths already warned about this process (warn once, not per
 #: ExecutableCache — a fleet of services sharing one bad path should
@@ -64,6 +70,25 @@ def _build_core(key: BucketKey) -> Callable:
 
     nb = key.nb
     opts = {Option.Schedule: key.schedule}
+
+    if key.mesh:
+        # sharded bucket: the core is the explicit spmd program on the
+        # key's submesh (parallel/spmd_core — distributed LU/Cholesky +
+        # trsm pipelines under shard_map), wrapped to the cache's
+        # batched calling convention at its single batch point (1):
+        # shape parallelism comes from the mesh, throughput from the
+        # replica scale-out, never a vmap over shard_map.
+        import jax.numpy as jnp
+
+        from ..parallel import spmd_core
+
+        core1 = spmd_core.serve_core(key)
+
+        def core(Ab, Bb):
+            X, info = core1(Ab[0], Bb[0])
+            return X[None], jnp.reshape(info, (1,))
+
+        return core
 
     if key.precision == "mixed":
         # mixed-precision bucket: low-precision factor + device-resident
@@ -186,6 +211,18 @@ class ExecutableCache:
         # how each live executable came to be: "artifact" (export blob
         # deserialized) or "compile" (built here) — restore() reports it
         self._origin: Dict[Tuple[BucketKey, int], str] = {}
+        # device ids each entry has dispatched on (None = default
+        # placement): warmup/restore prime every replica device that is
+        # not in here yet, so multi-replica steady state is compile-free
+        # on EVERY device, not just the first one traffic happened to hit
+        self._primed: Dict[Tuple[BucketKey, int], Set] = {}
+        # single-flight cold builds: (key, batch) -> Event while one
+        # thread builds.  The replica worker pool spreads a same-bucket
+        # burst across lanes on purpose, so without this every lane
+        # would pay the full trace+compile (~10-25 s per f64 shape) for
+        # the SAME executable; the pre-placement single worker
+        # serialized builds for free
+        self._building: Dict[Tuple[BucketKey, int], threading.Event] = {}
         self.artifacts: Optional[ArtifactStore] = store_from_env(artifact_dir)
         self.manifest_path = (
             manifest_path
@@ -281,13 +318,35 @@ class ExecutableCache:
         artifact-verification failure (stale/corrupt/load_fail) has
         already been counted by the store and lands here on the build
         path: the degradation is a recompile, never an error."""
-        with self._lock:
-            exe = self._exes.get((key, batch))
-            if exe is not None:
-                return exe
+        while True:
+            with self._lock:
+                exe = self._exes.get((key, batch))
+                if exe is not None:
+                    return exe
+                ev = self._building.get((key, batch))
+                if ev is None:
+                    ev = self._building[(key, batch)] = threading.Event()
+                    break  # this thread owns the build
+            # another thread is already building this executable: wait
+            # it out, then re-check.  If that build FAILED the entry is
+            # still absent and the loop takes over (a chaos compile
+            # fault must not strand the waiters — each raises or builds
+            # on its own terms).
+            ev.wait()
+        name = f"serve.{key.label}.b{batch}"
+        try:
+            return self._build_locked_out(key, batch, name)
+        finally:
+            with self._lock:
+                self._building.pop((key, batch), None)
+            ev.set()
+
+    def _build_locked_out(self, key: BucketKey, batch: int, name: str):
+        """The build half of :meth:`executable` — runs OUTSIDE the
+        cache lock (compiles are seconds-to-minutes) under the
+        single-flight guard the caller holds."""
         import jax
 
-        name = f"serve.{key.label}.b{batch}"
         origin = "compile"
         jitted = None
         if self.artifacts is not None:
@@ -301,16 +360,28 @@ class ExecutableCache:
                 origin = "artifact"
         if jitted is None:
             faults.check("compile")  # cold builds only: loads never fire
+            if key.mesh and batch != 1:
+                raise ValueError(
+                    f"sharded bucket {key.label} has one batch point (1), "
+                    f"got {batch}"
+                )
             core = _build_core(key)
-            # donate the padded batch operands on accelerators: run()
-            # always builds them fresh from the request's host arrays,
-            # so the factorizations work in place instead of paying a
-            # batch-sized copy per dispatch (XLA:CPU has no donation
-            # and would warn).
-            jit_kw = {}
-            if jax.default_backend() != "cpu":
-                jit_kw["donate_argnums"] = (0, 1)
-            jitted = jax.jit(jax.vmap(core), **jit_kw)
+            if key.mesh:
+                # sharded core: already batched at its single batch
+                # point; no donation (the spmd program's operands are
+                # resharded at the shard_map boundary) and no vmap
+                jitted = jax.jit(core)
+                jit_kw = {}
+            else:
+                # donate the padded batch operands on accelerators:
+                # run() always builds them fresh from the request's
+                # host arrays, so the factorizations work in place
+                # instead of paying a batch-sized copy per dispatch
+                # (XLA:CPU has no donation and would warn).
+                jit_kw = {}
+                if jax.default_backend() != "cpu":
+                    jit_kw["donate_argnums"] = (0, 1)
+                jitted = jax.jit(jax.vmap(core), **jit_kw)
             if self.artifacts is not None and not (
                 self.artifacts.verified_cache_seed(key, batch)
             ):
@@ -340,82 +411,223 @@ class ExecutableCache:
         self._record(key, batch)
         return exe
 
-    def run(self, key: BucketKey, A_batch: np.ndarray, B_batch: np.ndarray):
+    def run(
+        self,
+        key: BucketKey,
+        A_batch: np.ndarray,
+        B_batch: np.ndarray,
+        device=None,
+    ):
         """Execute one padded batch; returns host (X_batch, info_batch).
+
+        ``device`` pins the dispatch (and its per-device compiled
+        variant) to one device — the replica-placement path; None runs
+        on the default placement exactly as before.
 
         Fault sites (aux/faults; every check is one bool when off):
         ``latency`` sleeps before dispatch, ``execute`` raises in place
         of the dispatch, ``result_corrupt`` NaN-poisons item 0 of X,
         ``info_nonzero`` forces item 0's info nonzero."""
+        import jax
         import jax.numpy as jnp
 
         faults.sleep("latency")
         faults.check("execute")
         exe = self.executable(key, A_batch.shape[0])
-        X, info = exe(jnp.asarray(A_batch), jnp.asarray(B_batch))
+        if device is not None and not key.mesh:
+            # straight host -> replica-device transfer: an asarray first
+            # would commit the batch to the default device and pay a
+            # second device-to-device hop, funneling the whole fleet's
+            # traffic through device 0's memory
+            A = jax.device_put(A_batch, device)
+            B = jax.device_put(B_batch, device)
+        else:
+            A = jnp.asarray(A_batch)
+            B = jnp.asarray(B_batch)
+        X, info = exe(A, B)
+        with self._lock:
+            self._primed.setdefault((key, A_batch.shape[0]), set()).add(
+                _device_id(None if key.mesh else device)
+            )
         X = faults.corrupt("result_corrupt", np.asarray(X))
         info = faults.poison_info(
             "info_nonzero", np.atleast_1d(np.asarray(info))
         )
         return np.asarray(X), info
 
-    # -- warmup ------------------------------------------------------------
+    # -- warmup / restore (one loop, per-caller error policy) --------------
+
+    def _live_todo(self, batch_max=None, extra_path=None):
+        """The sorted (key, batch) work list both :meth:`warmup` and
+        :meth:`restore` walk: manifest entries (plus an extra manifest
+        file's), minus batch points past ``batch_max`` and minus
+        mesh-keyed entries this process cannot realize (a 2x4 entry on
+        a 1-device box — counted ``serve.mesh_unfit_skipped``, a
+        replica warms only what its mesh can run).  Returns
+        ``(todo, mesh_unfit_count)``."""
+        with self._lock:  # the workers may add entries concurrently
+            todo = list(self._entries)
+        if extra_path is not None and os.path.exists(extra_path):
+            with open(extra_path) as f:
+                for e in manifest_loads(f.read()):
+                    if e not in todo:
+                        todo.append(e)
+        todo.sort(key=lambda e: (e[0].label, e[1]))
+        out = []
+        unfit = 0
+        ndev = None
+        for key, batch in todo:
+            if key.mesh:
+                if batch != 1:
+                    # malformed entry (hand-edited / foreign writer):
+                    # sharded buckets have one batch point — distinct
+                    # from a device-capacity skip, or the operator
+                    # would hunt for missing devices that exist
+                    metrics.inc("serve.manifest_bad_batch")
+                    continue
+                if ndev is None:
+                    import jax
+
+                    ndev = len(jax.devices())
+                if not mesh_fits(key.mesh, ndev):
+                    unfit += 1
+                    metrics.inc("serve.mesh_unfit_skipped")
+                    continue
+            elif batch_max is not None and batch > batch_max:
+                continue
+            out.append((key, batch))
+        return out, unfit
+
+    def _bring_live(
+        self,
+        todo,
+        devices=None,
+        on_error: Optional[Callable] = None,
+        stop_check: Optional[Callable[[], bool]] = None,
+        verbose: bool = False,
+        tag: str = "warmup",
+    ):
+        """The ONE loop behind :meth:`warmup` and :meth:`restore` —
+        placement plumbing lands here exactly once.  Brings each entry
+        live (artifact-first via :meth:`executable`) and primes it with
+        one dummy dispatch on every device in ``devices`` it has not
+        dispatched on yet (replica pinning: multi-replica steady state
+        must be compile-free on EVERY replica device).  Mesh-keyed
+        entries prime once — their placement is the mesh itself.
+
+        Per-caller error policy: ``on_error=None`` propagates the
+        first failure (warmup semantics — the caller wants to know its
+        precompile failed); a callable receives ``(key, batch, exc)``
+        and the entry is reported ``failed`` (restore semantics —
+        degrade, never crash).  ``stop_check`` is polled between
+        entries; True abandons the rest (``serve.restore_stopped``).
+
+        Yields ``(key, batch, outcome, origin)`` rows with outcome in
+        ``restored`` (came live from an export artifact) / ``compiled``
+        (any other rung) / ``skipped`` (already live and primed
+        everywhere requested) / ``failed``."""
+        devs = [d for d in (devices if devices else [None])]
+        # dedupe while preserving replica order (replicas may share a
+        # device when the pool is smaller than the replica count)
+        seen: Set = set()
+        devs = [
+            d for d in devs
+            if _device_id(d) not in seen and not seen.add(_device_id(d))
+        ]
+        for key, batch in todo:
+            if stop_check is not None and stop_check():
+                metrics.inc("serve.restore_stopped")
+                break
+            want = [None] if key.mesh else devs
+            with self._lock:
+                live = (key, batch) in self._exes
+                primed = set(self._primed.get((key, batch), ()))
+            need = [d for d in want if _device_id(d) not in primed]
+            if live and not need:
+                yield key, batch, "skipped", None
+                continue
+            t0 = time.perf_counter()
+            try:
+                A, B = _warm_inputs(key, batch)
+                for d in (need or want):
+                    # loads-or-builds on the first device, then primes
+                    # the per-device variants (subclassed caches keep
+                    # the legacy 3-arg run() for the default placement)
+                    if d is None:
+                        self.run(key, A, B)
+                    else:
+                        self.run(key, A, B, device=d)
+            except Exception as e:  # noqa: BLE001 — policy decides
+                if on_error is None:
+                    raise
+                on_error(key, batch, e)
+                yield key, batch, "failed", None
+                continue
+            origin = self._origin.get((key, batch), "compile")
+            if live:
+                # the executable predates this pass; only new devices
+                # were primed — no fresh restore/compile to report, but
+                # the per-device backend compiles are real cold-start
+                # budget, so they are counted and printed, not hidden
+                outcome = "skipped"
+                primes = len(need)
+            else:
+                outcome = "restored" if origin == "artifact" else "compiled"
+                primes = max(0, len(need or want) - 1)
+            if primes:
+                metrics.inc("serve.device_primes", primes)
+            if verbose:
+                extra = f" +{primes} device prime(s)" if primes else ""
+                print(
+                    f"[serve.{tag}] {key.label} b{batch}: "
+                    f"{'primed' if live else origin}"
+                    f"{extra} {time.perf_counter() - t0:.2f}s"
+                )
+            yield key, batch, outcome, origin
 
     def warmup(
         self,
         path: Optional[str] = None,
         batch_max: Optional[int] = None,
+        devices=None,
         verbose: bool = False,
     ) -> int:
         """Pre-compile every manifest entry (plus ``path``'s entries if
-        given).  Returns the number of executables compiled — entries
-        that ``executable()`` served from the artifact store instead
-        are not counted (zero compiles happened; ``restore()`` is the
-        pass that reports restores).  Per-bucket compile walls land in
-        the ``serve.<bucket>.b<batch>.compile`` timers; the whole pass
+        given), priming each ``devices`` entry so a replica pool's
+        steady state never compiles.  Returns the number of
+        executables compiled — entries that ``executable()`` served
+        from the artifact store instead are not counted (zero compiles
+        happened; ``restore()`` is the pass that reports restores).
+        Errors propagate (the caller asked for a precompile and should
+        know it failed).  Per-bucket compile walls land in the
+        ``serve.<bucket>.b<batch>.compile`` timers; the whole pass
         under the ``serve.warmup`` timer."""
-        with self._lock:  # the worker may add entries concurrently
-            todo = list(self._entries)
-        if path is not None and os.path.exists(path):
-            with open(path) as f:
-                for e in manifest_loads(f.read()):
-                    if e not in todo:
-                        todo.append(e)
+        todo, _unfit = self._live_todo(batch_max=batch_max, extra_path=path)
         compiled = 0
         with metrics.phase("serve.warmup", always=True) as ph:
-            for key, batch in sorted(todo, key=lambda e: (e[0].label, e[1])):
-                if batch_max is not None and batch > batch_max:
-                    continue
-                with self._lock:
-                    if (key, batch) in self._exes:
-                        continue
-                t0 = time.perf_counter()
-                A, B = _warm_inputs(key, batch)
-                X, info = self.run(key, A, B)
-                if self._origin.get((key, batch)) != "artifact":
+            for _k, _b, outcome, _origin in self._bring_live(
+                todo, devices=devices, on_error=None, verbose=verbose,
+                tag="warmup",
+            ):
+                if outcome == "compiled":
                     compiled += 1  # an artifact hit compiled nothing
-                if verbose:
-                    print(
-                        f"[serve.warmup] {key.label} b{batch}: "
-                        f"{time.perf_counter() - t0:.2f}s"
-                    )
         metrics.gauge("serve.warmup_s", ph.seconds)
         metrics.inc("serve.warmup_compiles", compiled)
         return compiled
-
-    # -- restore (artifact-first cold start) -------------------------------
 
     def restore(
         self,
         batch_max: Optional[int] = None,
         verbose: bool = False,
         stop_check: Optional[Callable[[], bool]] = None,
+        devices=None,
     ) -> Dict[str, int]:
         """Bring every manifest entry live, artifact-first: load (or,
         where the store has nothing valid, compile) each executable and
-        prime it with one dummy dispatch, so a subsequent steady-state
-        stream never traces or compiles.  This is the cold-start path a
-        fresh replica runs before reporting ``ready``.
+        prime it with one dummy dispatch per ``devices`` entry, so a
+        subsequent steady-state stream never traces or compiles on any
+        replica.  This is the cold-start path a fresh replica runs
+        before reporting ``ready``.
 
         Per-entry failures (a fault-injected load, an execute fault on
         the priming dispatch, a poisoned artifact dir) are counted and
@@ -428,45 +640,31 @@ class ExecutableCache:
         recompiles; skipped = already live when the pass reached it —
         e.g. traffic served while restoring built it first — so
         ``entries == restored + compiled + failed + skipped`` always
-        holds).
+        holds), plus ``mesh_unfit`` when manifest entries were skipped
+        because their mesh shape does not fit this process's devices.
 
         ``stop_check`` is polled between entries; True abandons the
         rest of the pass (the service passes its stopped flag so a
         replica torn down mid-restore does not keep compiling a large
         manifest for minutes on a daemon thread)."""
-        with self._lock:
-            todo = sorted(self._entries, key=lambda e: (e[0].label, e[1]))
+        todo, unfit = self._live_todo(batch_max=batch_max)
         out = {
             "entries": 0, "restored": 0, "compiled": 0, "failed": 0,
             "skipped": 0,
         }
+        if unfit:
+            out["mesh_unfit"] = unfit
+
+        def on_error(key, batch, exc):
+            metrics.inc("serve.restore_failed")
+
         with metrics.phase("serve.restore", always=True) as ph:
-            for key, batch in todo:
-                if stop_check is not None and stop_check():
-                    metrics.inc("serve.restore_stopped")
-                    break
-                if batch_max is not None and batch > batch_max:
-                    continue
+            for _k, _b, outcome, _origin in self._bring_live(
+                todo, devices=devices, on_error=on_error,
+                stop_check=stop_check, verbose=verbose, tag="restore",
+            ):
                 out["entries"] += 1
-                with self._lock:
-                    if (key, batch) in self._exes:
-                        out["skipped"] += 1  # already live (a race won)
-                        continue
-                t0 = time.perf_counter()
-                try:
-                    A, B = _warm_inputs(key, batch)
-                    self.run(key, A, B)  # loads-or-builds, then primes
-                except Exception:  # noqa: BLE001 — degrade, never crash
-                    out["failed"] += 1
-                    metrics.inc("serve.restore_failed")
-                    continue
-                origin = self._origin.get((key, batch), "compile")
-                out["restored" if origin == "artifact" else "compiled"] += 1
-                if verbose:
-                    print(
-                        f"[serve.restore] {key.label} b{batch}: {origin} "
-                        f"{time.perf_counter() - t0:.2f}s"
-                    )
+                out[outcome] += 1
         metrics.gauge("serve.restore_s", ph.seconds)
         metrics.inc("serve.restore_restored", out["restored"])
         metrics.inc("serve.restore_compiled", out["compiled"])

@@ -1,0 +1,193 @@
+"""Mesh-aware placement policy for the serving tier.
+
+The policy answers two questions the SolverService asks on every
+request, turning the device fleet into the serving domain (ROADMAP
+item 1 — SLATE SC'19's 2D process grid as the placement domain,
+Clipper NSDI'17's replica scale-out as the serving shape):
+
+1. **Where does this request run?** (:meth:`PlacementPolicy.mesh_for`)
+   Small buckets stay on the *replicated* tier — the executable is
+   data-parallel-replicated across devices, one replica worker + queue
+   per device (group), and throughput scales with chips.  Large-n
+   requests (``n >= shard_threshold``) or explicitly-sharded submits
+   route to the *sharded* tier — the existing ``parallel/`` spmd
+   drivers under ``shard_map`` on a configured P x Q submesh
+   (``parallel/grid.ProcessGrid``), so one request is no longer
+   bounded by a single device's HBM and FLOPs.
+
+2. **Which replica takes it?** (:meth:`PlacementPolicy.select_replica`)
+   Least-loaded (queue depth + in-flight) with round-robin tie
+   breaking, or plain round-robin; replicas whose circuit breaker for
+   this bucket is OPEN are excluded while any healthy replica exists —
+   a degraded replica sheds its batched traffic to its peers instead
+   of forcing every request through the direct fallback.
+
+The policy is pure decision logic (the mesh grammar and fit checks
+live in serve/buckets so manifests can be filtered without jax);
+devices resolve lazily so constructing a default single-replica
+policy — the configuration every pre-placement deployment ran —
+touches no jax state at all.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..enums import Option
+from ..options import Options, get_option
+from .buckets import (  # noqa: F401  (re-exports)
+    DEFAULT_SHARD_THRESHOLD,
+    check_mesh,
+    mesh_fits,
+    parse_mesh,
+)
+
+#: replica-selection strategies
+LEAST_LOADED = "least_loaded"
+ROUND_ROBIN = "round_robin"
+
+#: routines the sharded tier can serve (the spmd drivers traced by
+#: parallel/spmd_core; gels and mixed precision stay replicated)
+SHARDABLE = ("gesv", "posv")
+
+
+class PlacementPolicy:
+    """Per-bucket placement: replica scale-out for small buckets,
+    spmd submesh routing for large ones.
+
+    Parameters
+    ----------
+    replicas: data-parallel replica worker count (default 1 — the
+        single-worker service, behavior-identical to the pre-placement
+        tier).  Each replica pins its dispatches to one device via
+        :meth:`device_for`; with more replicas than devices the
+        assignment wraps.
+    mesh: ``"PxQ"`` submesh for sharded routing, ``""`` disables it.
+        The sharded lane always binds the process's first P*Q global
+        ``jax.devices()`` (parallel/spmd_core.grid_for) — the
+        ``devices`` list below pins replicas only.
+    shard_threshold: requests with ``n >= shard_threshold`` route to
+        the mesh when one is configured (default 2048, matching
+        Option.ServeShardThreshold; 0 disables size-based routing —
+        explicit ``sharded=True`` submits still route).
+    strategy: ``"least_loaded"`` (default) or ``"round_robin"``.
+    devices: explicit device list for REPLICA pinning (tests);
+        default = ``jax.devices()`` resolved lazily on first use.
+    """
+
+    def __init__(
+        self,
+        replicas: int = 1,
+        mesh: str = "",
+        shard_threshold: int = DEFAULT_SHARD_THRESHOLD,
+        strategy: str = LEAST_LOADED,
+        devices: Optional[Sequence] = None,
+    ):
+        self.replicas = max(int(replicas), 1)
+        self.mesh = check_mesh(mesh)
+        self.shard_threshold = max(int(shard_threshold), 0)
+        if strategy not in (LEAST_LOADED, ROUND_ROBIN):
+            raise ValueError(
+                f"unknown placement strategy {strategy!r} "
+                f"({LEAST_LOADED}|{ROUND_ROBIN})"
+            )
+        self.strategy = strategy
+        self._devices = list(devices) if devices is not None else None
+        self._rr = 0  # round-robin cursor (ties + pure round-robin)
+
+    @staticmethod
+    def from_options(opts: Optional[Options] = None, **kw) -> "PlacementPolicy":
+        """Resolve the policy from the Serve* options (the service's
+        default construction path); ``kw`` overrides fields."""
+        cfg = dict(
+            replicas=int(get_option(opts, Option.ServeReplicas)),
+            mesh=str(get_option(opts, Option.ServeMesh) or ""),
+            shard_threshold=int(get_option(opts, Option.ServeShardThreshold)),
+        )
+        cfg.update({k: v for k, v in kw.items() if v is not None})
+        return PlacementPolicy(**cfg)
+
+    # -- devices -------------------------------------------------------------
+
+    def devices(self) -> List:
+        """The device pool (lazy ``jax.devices()``)."""
+        if self._devices is None:
+            import jax
+
+            self._devices = list(jax.devices())
+        return self._devices
+
+    def device_for(self, replica: int):
+        """The device replica ``replica`` pins its dispatches to; None
+        for the single-replica policy (default-device placement, the
+        pre-placement behavior, no committed transfers).  When the pool
+        is large enough to host the spmd submesh AND the replicas,
+        replica pinning starts past the mesh slice (grid_for binds the
+        first P*Q devices), so replicated batches and shard_map
+        programs do not contend for the same chips while spares idle."""
+        if self.replicas <= 1:
+            return None
+        devs = self.devices()
+        p, q = parse_mesh(self.mesh)
+        off = p * q if p and len(devs) >= p * q + self.replicas else 0
+        return devs[(off + replica) % len(devs)]
+
+    def replica_devices(self) -> List:
+        """One entry per replica — what warmup/restore prime so steady
+        state stays compile-free on EVERY replica, not just the first."""
+        return [self.device_for(i) for i in range(self.replicas)]
+
+    # -- routing -------------------------------------------------------------
+
+    def mesh_for(
+        self, routine: str, n: int, sharded: Optional[bool] = None
+    ) -> str:
+        """The mesh string this request's bucket should be keyed (and
+        routed) by: ``""`` = replicated tier, ``"PxQ"`` = sharded tier.
+
+        ``sharded`` is the per-submit override: True forces the mesh
+        (the caller validates one is configured), False forces the
+        replicated tier, None applies the size policy."""
+        if routine not in SHARDABLE or not self.mesh:
+            return ""
+        if sharded is False:
+            return ""
+        if sharded:
+            return self.mesh
+        if self.shard_threshold and n >= self.shard_threshold:
+            return self.mesh
+        return ""
+
+    # -- replica selection ---------------------------------------------------
+
+    def select_replica(
+        self,
+        loads: Sequence[int],
+        open_breaker: Optional[Sequence[bool]] = None,
+    ) -> int:
+        """Pick the replica index for one request.
+
+        ``loads`` is per-replica pending work (queue depth + in-flight);
+        ``open_breaker`` flags replicas whose breaker for this request's
+        bucket is OPEN — they are excluded while any healthy replica
+        exists (when ALL are open the least-loaded one takes it anyway
+        and the per-replica breaker decides direct routing downstream).
+        Ties break round-robin so equal-load replicas share traffic
+        instead of replica 0 absorbing every lull."""
+        n = len(loads)
+        if n == 0:
+            raise ValueError("no replicas to select from")
+        cand = list(range(n))
+        if open_breaker is not None:
+            healthy = [i for i in cand if not open_breaker[i]]
+            if healthy:
+                cand = healthy
+        if self.strategy == ROUND_ROBIN:
+            pick = cand[self._rr % len(cand)]
+            self._rr += 1
+            return pick
+        lo = min(loads[i] for i in cand)
+        tied = [i for i in cand if loads[i] == lo]
+        pick = tied[self._rr % len(tied)]
+        self._rr += 1
+        return pick

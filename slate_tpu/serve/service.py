@@ -1,26 +1,42 @@
-"""SolverService: bounded request queue, supervised worker thread,
-same-bucket batch coalescing, deadlines, retries with backoff, and
-circuit-breaker recovery.
+"""SolverService: bounded admission, a mesh-aware placement tier
+(replica worker pool + spmd routing), same-bucket batch coalescing,
+deadlines, retries with backoff, and circuit-breaker recovery.
 
-Execution model (one supervised worker — the architectural seam later
-scaling PRs widen into multi-host dispatch / priority tiers):
+Execution model (a pool of supervised replica workers plus an optional
+sharded lane — the multi-device serving tier ROADMAP item 1 asks for):
 
 * ``submit()`` validates (non-finite A/B -> immediate
   :class:`~slate_tpu.exceptions.InvalidInput`, before any queue or
   compile cost is paid; ``validate=False`` opts out), buckets the
-  request (`buckets.bucket_for`), and enqueues.  A full queue rejects
-  IMMEDIATELY with :class:`Rejected` — backpressure belongs at
-  admission, not at a timeout deep in the pipeline.
-* The worker pops the oldest *eligible* request (one whose retry
-  backoff has elapsed), waits up to ``batch_window_s`` for company,
-  then coalesces every queued request with the same BucketKey (up to
-  ``batch_max``) into one batch padded to the fixed batch point
-  (`buckets.batch_bucket`), so only two executables exist per bucket
-  and warmed steady state never compiles.
-* **Supervision**: the worker runs under a guard that catches ANY
-  death (including the ``worker_death`` fault site), re-enqueues
-  in-flight requests that still have retry budget, fails the rest fast
-  with a typed error, respawns the worker, and counts
+  request (`buckets.bucket_for`), and enqueues.  A full service (total
+  queued across every replica at ``max_queue``) rejects IMMEDIATELY
+  with :class:`Rejected` — backpressure belongs at admission, not at a
+  timeout deep in the pipeline.
+* **Placement** (`serve/placement.PlacementPolicy`): small buckets are
+  data-parallel-replicated — each of ``replicas`` workers owns a queue
+  and pins its dispatches to one device, and admission routes to the
+  least-loaded (or round-robin) replica, excluding replicas whose
+  breaker for that bucket is open (``serve.replicated_dispatch``).
+  Large-n requests (``n >= shard_threshold``) or ``sharded=True``
+  submits route to the *sharded lane*: a dedicated worker whose bucket
+  executables trace the ``parallel/`` spmd drivers under shard_map on
+  the configured ``"PxQ"`` submesh (``serve.routed_sharded``; the
+  BucketKey carries ``mesh`` so executables, manifests and artifacts
+  key per mesh shape).  The default policy (1 replica, no mesh) is the
+  single-worker service, behavior-identical to the pre-placement tier.
+* Each replica worker pops the oldest *eligible* request from ITS
+  queue (one whose retry backoff has elapsed), waits up to
+  ``batch_window_s`` for company, then coalesces every queued request
+  with the same BucketKey (up to ``batch_max``) into one batch padded
+  to the fixed batch point (`buckets.batch_bucket`), so only two
+  executables exist per bucket per device and warmed steady state
+  never compiles.  Sharded buckets never coalesce: their batch point
+  is 1 — shape parallelism comes from the mesh, throughput from the
+  replicas.
+* **Supervision**: every worker runs under a guard that catches ANY
+  death (including the ``worker_death`` fault site), re-enqueues that
+  replica's in-flight requests that still have retry budget, fails the
+  rest fast with a typed error, respawns the worker, and counts
   ``serve.worker_restarts`` — no future ever hangs.
 * Deadlines: a request whose deadline passes while still QUEUED is
   cancelled with :class:`DeadlineExceeded`
@@ -30,30 +46,33 @@ scaling PRs widen into multi-host dispatch / priority tiers):
   ``serve.deadline_miss_late``.  ``serve.deadline_miss`` stays the
   total of both.
 * Failures: an executable exception re-enqueues the batch's requests
-  while they have ``retries`` left, each delayed by exponential
-  backoff with decorrelated jitter (:func:`decorrelated_backoff`,
-  seeded — never the old immediate re-enqueue); after the budget each
+  on their own replica while they have ``retries`` left, each delayed
+  by exponential backoff with decorrelated jitter
+  (:func:`decorrelated_backoff`, seeded); after the budget each
   request falls back to the direct driver (``serve.fallbacks``).
-* **Circuit breaker** (`buckets.Breaker`, keyed by BucketKey): a
-  bucket whose batched path fails ``degrade_after`` consecutive times
-  opens its breaker — requests route direct — but after
-  ``breaker_cooldown_s`` the breaker half-opens and the next batch
-  probes the batched path; one healthy probe closes it again.
-  Degradation is a recoverable state, not a one-way door.
+* **Circuit breaker** (`buckets.Breaker`, keyed by BucketKey *per
+  replica*): a bucket whose batched path fails ``degrade_after``
+  consecutive times on one replica opens that replica's breaker —
+  its requests route direct, and admission steers NEW requests for
+  the bucket to healthy replicas — but after ``breaker_cooldown_s``
+  the breaker half-opens and the next batch probes the batched path;
+  one healthy probe closes it again.  Degradation is recoverable and
+  local: one sick replica never degrades the whole bucket fleet.
 * A nonzero per-item ``info`` raises
   :class:`~slate_tpu.exceptions.NumericalError` on that item's future
   only (no retry: the failure is deterministic); a non-finite solution
   for finite inputs (the ``result_corrupt`` fault site) re-solves that
   item on the direct driver instead of delivering garbage.
 * :meth:`SolverService.health` returns a liveness/readiness snapshot
-  (queue depth, worker liveness + restarts, per-bucket breaker states,
-  recent failure rate) for external probes — including the cold-start
-  **readiness phase** ``cold`` -> ``restoring`` -> ``ready``: a
-  service whose cache has an artifact store (``SLATE_TPU_ARTIFACTS``)
-  restores every manifest entry on :meth:`start` in a background
-  thread (serve/artifacts degrade ladder: verified artifact ->
-  manifest recompile -> cold compile) before reporting ``ready``, so
-  an orchestrator can gate traffic until the warmed executable set is
+  (total + per-replica queue depth, per-replica worker liveness /
+  restarts / dispatch counts / breaker states, recent failure rate)
+  for external probes — including the cold-start **readiness phase**
+  ``cold`` -> ``restoring`` -> ``ready``: a service whose cache has an
+  artifact store (``SLATE_TPU_ARTIFACTS``) restores every manifest
+  entry on :meth:`start` in a background thread — priming every
+  replica's device, and skipping manifest entries whose mesh shape
+  this process cannot realize — before reporting ``ready``, so an
+  orchestrator can gate traffic until the warmed executable set is
   live.  Requests submitted while ``restoring`` are still served
   (possibly paying a compile); the phase is a gate for callers, not an
   admission check.
@@ -61,8 +80,12 @@ scaling PRs widen into multi-host dispatch / priority tiers):
 Every exception set on a future carries structured context
 (``routine``/``bucket``/``attempt``, :meth:`SlateError.with_context`).
 
-Metrics: ``serve.queue_depth`` gauge, ``serve.requests``,
-``serve.batched``, ``serve.batched_requests``, ``serve.batch_pad``,
+Metrics: ``serve.queue_depth`` gauge (total) +
+``serve.replica.<i>.queue_depth`` per replica (the sharded lane is
+``serve.replica.sharded.*``), ``serve.requests``,
+``serve.replicated_dispatch`` / ``serve.routed_sharded`` placement
+counters, ``serve.replica.<i>.dispatched``, ``serve.batched``,
+``serve.batched_requests``, ``serve.batch_pad``,
 ``serve.bucket_pad_waste``, ``serve.deadline_miss`` (+ ``_queued`` /
 ``_late`` split), ``serve.rejected``, ``serve.invalid_input``,
 ``serve.retries`` + ``serve.retry_backoff_s`` timer,
@@ -91,6 +114,7 @@ from ..aux import faults, metrics
 from ..exceptions import InvalidInput, NumericalError, SlateError
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
+from .placement import PlacementPolicy
 
 
 class Rejected(SlateError):
@@ -150,6 +174,30 @@ class _Request:
         )
 
 
+class _Replica:
+    """One serving lane: a queue, a supervised worker, per-bucket
+    breakers, and (replicated tier) the device its dispatches pin to.
+    The sharded lane is a _Replica named "sharded" with no device pin
+    (its executables carry their own mesh placement)."""
+
+    def __init__(self, name: str, device=None):
+        self.name = name
+        self.device = device
+        self.q: Deque[_Request] = deque()
+        self.inflight: List[_Request] = []
+        self.breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}
+        self.thread: Optional[threading.Thread] = None
+        self.restarts = 0
+        self.dispatched = 0  # requests this lane executed (incl. direct)
+        # metric names precomputed once: the queue gauge is emitted
+        # under the service condition lock on every admission/pop
+        self.q_gauge = f"serve.replica.{name}.queue_depth"
+        self.dispatched_counter = f"serve.replica.{name}.dispatched"
+
+    def alive(self) -> bool:
+        return bool(self.thread is not None and self.thread.is_alive())
+
+
 class SolverService:
     """Batching solver service over the driver stack.
 
@@ -158,14 +206,17 @@ class SolverService:
     cache: shared :class:`ExecutableCache` (one per process is the
         point — executables amortize across services); built from
         ``SLATE_TPU_WARMUP`` when omitted.
-    max_queue: admission limit; ``submit`` past it raises Rejected.
+    max_queue: admission limit over the TOTAL queued across replicas;
+        ``submit`` past it raises Rejected.
     batch_max: coalesced batch point (and per-key executable batch).
-    batch_window_s: how long the worker lingers for coalescable
+    batch_window_s: how long a worker lingers for coalescable
         arrivals after popping a lone request.
     dim_floor / nrhs_floor: bucket lattice floors (buckets.py).
     degrade_after: consecutive batched-path failures of one bucket
-        before its breaker opens (requests route direct until the
-        cooldown elapses and a half-open probe succeeds).
+        on one replica before that replica's breaker opens (its
+        requests route direct and admission steers new traffic to
+        healthy replicas until the cooldown elapses and a half-open
+        probe succeeds).
     breaker_cooldown_s: open -> half-open delay
         (Option.ServeBreakerCooldown when None).
     retry_backoff_s: decorrelated-jitter base delay for batch retries
@@ -188,6 +239,14 @@ class SolverService:
         a breaker failure — persistent offenders demote the bucket to
         direct until the breaker heals).  ``submit(precision=...)``
         overrides per request.
+    placement: :class:`~slate_tpu.serve.placement.PlacementPolicy`
+        (replica count, spmd submesh, shard threshold, selection
+        strategy).  None builds one from the Serve* options
+        (``ServeReplicas`` / ``ServeMesh`` / ``ServeShardThreshold``),
+        with ``replicas=`` below overriding the count.  The default
+        (1 replica, no mesh) reproduces the single-worker service.
+    replicas: shorthand override for ``placement.replicas`` when no
+        explicit policy is passed.
     faults_spec: aux/faults grammar string; arms + enables injection
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
@@ -218,6 +277,8 @@ class SolverService:
         validate: Optional[bool] = None,
         schedule: Optional[str] = None,
         precision: Optional[str] = None,
+        placement: Optional[PlacementPolicy] = None,
+        replicas: Optional[int] = None,
         faults_spec: Optional[str] = None,
         restore_on_start: Optional[bool] = None,
         start: bool = True,
@@ -271,6 +332,30 @@ class SolverService:
         if precision is None:
             precision = get_option(None, Option.ServePrecision) or "full"
         self.precision = _bk.check_precision(precision)
+        self.placement = (
+            placement if placement is not None
+            else PlacementPolicy.from_options(replicas=replicas)
+        )
+        if self.placement.mesh:
+            # fail FAST, and against the SAME device pool the sharded
+            # lane will actually bind (parallel/spmd_core.grid_for uses
+            # the process-global jax.devices(); the policy's explicit
+            # device list only pins replicas): without this, every
+            # sharded request would pay a failed spmd trace, trip the
+            # breaker, and silently resolve via the single-device
+            # direct fallback — an explicit "run this on the mesh"
+            # deployment downgraded to metrics noise
+            import jax
+
+            ndev = len(jax.devices())
+            if not _bk.mesh_fits(self.placement.mesh, ndev):
+                from ..exceptions import DistributedException
+
+                p, q = _bk.parse_mesh(self.placement.mesh)
+                raise DistributedException(
+                    f"serving mesh {self.placement.mesh} needs {p * q} "
+                    f"devices, only {ndev} visible"
+                )
         if faults_spec is None:
             faults_spec = get_option(None, Option.Faults) or ""
         # injection state is process-global (like metrics); a service
@@ -285,18 +370,48 @@ class SolverService:
         self._restore_result: Optional[Dict[str, int]] = None
         self._restore_thread: Optional[threading.Thread] = None
         self._rng = random.Random(retry_seed)
-        self._q: Deque[_Request] = deque()
         self._cond = threading.Condition()
         self._running = False
         self._stopped = False  # stop() called; submit() rejects until start()
-        self._thread: Optional[threading.Thread] = None
-        self._breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}
-        self._inflight: List[_Request] = []
+        # the replicated tier: one lane per replica (replica i pins to
+        # placement.device_for(i); the default single replica pins to
+        # nothing — the pre-placement single-worker behavior), plus the
+        # sharded lane when a mesh is configured
+        self._replicas: List[_Replica] = [
+            _Replica(str(i), self.placement.device_for(i))
+            for i in range(self.placement.replicas)
+        ]
+        self._shard_rep: Optional[_Replica] = (
+            _Replica("sharded") if self.placement.mesh else None
+        )
         self._restarts = 0
         self._recent_fail: Deque[float] = deque(maxlen=256)
         self._t_started = time.monotonic()
         if start:
             self.start()
+
+    # -- lanes -------------------------------------------------------------
+
+    @property
+    def _lanes(self) -> List[_Replica]:
+        return self._replicas + (
+            [self._shard_rep] if self._shard_rep is not None else []
+        )
+
+    @property
+    def _breakers(self) -> Dict[_bk.BucketKey, _bk.Breaker]:
+        """Back-compat alias: the default replica's breaker table (the
+        whole table of a single-replica service)."""
+        return self._replicas[0].breakers
+
+    def _gauge_queues_locked(self) -> int:
+        total = 0
+        for rep in self._lanes:
+            d = len(rep.q)
+            total += d
+            metrics.gauge(rep.q_gauge, d)
+        metrics.gauge("serve.queue_depth", total)
+        return total
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -306,7 +421,8 @@ class SolverService:
                 return self
             self._running = True
             self._stopped = False
-        self._spawn_worker()
+        for rep in self._lanes:
+            self._spawn_worker(rep)
         self._begin_restore()
         return self
 
@@ -334,12 +450,22 @@ class SolverService:
             self._restore_thread = t
         t.start()
 
+    def restore(self, verbose: bool = False, stop_check=None) -> Dict[str, int]:
+        """Run the cache's artifact/manifest restore pass for THIS
+        service's placement (every replica device primed, mesh-unfit
+        entries skipped) — the ONE spelling of the restore plumbing,
+        used by the start-time background pass and ``serve.restore()``
+        alike.  Returns the cache's restore summary."""
+        return self.cache.restore(
+            batch_max=self.batch_max,
+            stop_check=stop_check,
+            devices=self.placement.replica_devices(),
+            verbose=verbose,
+        )
+
     def _run_restore(self) -> None:
         try:
-            result = self.cache.restore(
-                batch_max=self.batch_max,
-                stop_check=lambda: self._stopped,
-            )
+            result = self.restore(stop_check=lambda: self._stopped)
         except Exception:  # noqa: BLE001 — a broken store must not block ready
             # distinct from the per-entry serve.restore_failed counter:
             # the whole pass died before/outside the entry loop.  The
@@ -377,41 +503,65 @@ class SolverService:
                 self._cond.wait(min(left, 0.1) if left > 0 else 0.1)
             return True
 
-    def _spawn_worker(self) -> None:
+    def warmup(
+        self, path: Optional[str] = None, verbose: bool = False
+    ) -> int:
+        """Pre-compile the manifest's executables for THIS service's
+        placement: every replica device is primed (so steady state is
+        compile-free on all of them), and manifest entries whose mesh
+        this process cannot realize are skipped.  Returns the number
+        of executables compiled."""
+        return self.cache.warmup(
+            path=path, batch_max=self.batch_max,
+            devices=self.placement.replica_devices(), verbose=verbose,
+        )
+
+    def _spawn_worker(self, rep: _Replica) -> None:
         t = threading.Thread(
-            target=self._run_worker, name="slate-serve-worker", daemon=True
+            target=self._run_worker, args=(rep,),
+            name=f"slate-serve-worker-{rep.name}", daemon=True,
         )
         with self._cond:
-            self._thread = t
+            rep.thread = t
         t.start()
 
     def stop(self, timeout: float = 10.0) -> None:
-        """Stop the worker; unstarted/leftover requests resolve with
+        """Stop the workers; unstarted/leftover requests resolve with
         Rejected (futures never hang)."""
         with self._cond:
             self._running = False
             self._stopped = True
-            leftovers = list(self._q)
-            self._q.clear()
+            leftovers: List[_Request] = []
+            for rep in self._lanes:
+                leftovers.extend(rep.q)
+                rep.q.clear()
+            # zero the per-replica queue gauges too, or a metrics dump
+            # after stop() shows phantom per-lane depth under a zero total
+            self._gauge_queues_locked()
             self._cond.notify_all()
-            t = self._thread
-        if t is not None:
-            t.join(timeout)
-            with self._cond:
-                if self._thread is t:
-                    self._thread = None
+            threads = [rep.thread for rep in self._lanes]
+        # ONE timeout budget for the whole teardown (not per thread):
+        # an orchestrator's grace period is sized to `timeout`, not
+        # timeout x (replicas + 1) with five wedged workers
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            if t is not None:
+                t.join(max(0.0, deadline - time.monotonic()))
+        with self._cond:
+            for rep, t in zip(self._lanes, threads):
+                if rep.thread is t:
+                    rep.thread = None
         # the restore thread polls _stopped between entries; bounded
         # join so faults.reset() below never runs under a live pass
         with self._cond:
             rt = self._restore_thread
         if rt is not None and rt.is_alive():
-            rt.join(timeout)
+            rt.join(max(0.0, deadline - time.monotonic()))
         for r in leftovers:
             _resolve_exc(r.future, Rejected("service stopped"), req=r)
         if self._owns_faults:
             faults.reset()
             self._owns_faults = False
-        metrics.gauge("serve.queue_depth", 0)
 
     def __enter__(self) -> "SolverService":
         return self.start()
@@ -430,6 +580,7 @@ class SolverService:
         deadline: Optional[float] = None,
         retries: int = 0,
         precision: Optional[str] = None,
+        sharded: Optional[bool] = None,
     ) -> Future:
         """Enqueue one solve; returns a Future resolving to the cropped
         solution X (n x nrhs ndarray).
@@ -438,8 +589,12 @@ class SolverService:
         batched path (with backoff) on executable failure before
         falling back.  ``precision`` ("full"|"mixed") overrides the
         service-wide solve path for this request (gesv/posv only —
-        gels always serves full precision).  Raises :class:`Rejected`
-        when the queue is full and :class:`InvalidInput` on non-finite
+        gels always serves full precision).  ``sharded`` overrides the
+        placement policy: True forces the spmd submesh (raises
+        ValueError when none is configured or the routine has no
+        sharded path), False forces the replicated tier, None routes
+        by size (``shard_threshold``).  Raises :class:`Rejected` when
+        the queue is full and :class:`InvalidInput` on non-finite
         operands (before any queue/compile cost; disable with
         ``validate=False``)."""
         A = np.asarray(A)
@@ -469,12 +624,32 @@ class SolverService:
         prec = _bk.check_precision(
             precision if precision is not None else self.precision
         )
+        # placement: "" = replicated tier, "PxQ" = the sharded lane
+        mesh = self.placement.mesh_for(routine, n, sharded)
+        if mesh and prec != "full":
+            if sharded and precision is not None:
+                # explicitly sharded AND explicitly mixed: contradictory
+                raise ValueError(
+                    f"{routine}: sharded serving is full-precision only"
+                )
+            if sharded:
+                # explicit sharded under a mixed SERVICE default: the
+                # caller asked for the mesh, not for mixed — serve the
+                # request full-precision there
+                prec = "full"
+            else:
+                mesh = ""  # size-routed mixed requests stay replicated
+        if sharded and not mesh:
+            raise ValueError(
+                f"{routine}: sharded routing unavailable (no mesh "
+                "configured, or the routine has no sharded path)"
+            )
         key: Optional[_bk.BucketKey] = None
         if not (routine == "gels" and m < n):
             key = _bk.bucket_for(
                 routine, m, n, nrhs, A.dtype,
                 floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
-                schedule=self.schedule, precision=prec,
+                schedule=self.schedule, precision=prec, mesh=mesh,
             )
         req = _Request(
             routine=routine, key=key, A=A, B=B, m=m, n=n, nrhs=nrhs,
@@ -492,43 +667,104 @@ class SolverService:
                 raise Rejected(
                     "service stopped; configure() a new one"
                 ).with_context(routine=routine)
-            if len(self._q) >= self.max_queue:
+            if sum(len(rep.q) for rep in self._lanes) >= self.max_queue:
                 metrics.inc("serve.rejected")
                 raise Rejected(
                     f"queue full ({self.max_queue}); retry with backoff"
                 ).with_context(routine=routine)
-            self._q.append(req)
-            depth = len(self._q)
+            if key is not None and key.mesh:
+                rep = self._shard_rep
+            else:
+                rep = self._pick_replica_locked(key)
+            rep.q.append(req)
+            self._gauge_queues_locked()
             self._cond.notify_all()
+        if key is not None and key.mesh:
+            metrics.inc("serve.routed_sharded")
+        elif key is not None:
+            metrics.inc("serve.replicated_dispatch")
         metrics.inc("serve.requests")
-        metrics.gauge("serve.queue_depth", depth)
         return req.future
+
+    def _pick_replica_locked(self, key: Optional[_bk.BucketKey]) -> _Replica:
+        """Admission-side replica selection: least-loaded/round-robin
+        via the placement policy, excluding replicas whose breaker for
+        this bucket is OPEN while a healthy one exists."""
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        loads = [len(r.q) + len(r.inflight) for r in self._replicas]
+        open_fl = None
+        if key is not None:
+            # exclude a breaker-open replica only while its cooldown is
+            # still running (Breaker.cooling_down — one definition with
+            # try_half_open): once it elapses the lane must be
+            # selectable again, or the half-open probe (driven by
+            # _execute when a batch reaches the lane) could never fire
+            # and the breaker would stay open forever behind healthy
+            # peers
+            now = time.monotonic()
+            open_fl = []
+            for r in self._replicas:
+                b = r.breakers.get(key)
+                open_fl.append(
+                    b is not None
+                    and b.cooling_down(now, self.breaker_cooldown_s)
+                )
+        return self._replicas[self.placement.select_replica(loads, open_fl)]
 
     def queue_depth(self) -> int:
         with self._cond:
-            return len(self._q)
+            return sum(len(rep.q) for rep in self._lanes)
 
     # -- health ------------------------------------------------------------
 
     def health(self) -> dict:
-        """Liveness/readiness snapshot for external probes: queue
-        depth vs limit, worker liveness + lifetime restarts, per-bucket
-        breaker states, and the recent failure rate (last 60 s over a
-        bounded window).  Cheap enough to poll."""
+        """Liveness/readiness snapshot for external probes: total +
+        per-replica queue depth vs limit, per-replica worker liveness,
+        lifetime restarts, dispatch counts and breaker states, and the
+        recent failure rate (last 60 s over a bounded window).  Cheap
+        enough to poll.  The legacy top-level ``breakers`` map merges
+        the per-replica tables (worst state wins) so existing probes
+        keep working; ``replicas`` (and ``sharded``, when a mesh is
+        configured) carry the placement-aware detail."""
         now = time.monotonic()
         window_s = 60.0
+        rank = {
+            _bk.BREAKER_OPEN: 2, _bk.BREAKER_HALF_OPEN: 1,
+            _bk.BREAKER_CLOSED: 0,
+        }
         with self._cond:
-            depth = len(self._q)
-            alive = bool(self._thread is not None and self._thread.is_alive())
+            depth = sum(len(rep.q) for rep in self._lanes)
+            alive = all(rep.alive() for rep in self._lanes)
             running = self._running
             restarts = self._restarts
-            inflight = len(self._inflight)
-            breakers = {k.label: b.state for k, b in self._breakers.items()}
+            inflight = sum(len(rep.inflight) for rep in self._lanes)
+            merged: Dict[str, str] = {}
+            lanes = []
+            for rep in self._lanes:
+                states = {k.label: b.state for k, b in rep.breakers.items()}
+                for lbl, st in states.items():
+                    if rank[st] > rank.get(merged.get(lbl), -1):
+                        merged[lbl] = st
+                lanes.append({
+                    "name": rep.name,
+                    "device": str(rep.device) if rep.device is not None
+                    else None,
+                    "queue_depth": len(rep.q),
+                    "inflight": len(rep.inflight),
+                    "worker_alive": rep.alive(),
+                    "restarts": rep.restarts,
+                    "dispatched": rep.dispatched,
+                    "breakers": states,
+                })
             recent = [t for t in self._recent_fail if now - t <= window_s]
             phase = self._phase
             restore_result = (
                 dict(self._restore_result) if self._restore_result else None
             )
+        shard_lane = lanes.pop() if self._shard_rep is not None else None
+        if shard_lane is not None:
+            shard_lane["mesh"] = self.placement.mesh
         return {
             "ok": running and alive,
             "phase": phase,
@@ -540,10 +776,12 @@ class SolverService:
             "queue_depth": depth,
             "queue_limit": self.max_queue,
             "inflight": inflight,
-            "breakers": breakers,
+            "breakers": merged,
             "open_buckets": sorted(
-                lbl for lbl, s in breakers.items() if s == _bk.BREAKER_OPEN
+                lbl for lbl, s in merged.items() if s == _bk.BREAKER_OPEN
             ),
+            "replicas": lanes,
+            "sharded": shard_lane,
             "failures_60s": len(recent),
             "failure_rate_60s": len(recent) / window_s,
             "uptime_s": now - self._t_started,
@@ -555,19 +793,21 @@ class SolverService:
 
     # -- supervision -------------------------------------------------------
 
-    def _run_worker(self) -> None:
+    def _run_worker(self, rep: _Replica) -> None:
         try:
-            self._loop()
+            self._loop(rep)
         except BaseException as e:  # noqa: BLE001 — supervise ANY death
-            self._supervise(e)
+            self._supervise(rep, e)
 
-    def _supervise(self, exc: BaseException) -> None:
-        """Worker-death containment: re-enqueue in-flight requests that
-        still have retry budget (with backoff), fail the rest fast with
-        a typed error — no future ever hangs — and respawn the worker."""
+    def _supervise(self, rep: _Replica, exc: BaseException) -> None:
+        """Worker-death containment: re-enqueue the replica's in-flight
+        requests that still have retry budget (with backoff), fail the
+        rest fast with a typed error — no future ever hangs — and
+        respawn the worker."""
         metrics.inc("serve.worker_restarts")
         with self._cond:
-            inflight, self._inflight = self._inflight, []
+            inflight, rep.inflight = rep.inflight, []
+            rep.restarts += 1
             self._restarts += 1
             respawn = self._running
         self._note_failure()
@@ -575,7 +815,7 @@ class SolverService:
             if r.future.done():
                 continue  # _execute resolved it before the death
             if respawn and r.retries > 0:
-                self._requeue_with_backoff(r)
+                self._requeue_with_backoff(rep, r)
             else:
                 # no worker will ever pop a re-enqueued request once
                 # stop() has drained the queue — fail fast instead of
@@ -586,33 +826,35 @@ class SolverService:
                     req=r,
                 )
         if respawn:
-            self._spawn_worker()
+            self._spawn_worker(rep)
 
     # -- worker ------------------------------------------------------------
 
-    def _loop(self) -> None:
+    def _loop(self, rep: _Replica) -> None:
         while True:
-            batch = self._next_batch()
+            batch = self._next_batch(rep)
             if batch is None:
                 return
             if not batch:
                 continue
             with self._cond:
-                self._inflight = batch
+                rep.inflight = batch
             faults.check("worker_death")  # in-flight: supervision must cover
-            self._execute(batch)
+            self._execute(rep, batch)
             with self._cond:
-                self._inflight = []
+                rep.inflight = []
 
-    def _pop_eligible_locked(self, now: float) -> Optional[_Request]:
+    def _pop_eligible_locked(
+        self, rep: _Replica, now: float
+    ) -> Optional[_Request]:
         """Oldest request whose retry backoff (not_before) has elapsed."""
-        for i, r in enumerate(self._q):
+        for i, r in enumerate(rep.q):
             if r.not_before <= now:
-                del self._q[i]
+                del rep.q[i]
                 return r
         return None
 
-    def _next_batch(self) -> Optional[List[_Request]]:
+    def _next_batch(self, rep: _Replica) -> Optional[List[_Request]]:
         """Pop the oldest eligible request plus every same-key eligible
         request (up to batch_max).  None => stopped; [] => only expired
         requests were popped this round."""
@@ -625,32 +867,32 @@ class SolverService:
                 # a request that is backing off (not_before in the
                 # future) must still be queued-cancelled the moment its
                 # deadline passes, not after its backoff elapses
-                if self._q:
+                if rep.q:
                     live: Deque[_Request] = deque()
-                    for r in self._q:
+                    for r in rep.q:
                         (expired if r.expired() else live).append(r)
-                    self._q = live
+                    rep.q = live
                 if expired:
                     break  # cancel outside the lock, then come back
-                first = self._pop_eligible_locked(now)
+                first = self._pop_eligible_locked(rep, now)
                 if first is not None:
                     break
-                if self._q:  # everything is backing off: sleep to the next
-                    wake = min(r.not_before for r in self._q) - now
+                if rep.q:  # everything is backing off: sleep to the next
+                    wake = min(r.not_before for r in rep.q) - now
                     self._cond.wait(min(max(wake, 0.001), 0.05))
                 else:
                     self._cond.wait(0.05)
             if not self._running:
                 # resolve anything the failure path re-enqueued after
                 # stop() drained the queue — futures must never strand
-                leftovers = list(self._q)
-                self._q.clear()
+                leftovers = list(rep.q)
+                rep.q.clear()
                 for r in leftovers:
                     _resolve_exc(
                         r.future, Rejected("service stopped"), req=r
                     )
                 return None
-            metrics.gauge("serve.queue_depth", len(self._q))
+            self._gauge_queues_locked()
         if expired:
             for r in expired:
                 self._miss_queued(r)
@@ -658,29 +900,32 @@ class SolverService:
         if first.expired():
             self._miss_queued(first)
             return []
-        if first.key is None:
+        if first.key is None or first.key.mesh:
+            # keyless requests run direct; sharded buckets never
+            # coalesce — their batch point is 1 (the mesh owns shape
+            # parallelism, replica scale-out owns throughput)
             return [first]
         if self.batch_max > 1 and self.batch_window_s > 0:
             with self._cond:
                 now = time.monotonic()
                 if not any(
                     r.key == first.key and r.not_before <= now
-                    for r in self._q
+                    for r in rep.q
                 ):
                     self._cond.wait(self.batch_window_s)
         batch = [first]
         with self._cond:
             keep: Deque[_Request] = deque()
             now = time.monotonic()
-            while self._q and len(batch) < self.batch_max:
-                r = self._q.popleft()
+            while rep.q and len(batch) < self.batch_max:
+                r = rep.q.popleft()
                 if r.key == first.key and r.not_before <= now:
                     batch.append(r)
                 else:
                     keep.append(r)
-            keep.extend(self._q)
-            self._q = keep
-            metrics.gauge("serve.queue_depth", len(self._q))
+            keep.extend(rep.q)
+            rep.q = keep
+            self._gauge_queues_locked()
         live = []
         for r in batch:
             if r.expired():
@@ -709,20 +954,22 @@ class SolverService:
 
     # -- execution ---------------------------------------------------------
 
-    def _breaker(self, key: _bk.BucketKey) -> _bk.Breaker:
-        with self._cond:  # health() iterates _breakers under the lock
-            br = self._breakers.get(key)
+    def _breaker(self, rep: _Replica, key: _bk.BucketKey) -> _bk.Breaker:
+        with self._cond:  # health() iterates breaker tables under the lock
+            br = rep.breakers.get(key)
             if br is None:
-                br = self._breakers[key] = _bk.Breaker()
+                br = rep.breakers[key] = _bk.Breaker()
         return br
 
-    def _execute(self, batch: List[_Request]) -> None:
+    def _execute(self, rep: _Replica, batch: List[_Request]) -> None:
+        rep.dispatched += len(batch)
+        metrics.inc(rep.dispatched_counter, len(batch))
         key = batch[0].key
         if key is None:
             for r in batch:
                 self._direct(r)
             return
-        br = self._breaker(key)
+        br = self._breaker(rep, key)
         if br.state == _bk.BREAKER_OPEN:
             if br.try_half_open(time.monotonic(), self.breaker_cooldown_s):
                 metrics.inc("serve.breaker_half_open")
@@ -733,16 +980,17 @@ class SolverService:
         try:
             for r in batch:
                 r.attempt += 1
-            deliver, corrupt = self._execute_batched(key, batch)
+            deliver, corrupt = self._execute_batched(rep, key, batch)
         except Exception as e:  # noqa: BLE001 — futures carry the error
             self._note_failure()
             if br.record_failure(time.monotonic(), self.degrade_after):
                 metrics.inc("serve.breaker_open")
+                metrics.inc(f"serve.replica.{rep.name}.breaker_open")
                 metrics.inc("serve.degraded")  # legacy alias: open events
             retryable = [r for r in batch if r.retries > 0]
             rest = [r for r in batch if r.retries <= 0]
             for r in reversed(retryable):
-                self._requeue_with_backoff(r)
+                self._requeue_with_backoff(rep, r)
             for r in rest:
                 self._direct(r, batched_error=e)
             return
@@ -753,19 +1001,22 @@ class SolverService:
             # returned non-finite X must re-open, not close
             if br.record_failure(time.monotonic(), self.degrade_after):
                 metrics.inc("serve.breaker_open")
+                metrics.inc(f"serve.replica.{rep.name}.breaker_open")
                 metrics.inc("serve.degraded")
         elif br.record_success():
             metrics.inc("serve.breaker_closed")  # half-open probe healed
+            metrics.inc(f"serve.replica.{rep.name}.breaker_closed")
         # resolve futures only AFTER the breaker transition committed: a
         # client that wakes from .result() must observe consistent
         # breaker metrics / health() state
         for fn in deliver:
             fn()
 
-    def _requeue_with_backoff(self, r: _Request) -> None:
+    def _requeue_with_backoff(self, rep: _Replica, r: _Request) -> None:
         """Retry with exponential backoff + decorrelated jitter instead
         of an immediate re-enqueue (which would hammer a failing path
-        in a tight loop)."""
+        in a tight loop).  The retry stays on ITS replica: the breaker
+        accounting that failed is this lane's."""
         r.retries -= 1
         r.backoff_s = decorrelated_backoff(
             self._rng, r.backoff_s, self.retry_backoff_s,
@@ -775,10 +1026,12 @@ class SolverService:
         metrics.inc("serve.retries")
         metrics.observe("serve.retry_backoff_s", r.backoff_s)
         with self._cond:
-            self._q.appendleft(r)
+            rep.q.appendleft(r)
             self._cond.notify_all()
 
-    def _execute_batched(self, key: _bk.BucketKey, batch: List[_Request]):
+    def _execute_batched(
+        self, rep: _Replica, key: _bk.BucketKey, batch: List[_Request]
+    ):
         """Run one padded batch; returns ``(deliver, corrupt)``: the
         deferred per-item delivery thunks (resolutions happen in
         _execute, after the breaker bookkeeping, so clients never
@@ -786,15 +1039,26 @@ class SolverService:
         items (a garbage batch is a breaker failure, not a success —
         nonzero ``info`` is NOT corruption: it is a numerical property
         of the input, no fault of the batched path)."""
-        self.cache.ensure_manifest(key, (1, self.batch_max))
-        bb = _bk.batch_bucket(len(batch), self.batch_max)
+        if key.mesh:
+            # sharded buckets have one batch point: the executable is
+            # the spmd program, not a vmap
+            self.cache.ensure_manifest(key, (1,))
+            bb = 1
+        else:
+            self.cache.ensure_manifest(key, (1, self.batch_max))
+            bb = _bk.batch_bucket(len(batch), self.batch_max)
         pads = [_bk.pad_request(key, r.A, r.B) for r in batch]
         while len(pads) < bb:  # repeat-pad to the fixed batch point
             pads.append(pads[0])
             metrics.inc("serve.batch_pad")
         A_b = np.stack([p[0] for p in pads])
         B_b = np.stack([p[1] for p in pads])
-        X_b, info_b = self.cache.run(key, A_b, B_b)
+        if rep.device is not None:
+            # replica pinning: the dispatch (and its per-device compiled
+            # variant) lands on this replica's device
+            X_b, info_b = self.cache.run(key, A_b, B_b, device=rep.device)
+        else:
+            X_b, info_b = self.cache.run(key, A_b, B_b)
         now = time.monotonic()
         deliver = []
         corrupt = 0

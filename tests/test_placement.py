@@ -1,0 +1,517 @@
+"""Placement tier tests: serve/placement policy, mesh-keyed buckets,
+replica scale-out, spmd routing, and the mesh-aware warmup/restore.
+
+Pure policy pieces (mesh grammar, thresholds, replica selection under
+skewed load and breaker-open exclusion) are unit-tested; the
+integration pieces run on the 8 fake CPU devices conftest forces
+(xla_force_host_platform_device_count), including the ISSUE acceptance
+stream: a warmed mixed small/large request mix dispatching across >= 2
+replicas with zero steady-state compiles per replica, large-n requests
+solved by the spmd drivers to single-device-driver parity, and
+per-replica queue depth / breaker state in ``health()``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import metrics
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.placement import PlacementPolicy
+from slate_tpu.serve.service import SolverService
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    """Placement metrics are part of the contract under test."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _gesv_problem(n, nrhs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+# ---------------------------------------------------------------------------
+# mesh grammar + mesh-keyed buckets
+# ---------------------------------------------------------------------------
+
+
+def test_parse_and_check_mesh():
+    assert bk.parse_mesh("") == (0, 0)
+    assert bk.parse_mesh("2x4") == (2, 4)
+    assert bk.check_mesh("") == ""
+    assert bk.check_mesh("2X4") == "2x4"
+    for bad in ("x", "2x", "ax4", "0x4", "2x4x2", "-1x4"):
+        with pytest.raises(ValueError):
+            bk.parse_mesh(bad)
+
+
+def test_mesh_fits():
+    assert bk.mesh_fits("", 0) and bk.mesh_fits("", 1)
+    assert bk.mesh_fits("2x4", 8)
+    assert not bk.mesh_fits("2x4", 7)
+    assert not bk.mesh_fits("4x4", 8)
+
+
+def test_bucketkey_mesh_label_and_fingerprint():
+    k0 = bk.bucket_for("gesv", 50, 50, 3, np.float64, floor=FLOOR)
+    km = bk.bucket_for("gesv", 50, 50, 3, np.float64, floor=FLOOR,
+                       mesh="2x4")
+    assert km.mesh == "2x4" and k0.mesh == ""
+    assert km.label.endswith(".mesh2x4")
+    assert ".mesh" not in k0.label
+    # the ROADMAP item 2 remnant: a sharded executable's artifact
+    # identity must NOT collide with the single-device key's
+    f0 = bk.fingerprint(bk.content_fields(k0, 1))
+    fm = bk.fingerprint(bk.content_fields(km, 1))
+    assert f0 != fm
+    # JSON round trip preserves the mesh field
+    assert bk.BucketKey.from_json(km.to_json()) == km
+
+
+def test_bucket_for_mesh_validation():
+    with pytest.raises(ValueError):  # sharded serving is full-precision
+        bk.bucket_for("gesv", 32, 32, 2, np.float64, floor=FLOOR,
+                      precision="mixed", mesh="2x2")
+    with pytest.raises(ValueError):  # gels has no sharded path
+        bk.bucket_for("gels", 64, 32, 2, np.float64, floor=FLOOR,
+                      mesh="2x2")
+
+
+def test_legacy_manifest_defaults_single_device():
+    """Manifest entries written before the mesh field must load as
+    single-device placements and re-serialize canonically (the PR 6
+    schedule/precision legacy pattern)."""
+    legacy = {
+        "routine": "gesv", "m": 16, "n": 16, "nrhs": 4,
+        "dtype": "float64", "nb": 16, "tag": "", "batch": 1,
+        "schedule": "flat", "precision": "full",
+    }  # no "mesh": a pre-placement writer
+    text = json.dumps({"version": 1, "entries": [legacy]})
+    [(key, batch)] = bk.manifest_loads(text)
+    assert key.mesh == ""
+    canon = json.loads(bk.manifest_dumps([(key, batch)]))
+    [entry] = canon["entries"]
+    assert entry["mesh"] == ""  # present + canonical on re-serialize
+    assert bk.manifest_loads(json.dumps(canon)) == [(key, batch)]
+
+
+# ---------------------------------------------------------------------------
+# placement policy (pure decision logic)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_for_threshold_and_overrides():
+    pol = PlacementPolicy(replicas=2, mesh="2x2", shard_threshold=100,
+                          devices=[None] * 4)
+    assert pol.mesh_for("gesv", 99) == ""  # below threshold: replicated
+    assert pol.mesh_for("gesv", 100) == "2x2"  # at threshold: sharded
+    assert pol.mesh_for("posv", 4096) == "2x2"
+    assert pol.mesh_for("gels", 4096) == ""  # no sharded gels
+    assert pol.mesh_for("gesv", 8, sharded=True) == "2x2"  # explicit
+    assert pol.mesh_for("gesv", 4096, sharded=False) == ""  # forced off
+    # no mesh configured: nothing routes sharded
+    off = PlacementPolicy(replicas=2, shard_threshold=100)
+    assert off.mesh_for("gesv", 4096) == ""
+    assert off.mesh_for("gesv", 4096, sharded=True) == ""
+    # threshold 0 disables size routing but keeps the explicit override
+    explicit = PlacementPolicy(mesh="2x2", shard_threshold=0,
+                               devices=[None] * 4)
+    assert explicit.mesh_for("gesv", 1 << 20) == ""
+    assert explicit.mesh_for("gesv", 8, sharded=True) == "2x2"
+
+
+def test_select_replica_least_loaded_under_skew():
+    pol = PlacementPolicy(replicas=4, devices=[None] * 4)
+    # replica 2 is idle while the others are backed up
+    assert pol.select_replica([5, 3, 0, 7]) == 2
+    # repeated skewed selection keeps picking the least loaded
+    assert pol.select_replica([5, 3, 1, 0]) == 3
+
+
+def test_select_replica_breaker_exclusion():
+    pol = PlacementPolicy(replicas=3, devices=[None] * 3)
+    # the least-loaded replica's breaker is open: next healthy one wins
+    assert pol.select_replica([0, 4, 2], [True, False, False]) == 2
+    # ALL open: degrade to least-loaded overall (the per-replica
+    # breaker still routes its requests direct downstream)
+    assert pol.select_replica([3, 1, 2], [True, True, True]) == 1
+
+
+def test_select_replica_round_robin_ties():
+    pol = PlacementPolicy(replicas=3, devices=[None] * 3)
+    picks = [pol.select_replica([0, 0, 0]) for _ in range(6)]
+    # equal load: ties rotate instead of replica 0 absorbing everything
+    assert sorted(set(picks)) == [0, 1, 2]
+    rr = PlacementPolicy(replicas=3, strategy="round_robin",
+                         devices=[None] * 3)
+    assert [rr.select_replica([9, 0, 0]) for _ in range(3)] == [0, 1, 2]
+    with pytest.raises(ValueError):
+        PlacementPolicy(strategy="typo")
+
+
+def test_replica_pinning_avoids_mesh_slice():
+    """With enough devices, replica pinning starts past the first P*Q
+    devices the spmd submesh binds — the two tiers stop contending
+    while spare chips idle; a small pool overlaps instead of failing."""
+    devs = list(range(8))  # device_for only indexes the pool
+    pol = PlacementPolicy(replicas=3, mesh="2x2", devices=devs)
+    assert [pol.device_for(i) for i in range(3)] == [4, 5, 6]
+    small = PlacementPolicy(replicas=3, mesh="2x2", devices=devs[:4])
+    assert [small.device_for(i) for i in range(3)] == [0, 1, 2]
+    nomesh = PlacementPolicy(replicas=3, devices=devs)
+    assert [nomesh.device_for(i) for i in range(3)] == [0, 1, 2]
+
+
+def test_configure_replicas_shorthand():
+    """serve.configure(replicas=N) must actually produce N replica
+    lanes — the shorthand routes into the policy, not into a dead
+    SolverService argument."""
+    from slate_tpu.serve import api
+
+    svc = api.configure(replicas=3, start=False)
+    try:
+        assert svc.placement.replicas == 3
+        assert len(svc._replicas) == 3
+    finally:
+        api.shutdown()
+
+
+def test_policy_from_options_and_devices():
+    from slate_tpu.enums import Option
+
+    pol = PlacementPolicy.from_options({
+        Option.ServeReplicas: 3, Option.ServeMesh: "2x2",
+        Option.ServeShardThreshold: 128,
+    })
+    assert (pol.replicas, pol.mesh, pol.shard_threshold) == (3, "2x2", 128)
+    # default policy: single replica, no mesh, no device resolution
+    dflt = PlacementPolicy.from_options(None)
+    assert dflt.replicas == 1 and dflt.mesh == ""
+    assert dflt.device_for(0) is None  # single replica never pins
+
+
+# ---------------------------------------------------------------------------
+# mesh-aware warmup / restore
+# ---------------------------------------------------------------------------
+
+
+def test_restore_skips_unfit_mesh(tmp_path):
+    """A manifest entry whose mesh needs more devices than this process
+    has is skipped (counted), never crashed on — a 1-device replica
+    restoring a fleet manifest warms only what it can run."""
+    key = bk.bucket_for("gesv", 32, 32, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR, mesh="4x4")  # needs 16 > 8
+    man = tmp_path / "warmup.json"
+    man.write_text(bk.manifest_dumps([(key, 1)]) + "\n")
+    cache = ExecutableCache(manifest_path=str(man))
+    with metrics.deltas() as d:
+        out = cache.restore(batch_max=4)
+    assert out["entries"] == 0 and out.get("mesh_unfit") == 1
+    assert d.get("serve.mesh_unfit_skipped") == 1
+    assert cache.warmup(batch_max=4) == 0  # warmup shares the filter
+
+
+def test_warmup_primes_every_replica_device(devices):
+    """After a device-aware warmup, dispatches on EVERY warmed device
+    are compile-free — the multi-replica steady-state contract."""
+    cache = ExecutableCache(manifest_path=None)
+    key = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    cache.ensure_manifest(key, (1,))
+    devs = [devices[0], devices[1]]
+    cache.warmup(batch_max=1, devices=devs)
+    A, B = _gesv_problem(12, seed=3)
+    Ap, Bp = bk.pad_request(key, A, B)
+    with metrics.deltas() as d:
+        for dev in devs:
+            X, info = cache.run(key, Ap[None], Bp[None], device=dev)
+            assert int(info[0]) == 0
+            assert np.abs(A @ X[0][:12, :2] - B).max() < 1e-9
+        assert d.get("jit.compilations") == 0, (
+            "warmed replica devices must not compile on dispatch"
+        )
+    # an UNwarmed device still pays its compile (the gauge of why
+    # warmup takes the device list at all)
+    with metrics.deltas() as d:
+        cache.run(key, Ap[None], Bp[None], device=devices[2])
+        assert d.get("jit.compilations") == 1
+
+
+# ---------------------------------------------------------------------------
+# service integration on the 8 fake devices
+# ---------------------------------------------------------------------------
+
+
+def _placement_service(shared_cache, **kw):
+    cfg = dict(
+        cache=shared_cache, batch_max=4, batch_window_s=0.002,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+        placement=PlacementPolicy(replicas=3, mesh="2x2",
+                                  shard_threshold=40),
+    )
+    cfg.update(kw)
+    return SolverService(**cfg)
+
+
+def test_mixed_stream_dispatches_replicated_and_sharded(shared_cache):
+    """The ISSUE acceptance stream: a warmed mixed small/large mix
+    dispatches across >= 2 replicas (per-replica counters prove it),
+    large-n requests route to the spmd drivers with single-device
+    parity, steady state stays compile-free per replica, and health()
+    exposes per-replica queue depth + breaker state."""
+    svc = _placement_service(shared_cache)
+    n_small, n_large = 12, 50  # 50 >= threshold 40 -> bucket 64, sharded
+    key_s = bk.bucket_for("gesv", n_small, n_small, 2, np.float64,
+                          floor=FLOOR, nrhs_floor=NRHS_FLOOR)
+    key_l = bk.bucket_for("gesv", n_large, n_large, 2, np.float64,
+                          floor=FLOOR, nrhs_floor=NRHS_FLOOR, mesh="2x2")
+    shared_cache.ensure_manifest(key_s, (1, 4))
+    shared_cache.ensure_manifest(key_l, (1,))
+    svc.warmup()  # primes every replica device + the spmd executable
+    problems = [
+        _gesv_problem(n_small, seed=i) for i in range(18)
+    ] + [_gesv_problem(n_large, seed=100 + i) for i in range(2)]
+    with metrics.deltas() as d:
+        futs = [svc.submit("gesv", A, B) for A, B in problems]
+        for (A, B), f in zip(problems, futs):
+            X = f.result(timeout=600)
+            # parity with the single-device answer, replicated AND
+            # sharded alike
+            assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+        assert d.get("jit.compilations") == 0, (
+            "warmed mixed stream must be compile-free on every replica "
+            f"(saw {d.get('jit.compilations')})"
+        )
+        assert d.get("serve.routed_sharded") == 2
+        assert d.get("serve.replicated_dispatch") == 18
+        busy = [
+            i for i in range(3)
+            if d.get(f"serve.replica.{i}.dispatched") > 0
+        ]
+        assert len(busy) >= 2, (
+            "scale-out must spread the stream across replicas: "
+            f"only replicas {busy} dispatched"
+        )
+        assert d.get("serve.replica.sharded.dispatched") == 2
+    h = svc.health()
+    assert [r["name"] for r in h["replicas"]] == ["0", "1", "2"]
+    for r in h["replicas"]:
+        assert r["queue_depth"] == 0 and isinstance(r["breakers"], dict)
+        assert r["worker_alive"]
+    assert h["sharded"]["mesh"] == "2x2"
+    assert h["sharded"]["dispatched"] >= 2
+    # per-replica queue-depth gauges exist (placement_report's rows)
+    g = metrics.gauges()
+    assert "serve.replica.0.queue_depth" in g
+    assert "serve.replica.sharded.queue_depth" in g
+    svc.stop()
+
+
+def test_sharded_posv_parity(shared_cache):
+    svc = _placement_service(shared_cache)
+    rng = np.random.default_rng(5)
+    n = 20
+    G = rng.standard_normal((n, n))
+    S = G @ G.T + n * np.eye(n)
+    B = rng.standard_normal((n, 2))
+    with metrics.deltas() as d:
+        X = svc.submit("posv", S, B, sharded=True).result(timeout=600)
+        assert d.get("serve.routed_sharded") == 1
+    assert np.abs(X - np.linalg.solve(S, B)).max() < 1e-8
+    svc.stop()
+
+
+def test_sharded_override_validation(shared_cache):
+    svc = _placement_service(shared_cache)
+    A, B = _gesv_problem(12, seed=9)
+    with pytest.raises(ValueError):  # explicitly sharded AND mixed
+        svc.submit("gesv", A, B, sharded=True, precision="mixed")
+    svc.stop()
+    # a mixed SERVICE default must not break the sharded API: an
+    # explicit sharded=True (no per-request precision) demotes the
+    # inherited default and serves full-precision on the mesh
+    svc_mixed = _placement_service(shared_cache, precision="mixed")
+    with metrics.deltas() as d:
+        X = svc_mixed.submit("gesv", A, B, sharded=True).result(timeout=600)
+        assert d.get("serve.routed_sharded") == 1
+    assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+    svc_mixed.stop()
+    # no mesh configured: explicit sharded must fail loudly
+    svc2 = SolverService(cache=shared_cache, batch_max=4, dim_floor=FLOOR,
+                         nrhs_floor=NRHS_FLOOR)
+    with pytest.raises(ValueError):
+        svc2.submit("gesv", A, B, sharded=True)
+    svc2.stop()
+
+
+def test_breaker_open_replica_excluded_at_admission(shared_cache):
+    """Admission steers a bucket's traffic away from a replica whose
+    breaker for that bucket is open — the sick lane sheds load to its
+    peers instead of routing every request direct — but only while the
+    cooldown runs: once it elapses the lane is selectable again, so
+    the half-open probe (driven by traffic reaching the lane) can
+    actually fire and heal it."""
+    import time as _time
+
+    svc = SolverService(
+        cache=shared_cache, batch_max=4, dim_floor=FLOOR,
+        nrhs_floor=NRHS_FLOOR, breaker_cooldown_s=60.0,
+        placement=PlacementPolicy(replicas=2),
+        start=False,  # paused: requests stay queued for inspection
+    )
+    A, B = _gesv_problem(12, seed=11)
+    key = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    br = bk.Breaker()
+    br.record_failure(_time.monotonic(), 1)  # open replica 0's breaker
+    assert br.state == bk.BREAKER_OPEN
+    svc._replicas[0].breakers[key] = br
+    for _ in range(3):
+        svc.submit("gesv", A, B)
+    assert len(svc._replicas[0].q) == 0
+    assert len(svc._replicas[1].q) == 3
+    # health surfaces the per-replica breaker state
+    h = svc.health()
+    assert h["replicas"][0]["breakers"][key.label] == bk.BREAKER_OPEN
+    assert h["breakers"][key.label] == bk.BREAKER_OPEN  # legacy merge
+    # "elapse" the cooldown: the still-open lane must become selectable
+    # again (it is now also the least loaded), or no probe could ever
+    # reach it and the breaker would stay open forever
+    br.opened_at -= 61.0
+    svc.submit("gesv", A, B)
+    assert len(svc._replicas[0].q) == 1
+    svc.stop()
+
+
+def test_sharded_artifact_roundtrip_mesh_keyed(tmp_path):
+    """A mesh-sharded bucket executable round-trips through the
+    artifact store under its mesh-shape-keyed fingerprint: the entry
+    takes the counted cache_seed rung (serialized shard_map programs
+    are not trusted across processes), its header carries the mesh
+    field, and it shares nothing — path or fingerprint — with the
+    single-device key (the ROADMAP item 2 remnant closed)."""
+    from slate_tpu.serve.artifacts import ArtifactStore
+
+    cache = ExecutableCache(manifest_path=None,
+                            artifact_dir=str(tmp_path))
+    key = bk.bucket_for("gesv", 20, 20, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR, mesh="2x2")
+    k0 = bk.bucket_for("gesv", 20, 20, 2, np.float64, floor=FLOOR,
+                       nrhs_floor=NRHS_FLOOR)
+    store = cache.artifacts
+    assert store.path_for(key, 1) != store.path_for(k0, 1)
+    A, B = _gesv_problem(20, seed=21)
+    Ap, Bp = bk.pad_request(key, A, B)
+    with metrics.deltas() as d:
+        X, info = cache.run(key, Ap[None], Bp[None])
+        assert d.get("serve.artifact_saved_cache_seed") == 1
+        assert d.get("serve.artifact_saved_export") == 0
+    assert np.abs(A @ X[0][:20, :2] - B).max() < 1e-8
+    [entry] = [e for e in store.entries() if "error" not in e]
+    assert entry["mode"] == "cache_seed"
+    assert entry["fields"]["mesh"] == "2x2"
+    assert any("sharded-mesh" in t for t in entry.get("nonportable", ()))
+    # a fresh store (new replica) finds + verifies the keyed entry:
+    # counted cache_seed, never a silent miss or a single-device
+    # collision
+    fresh = ArtifactStore(str(tmp_path))
+    with metrics.deltas() as d:
+        assert fresh.load(key, 1) is None  # recompile rung, XLA-cached
+        assert d.get(f"serve.artifact.{key.label}.b1.cache_seed") == 1
+        assert fresh.load(k0, 1) is None
+        assert d.get(f"serve.artifact.{k0.label}.b1.miss") == 1
+    assert fresh.verified_cache_seed(key, 1)
+
+
+def test_cold_build_single_flight(monkeypatch):
+    """A same-bucket burst spread across replica workers must compile
+    the executable ONCE per process — the lanes that lose the race
+    wait for the winner's build instead of paying their own
+    trace+compile (seconds to minutes per f64 shape)."""
+    import threading
+    import time as _time
+
+    from slate_tpu.serve import cache as cache_mod
+
+    builds = []
+    orig = cache_mod._build_core
+
+    def counting(key):
+        builds.append(key)
+        _time.sleep(0.05)  # widen the race window
+        return orig(key)
+
+    monkeypatch.setattr(cache_mod, "_build_core", counting)
+    cache = ExecutableCache(manifest_path=None)
+    key = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    A, B = _gesv_problem(12, seed=17)
+    Ap, Bp = bk.pad_request(key, A, B)
+    errs = []
+
+    def hit():
+        try:
+            X, info = cache.run(key, Ap[None], Bp[None])
+            assert int(info[0]) == 0
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=hit) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+    assert len(builds) == 1, f"expected one cold build, got {len(builds)}"
+
+
+def test_unfit_mesh_fails_fast_at_construction(shared_cache):
+    """A configured mesh the device pool cannot realize must fail at
+    construction, not downgrade every sharded request to a
+    breaker-tripping direct fallback."""
+    from slate_tpu.exceptions import DistributedException
+
+    with pytest.raises(DistributedException):
+        SolverService(
+            cache=shared_cache,
+            placement=PlacementPolicy(mesh="4x4"),  # needs 16 > 8
+            start=False,
+        )
+
+
+def test_single_replica_service_unchanged(shared_cache):
+    """The default policy (1 replica, no mesh) is the pre-placement
+    service: everything lands on replica 0, nothing routes sharded,
+    and the legacy health keys keep their shapes."""
+    svc = SolverService(cache=shared_cache, batch_max=4,
+                        batch_window_s=0.002, dim_floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    A, B = _gesv_problem(12, seed=13)
+    with metrics.deltas() as d:
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        assert np.abs(A @ X - B).max() < 1e-9
+        assert d.get("serve.routed_sharded") == 0
+        assert d.get("serve.replica.0.dispatched") == 1
+    h = svc.health()
+    assert len(h["replicas"]) == 1 and h["sharded"] is None
+    assert h["ok"] and h["queue_depth"] == 0
+    svc.stop()

@@ -36,6 +36,17 @@ _ROW_RE = re.compile(
     r"\.(?P<outcome>" + "|".join(OUTCOMES) + r")$"
 )
 
+#: placement suffix of a bucket label (buckets.BucketKey.label appends
+#: ``.meshPxQ`` for spmd-sharded executables — those entries always
+#: take the cache_seed rung, keyed by their mesh shape)
+_MESH_RE = re.compile(r"\.mesh(\d+x\d+)$")
+
+
+def bucket_mesh(bucket):
+    """The mesh column of one bucket label: "-" = single device."""
+    m = _MESH_RE.search(bucket)
+    return m.group(1) if m else "-"
+
 #: fault site -> the detection counter that must absorb every injection
 SITE_DETECTORS = {
     "artifact_corrupt": "serve.artifact_corrupt",
@@ -76,15 +87,14 @@ def main(argv=None):
         rows[key][m.group("outcome")] += int(value)
 
     if rows:
-        hdr = (f"{'bucket':44} {'batch':>5} " + " ".join(
+        hdr = (f"{'bucket':44} {'batch':>5} {'mesh':>6} " + " ".join(
             f"{o:>10}" for o in OUTCOMES
         ))
         print(hdr)
         print("-" * len(hdr))
         for (bucket, batch), r in sorted(rows.items()):
-            print(f"{bucket:44} {batch:5d} " + " ".join(
-                f"{r[o]:10d}" for o in OUTCOMES
-            ))
+            print(f"{bucket:44} {batch:5d} {bucket_mesh(bucket):>6} "
+                  + " ".join(f"{r[o]:10d}" for o in OUTCOMES))
     else:
         print("(no serve.artifact.* counters in this JSONL — was "
               "SLATE_TPU_ARTIFACTS set?)")

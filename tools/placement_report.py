@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Per-replica placement table from a metrics JSONL.
+
+    python tools/placement_report.py out.jsonl [--min-requests 8]
+
+Rows come from the placement-tier metrics the SolverService emits
+(slate_tpu/serve/service.py): ``serve.replica.<name>.dispatched``
+counters (requests each replica lane executed — the sharded lane is
+``serve.replica.sharded.*``), ``serve.replica.<name>.queue_depth``
+gauges (last snapshot), and the per-replica breaker transition
+counters ``serve.replica.<name>.breaker_open`` / ``breaker_closed``.
+The routing split (``serve.replicated_dispatch`` vs
+``serve.routed_sharded``) prints underneath.
+
+Exit status is the **scale-out verdict**: once the replicated tier has
+seen at least ``--min-requests`` dispatches, a *starved* replica — one
+that dispatched nothing while its peers worked — exits nonzero.  A
+starved replica means the placement policy is not spreading load
+(mis-selected strategy, a wedged worker, or a breaker stuck open), so
+the ``run_tests.py --sharded`` gate fails on it.
+
+Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
+serving workload (examples/ex20_sharded_serving.py shows the loop).
+"""
+
+import argparse
+import json
+import re
+import sys
+
+_REPLICA_RE = re.compile(
+    r"^serve\.replica\.(?P<name>[^.]+)\.(?P<field>dispatched|queue_depth"
+    r"|breaker_open|breaker_closed)$"
+)
+
+
+def load_records(path):
+    counters, gauges = {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            # cumulative snapshots: last value wins (same semantics as
+            # chaos_report/artifact_report — summing re-dumped JSONLs
+            # would inflate)
+            if r.get("type") == "counter":
+                counters[r["name"]] = r.get("value", 0)
+            elif r.get("type") == "gauge":
+                gauges[r["name"]] = r.get("value", 0)
+    return counters, gauges
+
+
+def replica_rows(counters, gauges):
+    rows = {}
+    for src in (counters, gauges):
+        for name, value in src.items():
+            m = _REPLICA_RE.match(name)
+            if not m:
+                continue
+            row = rows.setdefault(m.group("name"), {
+                "dispatched": 0, "queue_depth": 0,
+                "breaker_open": 0, "breaker_closed": 0,
+            })
+            row[m.group("field")] = int(value)
+    return rows
+
+
+def _order(name):
+    # numeric replicas first (in order), the sharded lane last
+    return (0, int(name)) if name.isdigit() else (1, 0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="placement_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS output)")
+    ap.add_argument("--min-requests", type=int, default=8,
+                    help="replicated dispatches before the starvation "
+                         "verdict applies (default 8)")
+    args = ap.parse_args(argv)
+
+    counters, gauges = load_records(args.jsonl)
+    rows = replica_rows(counters, gauges)
+    if not rows:
+        print("(no serve.replica.* metrics in this JSONL — did the "
+              "stream go through a SolverService?)")
+        return 0
+
+    hdr = (f"{'replica':>8} {'dispatched':>11} {'queue_depth':>12} "
+           f"{'breaker_open':>13} {'breaker_closed':>15}")
+    print(hdr)
+    print("-" * len(hdr))
+    for name in sorted(rows, key=_order):
+        r = rows[name]
+        print(f"{name:>8} {r['dispatched']:11d} {r['queue_depth']:12d} "
+              f"{r['breaker_open']:13d} {r['breaker_closed']:15d}")
+
+    replicated = int(counters.get("serve.replicated_dispatch", 0))
+    sharded = int(counters.get("serve.routed_sharded", 0))
+    print(f"\nrouting: {replicated} replicated, {sharded} sharded "
+          f"(serve.replicated_dispatch / serve.routed_sharded)")
+
+    # the scale-out verdict: a replica lane that dispatched nothing
+    # while the tier worked is starved
+    lanes = {n: r for n, r in rows.items() if n.isdigit()}
+    total = sum(r["dispatched"] for r in lanes.values())
+    rc = 0
+    if len(lanes) > 1 and total >= args.min_requests:
+        starved = sorted(
+            (n for n, r in lanes.items() if r["dispatched"] == 0),
+            key=_order,
+        )
+        if starved:
+            print(f"FAIL: replica(s) {', '.join(starved)} starved — "
+                  f"{total} dispatches never reached them (placement "
+                  "not spreading load)")
+            rc = 1
+        else:
+            print(f"scale-out ok: all {len(lanes)} replicas dispatched")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

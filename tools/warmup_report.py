@@ -4,18 +4,22 @@
     python tools/warmup_report.py out.jsonl [--manifest warmup.json]
 
 Rows come from the
-``serve.<routine>.<MxNxR>.<dtype>[.tag][.schedule][.precision].b<batch>``
+``serve.<routine>.<MxNxR>.<dtype>[.tag][.schedule][.precision][.meshPxQ].b<batch>``
 compile/run timers that the serving cache's instrumented executables
-record (slate_tpu/serve/cache.py) — the ``schedule`` (PR3) and
-``precision`` (PR5) BucketKey fields are part of the bucket label
-(omitted at their defaults "auto"/"full") and get their own columns
-here.  With ``--manifest`` the table is joined against the warmup
-manifest so buckets that were never compiled in this JSONL (stale
-manifest entries) and compiles missing from the manifest (warmup gap —
-the next cold start pays them) are both flagged; manifest entries that
-predate the schedule/precision fields are flagged ``legacy(...)`` —
-they load with the documented defaults and re-serialize canonically on
-the next manifest flush.
+record (slate_tpu/serve/cache.py) — the ``schedule`` (PR3),
+``precision`` (PR5) and ``mesh`` placement (PR8) BucketKey fields are
+part of the bucket label (omitted at their defaults
+"auto"/"full"/single-device) and get their own columns here; the mesh
+column prints ``-`` for single-device buckets and ``PxQ`` for
+executables traced through the spmd drivers on that submesh.  With
+``--manifest`` the table is joined against the warmup manifest so
+buckets that were never compiled in this JSONL (stale manifest
+entries) and compiles missing from the manifest (warmup gap — the
+next cold start pays them) are both flagged; manifest entries that
+predate the schedule/precision/mesh fields are flagged ``legacy(...)``
+— they load with the documented defaults (mesh-less entries load as
+single-device) and re-serialize canonically on the next manifest
+flush.
 
 Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
 serving workload (examples/ex16_serving.py shows the whole loop).
@@ -31,9 +35,11 @@ _BUCKET_RE = re.compile(
 )
 
 #: non-default label suffixes (buckets.BucketKey.label appends schedule
-#: when != "auto" and precision when != "full", in that order)
+#: when != "auto", precision when != "full", and meshPxQ when sharded,
+#: in that order)
 _SCHEDULES = ("flat", "recursive")
 _PRECISIONS = ("mixed",)
+_MESH_RE = re.compile(r"^mesh(\d+x\d+)$")
 
 
 def load_jsonl(path):
@@ -47,17 +53,22 @@ def load_jsonl(path):
 
 
 def split_label(bucket):
-    """(schedule, precision) parsed off a bucket label's tail — the
-    JSONL-only fallback when no manifest is given (a tag that collides
-    with a schedule/precision literal is misread here; the manifest
-    join is the ground truth)."""
+    """(schedule, precision, mesh) parsed off a bucket label's tail —
+    the JSONL-only fallback when no manifest is given (a tag that
+    collides with a schedule/precision/mesh literal is misread here;
+    the manifest join is the ground truth)."""
     parts = bucket.split(".")
-    schedule, precision = "auto", "full"
+    schedule, precision, mesh = "auto", "full", ""
+    if parts:
+        m = _MESH_RE.match(parts[-1])
+        if m:
+            mesh = m.group(1)
+            parts.pop()
     if parts and parts[-1] in _PRECISIONS:
         precision = parts.pop()
     if parts and parts[-1] in _SCHEDULES:
         schedule = parts.pop()
-    return schedule, precision
+    return schedule, precision, mesh
 
 
 def bucket_rows(records):
@@ -83,17 +94,21 @@ def bucket_rows(records):
 
 
 def manifest_index(path):
-    """{(bucket_label, batch): {"schedule", "precision", "legacy"}} —
-    ``legacy`` lists the BucketKey fields this entry's manifest JSON
-    omitted (pre-PR3 ``schedule`` / pre-PR5 ``precision`` writers), so
-    defaulted entries are visibly flagged rather than silently joined."""
+    """{(bucket_label, batch): {"schedule", "precision", "mesh",
+    "legacy"}} — ``legacy`` lists the BucketKey fields this entry's
+    manifest JSON omitted (pre-PR3 ``schedule`` / pre-PR5 ``precision``
+    / pre-PR8 ``mesh`` writers), so defaulted entries — mesh-less ones
+    load as single-device — are visibly flagged rather than silently
+    joined."""
     with open(path) as f:
         doc = json.load(f)
     idx = {}
     for e in doc.get("entries", []):
-        legacy = [k for k in ("schedule", "precision") if k not in e]
+        legacy = [k for k in ("schedule", "precision", "mesh")
+                  if k not in e]
         schedule = str(e.get("schedule", "auto"))
         precision = str(e.get("precision", "full"))
+        mesh = str(e.get("mesh", ""))
         bucket = f"{e['routine']}.{e['m']}x{e['n']}x{e['nrhs']}.{e['dtype']}"
         if e.get("tag"):
             bucket += f".{e['tag']}"
@@ -102,8 +117,11 @@ def manifest_index(path):
             bucket += f".{schedule}"
         if precision != "full":
             bucket += f".{precision}"
+        if mesh:
+            bucket += f".mesh{mesh}"
         idx[(bucket, int(e.get("batch", 1)))] = {
-            "schedule": schedule, "precision": precision, "legacy": legacy,
+            "schedule": schedule, "precision": precision, "mesh": mesh,
+            "legacy": legacy,
         }
     return idx
 
@@ -125,7 +143,7 @@ def main(argv=None):
         return 0
 
     hdr = (f"{'bucket':44} {'batch':>5} {'schedule':>9} {'precision':>9} "
-           f"{'compiles':>8} {'compile(s)':>11} {'runs':>6} "
+           f"{'mesh':>6} {'compiles':>8} {'compile(s)':>11} {'runs':>6} "
            f"{'mean_run(ms)':>13} {'note':>16}")
     print(hdr)
     print("-" * len(hdr))
@@ -136,8 +154,10 @@ def main(argv=None):
         mentry = midx.get(key) if midx is not None else None
         if mentry is not None:
             schedule, precision = mentry["schedule"], mentry["precision"]
+            mesh = mentry["mesh"]
         else:
-            schedule, precision = split_label(bucket)
+            schedule, precision, mesh = split_label(bucket)
+        mesh_col = mesh or "-"  # "-" = single-device placement
         notes = []
         if midx is not None:
             if mentry is None:
@@ -148,19 +168,20 @@ def main(argv=None):
                 legacy_total += 1
                 notes.append(
                     "legacy(%s)" % (
-                        "both" if len(mentry["legacy"]) == 2
-                        else mentry["legacy"][0]
+                        "all" if len(mentry["legacy"]) == 3
+                        else "+".join(mentry["legacy"])
                     )
                 )
         note = ",".join(notes)
         if row is None:
             print(f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
-                  f"{0:8d} {'-':>11} {0:6d} {'-':>13} {note:>16}")
+                  f"{mesh_col:>6} {0:8d} {'-':>11} {0:6d} {'-':>13} "
+                  f"{note:>16}")
             continue
         mean_run = (row["run_s"] / row["runs"] * 1e3) if row["runs"] else 0.0
         print(
             f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
-            f"{row['compiles']:8d} {row['compile_s']:11.2f} "
+            f"{mesh_col:>6} {row['compiles']:8d} {row['compile_s']:11.2f} "
             f"{row['runs']:6d} {mean_run:13.2f} {note:>16}"
         )
     total_c = sum(r["compile_s"] for r in rows.values())
@@ -170,8 +191,9 @@ def main(argv=None):
     if legacy_total:
         print(f"{legacy_total} manifest entr"
               f"{'y' if legacy_total == 1 else 'ies'} predate the "
-              "schedule/precision fields (defaulted to auto/full); "
-              "re-save the manifest to upgrade in place")
+              "schedule/precision/mesh fields (defaulted to "
+              "auto/full/single-device); re-save the manifest to "
+              "upgrade in place")
     return 0
 
 
