@@ -489,26 +489,103 @@ def main(argv=None):
             svc.warmup()  # compile-free stream: rates measure dispatch
             c0 = _m.counters().get("serve.replicated_dispatch", 0)
             t0 = time.perf_counter()
-            futs = [
-                svc.submit("gesv", *probs[i % len(probs)])
-                for i in range(reqs)
-            ]
-            for f in futs:
-                assert np.all(np.isfinite(f.result(timeout=600)))
+            with _m.deltas() as d:
+                futs = [
+                    svc.submit("gesv", *probs[i % len(probs)])
+                    for i in range(reqs)
+                ]
+                for f in futs:
+                    assert np.all(np.isfinite(f.result(timeout=600)))
             dt = time.perf_counter() - t0
             svc.stop()
             rates[nrep_i] = reqs / dt
-            out[f"replicas_{nrep_i}"] = {
+            rep = {
                 "requests_per_s": round(reqs / dt, 1),
                 "seconds": round(dt, 3),
                 "replicated_dispatch": int(
                     _m.counters().get("serve.replicated_dispatch", 0) - c0
                 ),
             }
+            # tail latency alongside throughput (BENCH_r06+ tracks the
+            # p99 curve, not just requests/s): the serve.latency
+            # histograms windowed to this config's stream
+            lat = d.hist(f"serve.latency.{key.label}.total")
+            if lat:
+                rep.update(
+                    p50_ms=round(lat["p50"] * 1e3, 2),
+                    p95_ms=round(lat["p95"] * 1e3, 2),
+                    p99_ms=round(lat["p99"] * 1e3, 2),
+                )
+            out[f"replicas_{nrep_i}"] = rep
         out["scaling_x"] = round(rates[nrep] / max(rates[1], 1e-9), 2)
         return out
 
     run_entry("serve_scaling", entry_serve_scaling)
+
+    # -- serving tail latency: one warmed replica, a mixed small/large
+    # stream, and the queued/execute/total percentile split per bucket
+    # (the SLO surface; tools/latency_report.py renders the same table
+    # from a SLATE_TPU_METRICS JSONL) --------------------------------
+    def entry_serve_latency():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.serve import buckets as _bk
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.service import SolverService
+
+        nsm = 256 if on_tpu else 24
+        nlg = 512 if on_tpu else 48
+        reqs = 64
+
+        def prob(n, seed):
+            r = np.random.default_rng(seed)
+            return (r.standard_normal((n, n)) + n * np.eye(n),
+                    r.standard_normal((n, 4)))
+
+        probs = [prob(nsm, i) for i in range(6)] + [
+            prob(nlg, 100 + i) for i in range(2)
+        ]
+        svc = SolverService(
+            cache=ExecutableCache(manifest_path=None), batch_max=8,
+            batch_window_s=0.001, dim_floor=16, nrhs_floor=4,
+        )
+        keys = {
+            n: _bk.bucket_for("gesv", n, n, 4, np.float64,
+                              floor=16, nrhs_floor=4)
+            for n in (nsm, nlg)
+        }
+        for k in keys.values():
+            svc.cache.ensure_manifest(k, (1, 8))
+        svc.warmup()
+        t0 = time.perf_counter()
+        with _m.deltas() as d:
+            futs = [
+                # 3:1 small:large mix, interleaved so buckets contend
+                svc.submit("gesv", *probs[i % len(probs)])
+                for i in range(reqs)
+            ]
+            for f in futs:
+                assert np.all(np.isfinite(f.result(timeout=600)))
+        dt = time.perf_counter() - t0
+        svc.stop()
+        out = {"requests": reqs,
+               "requests_per_s": round(reqs / dt, 1),
+               "seconds": round(dt, 3)}
+        for n, k in keys.items():
+            row = {}
+            for split in ("queued", "execute", "total"):
+                h = d.hist(f"serve.latency.{k.label}.{split}")
+                if h:
+                    row[split] = {
+                        "p50_ms": round(h["p50"] * 1e3, 2),
+                        "p95_ms": round(h["p95"] * 1e3, 2),
+                        "p99_ms": round(h["p99"] * 1e3, 2),
+                    }
+            row["count"] = (d.hist(f"serve.latency.{k.label}.total")
+                            or {}).get("count", 0)
+            out[f"n{n}"] = row
+        return out
+
+    run_entry("serve_latency", entry_serve_latency)
 
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96

@@ -1,0 +1,103 @@
+"""ex21: request-lifecycle tracing, latency histograms, SLO health.
+
+Runs a warmed serve stream under fault injection with the span layer
+on, then answers the question counters cannot: *where did the slow
+request's time go?* (README "Tracing & latency"):
+
+  1. every request gets a trace id and an admit -> deliver span chain
+  2. a retried request's trace carries a `backoff` span whose interval
+     IS the decorrelated-jitter delay it sat out
+  3. the Chrome export (Perfetto / chrome://tracing) has one lane per
+     replica/worker; no delivered request is an orphan
+  4. per-bucket p50/p95/p99 with the queued-vs-execute split comes
+     from the metrics histograms, and health() surfaces the SLO view
+"""
+
+import json
+
+from _common import np
+
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+metrics.on()
+spans.on(ring=8192)  # flight recorder: the production spelling is
+#                      SLATE_TPU_TRACE_RING=8192 in the environment
+
+rng = np.random.default_rng(21)
+n = 24
+mk = lambda i: rng.standard_normal((n, n)) + (n + i) * np.eye(n)
+rhs = lambda: rng.standard_normal((n, 2))
+
+svc = SolverService(
+    cache=ExecutableCache(manifest_path=None), batch_max=4,
+    batch_window_s=0.002, dim_floor=32, retry_backoff_s=0.01,
+    breaker_cooldown_s=0.05, retry_seed=21,
+)
+key = bk.bucket_for("gesv", n, n, 2, np.float64, floor=32)
+svc.cache.ensure_manifest(key, (1, 4))
+svc.warmup()  # warmed first: an execute fault during warmup would
+#               (correctly) fail the precompile
+
+# -- faulty stream, traced ------------------------------------------------
+faults.arm("execute", every=5)  # every 5th dispatch fails -> retries
+faults.arm("latency", p=0.3, ms=4, seed=21)
+faults.on()
+
+futs = [svc.submit("gesv", mk(i), rhs(), deadline=120.0, retries=2)
+        for i in range(20)]
+for f in futs:
+    X = f.result(timeout=300)
+    assert np.all(np.isfinite(X))
+faults.reset()
+
+# -- the retry span: the ISSUE assertion ----------------------------------
+back = [s for s in spans.snapshot() if s.name == "backoff"]
+assert back, "execute faults fired but no backoff span was recorded"
+sp = back[0]
+assert sp.trace is not None and sp.attrs["backoff_s"] > 0
+assert abs(sp.dur_s - sp.attrs["backoff_s"]) < 1e-3
+chain = {s.name for s in spans.by_trace()[sp.trace]}
+assert {"request", "admit", "queued", "execute", "backoff"} <= chain
+print(f"retry span: trace {sp.trace} sat out "
+      f"{sp.attrs['backoff_s'] * 1e3:.1f} ms of backoff "
+      f"(chain: {', '.join(sorted(chain))})")
+
+# -- Chrome export: complete chains, no orphans ---------------------------
+path = spans.export_chrome("/tmp/slate_tpu_ex21_trace.json")
+data = json.load(open(path))
+traces = {}
+for e in data["traceEvents"]:
+    tr = e.get("args", {}).get("trace")
+    if tr:
+        traces.setdefault(tr, set()).add(e["name"])
+delivered = 0
+for tr, names in traces.items():
+    assert "request" in names, f"orphan trace {tr}"
+    if "execute" in names or "direct" in names:
+        delivered += 1
+assert delivered >= 20
+lanes = sorted(e["args"]["name"] for e in data["traceEvents"]
+               if e.get("ph") == "M")
+print(f"chrome export: {path} — {len(traces)} traces, 0 orphans, "
+      f"lanes {lanes} (open in https://ui.perfetto.dev)")
+
+# -- the latency split + SLO surface --------------------------------------
+h = svc.health()
+lbl = key.label
+lat = h["latency"][lbl]
+qh = metrics.hist_summary(f"serve.latency.{lbl}.queued")
+xh = metrics.hist_summary(f"serve.latency.{lbl}.execute")
+print(f"latency {lbl}: total p50/p95/p99 = "
+      f"{lat['p50'] * 1e3:.1f}/{lat['p95'] * 1e3:.1f}/"
+      f"{lat['p99'] * 1e3:.1f} ms over {lat['count']} requests "
+      f"(queued p99 {qh['p99'] * 1e3:.1f} ms, "
+      f"execute p99 {xh['p99'] * 1e3:.1f} ms)")
+print(f"slo burn: {h['slo_burn']} — oldest queued now "
+      f"{h['replicas'][0]['oldest_queued_s']:.3f}s")
+assert lat["count"] == 20 and h["slo_burn"]["requests"] == 20
+
+svc.stop()
+print("ex21: tracing ok")

@@ -378,6 +378,131 @@ def sharded() -> int:
             pass
 
 
+# Env-activated tracing+latency stream for the --latency gate:
+# SLATE_TPU_METRICS + SLATE_TPU_TRACE_RING are read at import (the
+# production activation path); faults are armed AFTER warmup (an
+# execute fault during warmup would fail the precompile by design).
+# The driver asserts the ISSUE acceptance inline: every delivered
+# request's trace is a complete admit -> deliver span chain in the
+# Chrome export, and a retried request carries a backoff span.
+_LATENCY_DRIVER = """
+import json
+import sys
+import numpy as np
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.exceptions import SlateError
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+trace_path = sys.argv[1]
+assert spans.is_on() and spans.capacity() >= 4096  # env armed the ring
+svc = SolverService(cache=ExecutableCache(manifest_path=None), batch_max=4,
+                    batch_window_s=0.002, dim_floor=16, nrhs_floor=4,
+                    retry_backoff_s=0.002, breaker_cooldown_s=0.05,
+                    retry_seed=0)
+k1 = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=16, nrhs_floor=4)
+k2 = bk.bucket_for("posv", 24, 24, 2, np.float64, floor=16, nrhs_floor=4)
+svc.cache.ensure_manifest(k1, (1, 4))
+svc.cache.ensure_manifest(k2, (1, 4))
+svc.warmup()  # warmed: the latency split measures serving, not compiles
+# latency+execute injection (ISSUE acceptance): every=6 is
+# deterministic — at least one batch fails and retries with backoff
+faults.configure("execute:every=6;latency:p=0.3,ms=5,seed=5")
+faults.on()
+
+def prob(rt, n, seed):
+    r = np.random.default_rng(seed)
+    A = r.standard_normal((n, n))
+    A = A @ A.T + n * np.eye(n) if rt == "posv" else A + n * np.eye(n)
+    return rt, A, r.standard_normal((n, 2))
+
+probs = [prob("gesv", 12, i) for i in range(16)] + [
+    prob("posv", 24, 100 + i) for i in range(8)]
+futs = [svc.submit(rt, A, B, deadline=120.0, retries=3)
+        for rt, A, B in probs]
+ok = typed = 0
+for f in futs:
+    try:
+        X = f.result(timeout=300)
+        assert np.all(np.isfinite(X))
+        ok += 1
+    except SlateError:
+        typed += 1  # retry budget exhausted into a faulted direct path
+assert ok + typed == len(futs), "a future hung"
+assert ok >= len(futs) - 4, f"too many failures: {ok}/{len(futs)}"
+faults.reset()
+svc.stop()
+spans.export_chrome(trace_path)
+
+data = json.load(open(trace_path))
+evs = [e for e in data["traceEvents"] if e.get("ph") in ("X", "i")]
+traces = {}
+for e in evs:
+    tr = e.get("args", {}).get("trace")
+    if tr:
+        traces.setdefault(tr, {}).setdefault(e["name"], []).append(e)
+roots = {tr: t["request"][0] for tr, t in traces.items() if "request" in t}
+orphans = sorted(tr for tr in traces if tr not in roots)
+assert not orphans, f"orphan traces (no request root): {orphans}"
+delivered = {tr: r for tr, r in roots.items()
+             if r["args"].get("outcome") == "ok"}
+assert len(delivered) == ok, (len(delivered), ok)
+for tr in delivered:
+    names = set(traces[tr])
+    assert "admit" in names and "queued" in names, (tr, names)
+    assert "execute" in names or "direct" in names, (tr, names)
+retried = [tr for tr in traces if "backoff" in traces[tr]]
+assert retried, "execute faults fired but no backoff span recorded"
+h = svc.health()
+assert h["latency"], "health() must surface per-bucket percentiles"
+print(f"latency driver: {ok} delivered, {typed} typed, "
+      f"{len(delivered)} complete span chains, {len(retried)} retried "
+      f"with backoff spans, 0 orphans")
+"""
+
+
+def latency_gate() -> int:
+    """Latency/tracing gate, three legs: (1) the span + histogram
+    suites; (2) an env-activated warmed serve stream under
+    latency+execute fault injection (SLATE_TPU_METRICS +
+    SLATE_TPU_TRACE_RING, the production activation path) that exports
+    a Chrome trace and asserts every delivered request has a complete
+    admit -> deliver span chain; (3) tools/latency_report.py over the
+    stream's JSONL — per-bucket p50/p95/p99 with the queued-vs-execute
+    split, failing past the p99 budget."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_spans.py",
+         "tests/test_metrics.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_latency_") as td:
+        jsonl = os.path.join(td, "latency.jsonl")
+        trace_json = os.path.join(td, "trace.json")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
+            SLATE_TPU_TRACE_RING="8192",
+        )
+        env.pop("SLATE_TPU_FAULTS", None)  # the driver arms post-warmup
+        rc = subprocess.call(
+            [sys.executable, "-c", _LATENCY_DRIVER, trace_json],
+            env=env, cwd=here,
+        )
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "latency_report.py"),
+             jsonl, "--p99-budget", "30"],
+            cwd=here,
+        )
+
+
 # Restart-drill drivers for the --coldstart gate.  Each runs in its OWN
 # subprocess so the restore leg is a true fresh interpreter: nothing
 # carries over but the artifact dir + manifest on disk.
@@ -577,6 +702,10 @@ def main() -> int:
                     help="run the placement suite (replica scale-out + "
                          "spmd routing on a forced 8-device CPU mesh) + "
                          "the placement_report starvation gate")
+    ap.add_argument("--latency", action="store_true",
+                    help="run the span/histogram suites + a traced "
+                         "faulty serve stream (Chrome-export chain "
+                         "check) + the latency_report p99 gate")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -597,6 +726,8 @@ def main() -> int:
         return coldstart()
     if args.sharded:
         return sharded()
+    if args.latency:
+        return latency_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -1,11 +1,15 @@
 """Auxiliary subsystems (reference: src/auxiliary/ — Trace, Debug).
 
-- aux.trace: RAII phase tracing + SVG timeline + jax.profiler hook.
-- aux.metrics: counters/gauges/timers registry, compile-vs-execute
-  split, cost_analysis FLOP attribution, JSONL export
-  (SLATE_TPU_METRICS=/path/out.jsonl).
+- aux.trace: RAII phase tracing + SVG/Chrome timeline + jax.profiler
+  hook.
+- aux.metrics: counters/gauges/timers/histograms registry,
+  compile-vs-execute split, cost_analysis FLOP attribution, JSONL
+  export (SLATE_TPU_METRICS=/path/out.jsonl).
+- aux.spans: request-scoped span tracer — trace ids, parent/child
+  spans, bounded ring-buffer flight recorder
+  (SLATE_TPU_TRACE_RING=N), Chrome trace-event export for Perfetto.
 - aux.faults: deterministic seedable fault injection over named sites
   in the serve/driver dispatch path (SLATE_TPU_FAULTS spec).
 """
 
-from . import faults, metrics, trace  # noqa: F401
+from . import faults, metrics, spans, trace  # noqa: F401

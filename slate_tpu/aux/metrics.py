@@ -37,10 +37,23 @@ Activation::
     metrics.dump("out.jsonl")
 
 JSONL schema (one object per line): ``{"type": "meta"|"event"|
-"counter"|"gauge"|"timer"|"cost", ...}``; events carry ``name``,
+"counter"|"gauge"|"timer"|"hist"|"cost", ...}``; events carry ``name``,
 ``kind`` ("phase"|"compile"|"run"), ``t_start`` (seconds since the
 metrics epoch), ``dur_s``, ``thread``, and the active :func:`context`
-label.  Counters/gauges/timers are the end-of-run summaries.
+label.  Counters/gauges/timers/histograms are the end-of-run
+summaries; ``hist`` lines carry count/min/max/p50/p95/p99 plus the
+nonzero ``[le, count]`` bucket rows on the fixed log lattice
+(:data:`HIST_EDGES`), so ``tools/latency_report.py`` re-ranks any
+percentile from one dump.
+
+Tail latency lives in :class:`Histogram` (:func:`observe_hist`,
+:func:`percentile`): fixed log-spaced buckets, so p50/p95/p99 of every
+driver phase (``kind="driver"`` phases feed a same-named histogram
+automatically) and of the serve queued/execute/total split
+(``serve.latency.*``, see serve/service.py) are one call away — means
+hide the p99, and Clipper-style SLOs are stated in percentiles.
+Per-request timelines are ``aux/spans`` (trace ids + Chrome export);
+metric events mirror onto its ring when both layers are on.
 
 The containment layers report through this registry too: serve/ emits
 ``serve.worker_restarts``, ``serve.breaker_open/half_open/closed``,
@@ -61,11 +74,13 @@ from __future__ import annotations
 import atexit
 import functools
 import json
+import math
 import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from . import spans as _spans
 from . import trace as _trace
 
 _enabled = False
@@ -76,6 +91,7 @@ _counters: Dict[str, float] = {}
 _gauges: Dict[str, float] = {}
 # name -> [count, total_s, min_s, max_s]
 _timers: Dict[str, List[float]] = {}
+_hists: Dict[str, "Histogram"] = {}
 _events: List[dict] = []
 _costs: Dict[str, dict] = {}
 _context = threading.local()
@@ -114,6 +130,7 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _timers.clear()
+        _hists.clear()
         _events.clear()
         _costs.clear()
         _dropped_events = 0
@@ -156,6 +173,157 @@ def observe(name: str, seconds: float) -> None:
             t[3] = max(t[3], seconds)
 
 
+# -- histograms (fixed log-spaced buckets; the tail-latency primitive) ------
+
+#: bucket lattice: 10 buckets per decade from 1 µs to 1000 s.  FIXED for
+#: every histogram so JSONL dumps from different runs/replicas merge
+#: bucket-by-bucket (the Prometheus argument), and recording is one
+#: log10 + one list increment — no per-observation allocation.
+HIST_PER_DECADE = 10
+HIST_LO_S = 1e-6
+HIST_EDGES = tuple(
+    HIST_LO_S * 10.0 ** (i / HIST_PER_DECADE)
+    for i in range(9 * HIST_PER_DECADE + 1)
+)
+
+
+class Histogram:
+    """Fixed-bucket log-spaced histogram of seconds.  Bucket 0 is the
+    underflow (< ``HIST_LO_S``), bucket ``i`` covers
+    ``[EDGES[i-1], EDGES[i])``, the last bucket is the overflow.
+    ``percentile`` interpolates geometrically inside the winning bucket
+    and clamps to the observed min/max, so p50/p95/p99 are accurate to
+    one bucket ratio (~26%) worst-case, exact at the extremes."""
+
+    __slots__ = ("counts", "count", "total", "min", "max")
+
+    def __init__(self):
+        self.counts = [0] * (len(HIST_EDGES) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        v = max(float(seconds), 0.0)
+        if v < HIST_LO_S:
+            i = 0
+        else:
+            i = min(
+                int(math.log10(v / HIST_LO_S) * HIST_PER_DECADE) + 1,
+                len(HIST_EDGES),
+            )
+        self.counts[i] += 1
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    @staticmethod
+    def percentile_from(counts, p: float, lo: Optional[float] = None,
+                        hi: Optional[float] = None) -> Optional[float]:
+        """p-th percentile (0..100) from a bucket-count list laid out on
+        ``HIST_EDGES`` (the shared static so :class:`deltas` and
+        tools/latency_report.py rank windows/dumps the same way)."""
+        total = sum(counts)
+        if total <= 0:
+            return None
+        rank = max(1, math.ceil(p / 100.0 * total))
+        cum = 0
+        for i, k in enumerate(counts):
+            cum += k
+            if cum >= rank:
+                if i == 0:
+                    # underflow bucket: the observed min (when known) is
+                    # strictly better than the lattice floor
+                    est = lo if lo is not None else HIST_LO_S
+                elif i >= len(HIST_EDGES):
+                    est = hi if hi is not None else HIST_EDGES[-1]
+                else:
+                    b_lo, b_hi = HIST_EDGES[i - 1], HIST_EDGES[i]
+                    frac = (rank - (cum - k)) / max(k, 1)
+                    est = b_lo * (b_hi / b_lo) ** frac
+                if lo is not None:
+                    est = max(est, lo)
+                if hi is not None:
+                    est = min(est, hi)
+                return est
+        return None  # unreachable: cum == total >= rank
+
+    def percentile(self, p: float) -> Optional[float]:
+        return self.percentile_from(
+            self.counts, p,
+            lo=(self.min if self.count else None),
+            hi=(self.max if self.count else None),
+        )
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "min_s": round(self.min, 6) if self.count else 0.0,
+            "max_s": round(self.max, 6),
+            "p50": round(self.percentile(50) or 0.0, 6),
+            "p95": round(self.percentile(95) or 0.0, 6),
+            "p99": round(self.percentile(99) or 0.0, 6),
+        }
+
+    def bucket_rows(self) -> List[list]:
+        """Nonzero ``[le, count]`` rows (le = bucket upper edge;
+        ``"inf"`` for the overflow bucket) — the JSONL wire form."""
+        rows = []
+        for i, k in enumerate(self.counts):
+            if not k:
+                continue
+            le = (
+                "inf" if i >= len(HIST_EDGES)
+                else float(f"{HIST_EDGES[min(i, len(HIST_EDGES) - 1)]:.9g}")
+            )
+            rows.append([le, k])
+        return rows
+
+
+def observe_hist(name: str, seconds: float) -> None:
+    """Record one duration into the named histogram (log-spaced fixed
+    buckets).  One bool check when metrics are off."""
+    if not _enabled:
+        return
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = Histogram()
+        h.observe(seconds)
+
+
+def percentile(name: str, p: float) -> Optional[float]:
+    """p-th percentile (0..100) of a histogram; None when absent."""
+    with _lock:
+        h = _hists.get(name)
+        return h.percentile(p) if h is not None else None
+
+
+def hist_summary(name: str) -> Optional[dict]:
+    """count/total/min/max/p50/p95/p99 of one histogram (None if
+    absent) — what ``health()`` surfaces per bucket."""
+    with _lock:
+        h = _hists.get(name)
+        return h.summary() if h is not None and h.count else None
+
+
+def histograms() -> Dict[str, dict]:
+    with _lock:
+        return {k: h.summary() for k, h in _hists.items() if h.count}
+
+
+def _hist_counts() -> Dict[str, tuple]:
+    """Raw (counts, count, total) snapshot — the deltas window state."""
+    with _lock:
+        return {
+            k: (tuple(h.counts), h.count, h.total)
+            for k, h in _hists.items()
+        }
+
+
 def _emit_event(name: str, start: float, stop: float, kind: str,
                 extra: Optional[dict] = None) -> None:
     """Append a timeline event (and mirror it onto trace's timeline so
@@ -182,6 +350,11 @@ def _emit_event(name: str, start: float, stop: float, kind: str,
         with _trace._lock:
             _trace._events.append(_trace.Event(
                 name, start, stop, threading.get_ident()))
+    if _spans.is_on():
+        # one flight recorder: metric events (driver phases, per-bucket
+        # compile/run dispatches) land on the span ring so a Chrome
+        # export shows them in the same lanes as the request spans
+        _spans.record(name, start, stop, kind=kind)
 
 
 class phase:
@@ -203,24 +376,33 @@ class phase:
         self._start = 0.0
 
     def __enter__(self):
-        if _enabled or self.always or _trace.is_on():
+        if _enabled or self.always or _trace.is_on() or _spans.is_on():
             self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         # _start == 0.0 means nothing was armed at __enter__ (also guards
-        # against metrics/trace flipping on mid-block)
-        if self._start == 0.0 or not (_enabled or self.always or _trace.is_on()):
+        # against metrics/trace/spans flipping on mid-block)
+        if self._start == 0.0 or not (
+            _enabled or self.always or _trace.is_on() or _spans.is_on()
+        ):
             return False
         stop = time.perf_counter()
         self.seconds = stop - self._start
         if _enabled:
             observe(self.name, self.seconds)
+            if self.kind == "driver":
+                # per-driver latency distribution: the factor/solve
+                # histograms percentile() and the latency report read
+                observe_hist(self.name, self.seconds)
             _emit_event(self.name, self._start, stop, self.kind)
-        elif _trace.is_on():
+            return False
+        if _trace.is_on():
             with _trace._lock:
                 _trace._events.append(_trace.Event(
                     self.name, self._start, stop, threading.get_ident()))
+        if _spans.is_on():
+            _spans.record(self.name, self._start, stop, kind=self.kind)
         return False
 
 
@@ -254,6 +436,7 @@ class deltas:
 
     def __enter__(self):
         self._before = counters()
+        self._hbefore = _hist_counts()
         return self
 
     def __exit__(self, *exc):
@@ -261,6 +444,31 @@ class deltas:
 
     def get(self, name: str) -> float:
         return counters().get(name, 0) - self._before.get(name, 0)
+
+    def hist(self, name: str) -> Optional[dict]:
+        """Windowed histogram stats: count/total/p50/p95/p99 over the
+        observations recorded since __enter__ (bucket-count deltas —
+        bench entries report per-entry tail latency without a global
+        reset).  None when nothing landed in the window."""
+        cur = _hist_counts().get(name)
+        if cur is None:
+            return None
+        before = self._hbefore.get(name)
+        if before is None:
+            counts = list(cur[0])
+            dc, dt = cur[1], cur[2]
+        else:
+            counts = [a - b for a, b in zip(cur[0], before[0])]
+            dc, dt = cur[1] - before[1], cur[2] - before[2]
+        if dc <= 0:
+            return None
+        return {
+            "count": dc,
+            "total_s": round(dt, 6),
+            "p50": round(Histogram.percentile_from(counts, 50) or 0.0, 6),
+            "p95": round(Histogram.percentile_from(counts, 95) or 0.0, 6),
+            "p99": round(Histogram.percentile_from(counts, 99) or 0.0, 6),
+        }
 
     def all(self) -> Dict[str, float]:
         now = counters()
@@ -279,7 +487,7 @@ def instrumented(name: str) -> Callable:
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kw):
-            if not _enabled and not _trace.is_on():
+            if not _enabled and not _trace.is_on() and not _spans.is_on():
                 return fn(*args, **kw)
             import jax
 
@@ -532,6 +740,7 @@ def summary() -> dict:
                 for kk, vv in v.items()}
             for k, v in timers().items()
         },
+        "histograms": histograms(),
         "costs": costs(),
     }
 
@@ -543,6 +752,7 @@ def report() -> str:
         tsnap = {k: list(v) for k, v in _timers.items()}
         csnap = dict(_counters)
         costsnap = {k: dict(v) for k, v in _costs.items()}
+        hsnap = {k: h.summary() for k, h in _hists.items() if h.count}
     lines = []
     if tsnap:
         hdr = (f"{'timer':40} {'count':>6} {'total(s)':>10} {'mean(s)':>10} "
@@ -568,6 +778,18 @@ def report() -> str:
                 f"{name:40} {int(cnt):6d} {total:10.4f} "
                 f"{total / max(cnt, 1):10.4f} {mx:10.4f} {gf:>9}"
             )
+    if hsnap:
+        lines.append("")
+        hdr = (f"{'histogram':44} {'count':>6} {'p50(s)':>10} "
+               f"{'p95(s)':>10} {'p99(s)':>10} {'max(s)':>10}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for name in sorted(hsnap):
+            h = hsnap[name]
+            lines.append(
+                f"{name:44} {h['count']:6d} {h['p50']:10.4f} "
+                f"{h['p95']:10.4f} {h['p99']:10.4f} {h['max_s']:10.4f}"
+            )
     if csnap:
         lines.append("")
         lines.append(f"{'counter':50} {'value':>12}")
@@ -592,6 +814,10 @@ def dump(path: Optional[str] = None) -> Optional[str]:
         csnap = dict(_counters)
         gsnap = dict(_gauges)
         tsnap = {k: list(v) for k, v in _timers.items()}
+        hsnap = {
+            k: (h.summary(), h.bucket_rows())
+            for k, h in _hists.items() if h.count
+        }
         costsnap = {k: dict(v) for k, v in _costs.items()}
         dropped = _dropped_events
     with open(path, "w") as f:
@@ -616,6 +842,11 @@ def dump(path: Optional[str] = None) -> Optional[str]:
                 "type": "timer", "name": name, "count": int(cnt),
                 "total_s": round(total, 6), "min_s": round(mn, 6),
                 "max_s": round(mx, 6),
+            }) + "\n")
+        for name in sorted(hsnap):
+            summ, buckets = hsnap[name]
+            f.write(json.dumps({
+                "type": "hist", "name": name, **summ, "buckets": buckets,
             }) + "\n")
         for name in sorted(costsnap):
             f.write(json.dumps(

@@ -13,12 +13,21 @@ reference's static `Trace::on_`.
     trace.on()
     with trace.Block("potrf"):
         L, info = st.potrf(A)
-    trace.finish("trace.svg")          # writes the SVG timeline
+    trace.finish("trace.json")         # Chrome trace-event JSON
+    trace.finish("trace.svg")          # legacy SVG timeline
 
     with trace.xla_profile("/tmp/prof"):   # jax.profiler device trace
         ...
 
 Drivers annotated with @trace.traced("name") record automatically.
+
+The documented output is now the **Chrome trace-event JSON** (load in
+Perfetto / chrome://tracing — one lane per thread/replica, zoomable,
+with span attrs): ``finish()`` defaults to it, and ``Block``/``traced``
+mirror every interval onto the ``aux/spans`` ring buffer whenever that
+layer is on, so driver phases and request-lifecycle spans share one
+flight recorder.  A ``.svg`` path keeps the legacy self-contained SVG
+renderer.
 """
 
 from __future__ import annotations
@@ -30,6 +39,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import List, Optional
 
+from . import spans as _spans
+
 _enabled = False
 _events: List["Event"] = []
 _lock = threading.Lock()
@@ -38,6 +49,17 @@ _t0: Optional[float] = None
 
 @dataclass
 class Event:
+    """One traced interval on the legacy flat event list.
+
+    .. deprecated:: PR 9
+        The unbounded ``trace._events`` list is superseded by the
+        ``aux/spans`` ring buffer (bounded, trace-id aware, Chrome
+        exportable).  ``Block``/``traced`` already mirror onto it;
+        new code should read ``spans.snapshot()`` instead of
+        ``trace._events``, which is kept only for the SVG renderer
+        and back-compat consumers.
+    """
+
     name: str
     start: float
     stop: float
@@ -78,16 +100,23 @@ class Block:
         self._start = 0.0
 
     def __enter__(self):
-        if _enabled:
+        if _enabled or _spans.is_on():
             self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._start == 0.0:
+            return False
+        stop = time.perf_counter()
         if _enabled:
-            stop = time.perf_counter()
             ev = Event(self.name, self._start, stop, threading.get_ident())
             with _lock:
                 _events.append(ev)
+        if _spans.is_on():
+            # unified recorder: trace blocks are spans too, so one
+            # export_chrome() carries driver phases AND request spans
+            _spans.record(self.name, self._start, stop)
+        self._start = 0.0
         return False
 
 
@@ -98,7 +127,7 @@ def traced(name: str):
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kw):
-            if not _enabled:
+            if not _enabled and not _spans.is_on():
                 return fn(*args, **kw)
             with Block(name):
                 return fn(*args, **kw)
@@ -127,12 +156,20 @@ _PALETTE = [
 ]
 
 
-def finish(path: str = "trace.svg", width: int = 1200) -> str:
-    """Write the recorded events as an SVG timeline (reference:
-    Trace::finish, Trace.cc:330-370: one row per thread, legend below).
-    Returns the path; clears nothing (call clear() to reset)."""
+def finish(path: str = "trace.json", width: int = 1200) -> str:
+    """Write the recorded timeline and return the path (clears nothing;
+    call clear() to reset).
+
+    The default (any non-``.svg`` path) is **Chrome trace-event JSON**:
+    the legacy event list and the ``aux/spans`` ring are merged into
+    one ``traceEvents`` array — load it in Perfetto /
+    chrome://tracing.  A path ending in ``.svg`` keeps the reference's
+    self-contained SVG renderer (Trace::finish, Trace.cc:330-370: one
+    row per thread, legend below) over the legacy event list only."""
     with _lock:
         events = list(_events)
+    if not path.endswith(".svg"):
+        return _spans.export_chrome(path, extra=events)
     if not events:
         open(path, "w").write("<svg xmlns='http://www.w3.org/2000/svg'/>")
         return path

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..aux import faults, metrics
+from ..aux import faults, metrics, spans
 from ..aux.metrics import instrumented
 from ..enums import Option, RefineMethod
 from ..matrix.matrix import HermitianMatrix, Matrix
@@ -70,6 +70,18 @@ from ..refine import policy as _policy
 
 
 def _record(routine: str, iters: int, converged: bool, berr: float) -> None:
+    if spans.is_on():
+        # per-request tracing: the iteration count rides on the span
+        # the caller is inside (a user's spans.span block, or the serve
+        # `direct` span — context-managed exactly so this annotation
+        # reaches it); with no enclosing span, a `refine` instant still
+        # puts "IR took 9 sweeps here" on the flight recorder
+        if spans.current() is not None:
+            spans.annotate(refine_iters=int(iters),
+                           refine_converged=bool(converged))
+        else:
+            spans.event("refine", routine=routine, refine_iters=int(iters),
+                        refine_converged=bool(converged))
     if not metrics.is_on():
         return
     for name in ("refine", f"refine.{routine}"):
@@ -83,6 +95,11 @@ def _record(routine: str, iters: int, converged: bool, berr: float) -> None:
 def _record_fallback(routine: str) -> None:
     metrics.inc("refine.fallbacks")
     metrics.inc(f"refine.{routine}.fallbacks")
+    if spans.is_on():
+        if spans.current() is not None:
+            spans.annotate(refine_fallback=True)
+        else:
+            spans.event("refine_fallback", routine=routine)
 
 
 # ---------------------------------------------------------------------------

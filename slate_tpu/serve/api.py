@@ -204,8 +204,10 @@ def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray
 def health() -> dict:
     """Liveness/readiness snapshot of the process service for external
     probes: queue depth, worker liveness + restarts, per-bucket circuit
-    breaker states, recent failure rate (see
-    :meth:`SolverService.health`)."""
+    breaker states, recent failure rate, per-replica oldest-queued-age,
+    and — with metrics on — the SLO surface: per-bucket p50/p95/p99
+    total latency under ``"latency"`` and the deadline-budget burn
+    tiers under ``"slo_burn"`` (see :meth:`SolverService.health`)."""
     return get_service().health()
 
 

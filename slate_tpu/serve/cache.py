@@ -36,7 +36,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..aux import faults, metrics
+from ..aux import faults, metrics, spans
 from ..exceptions import NumericalError
 from .artifacts import ArtifactStore, store_from_env
 from .buckets import BucketKey, manifest_dumps, manifest_loads, mesh_fits
@@ -345,6 +345,19 @@ class ExecutableCache:
         """The build half of :meth:`executable` — runs OUTSIDE the
         cache lock (compiles are seconds-to-minutes) under the
         single-flight guard the caller holds."""
+        sp = spans.start("build", bucket=key.label, batch=batch) \
+            if spans.is_on() else None
+        try:
+            exe, origin = self._build_inner(key, batch, name)
+        except BaseException as e:
+            spans.end(sp, outcome=type(e).__name__)
+            raise
+        # origin annotates whether a mid-traffic cold build actually
+        # compiled or came from the artifact store
+        spans.end(sp, outcome="ok", origin=origin)
+        return exe
+
+    def _build_inner(self, key: BucketKey, batch: int, name: str):
         import jax
 
         origin = "compile"
@@ -409,7 +422,7 @@ class ExecutableCache:
                 self._origin[(key, batch)] = origin
             exe = prev
         self._record(key, batch)
-        return exe
+        return exe, origin
 
     def run(
         self,
@@ -547,6 +560,8 @@ class ExecutableCache:
                 yield key, batch, "skipped", None
                 continue
             t0 = time.perf_counter()
+            sp = spans.start(tag, lane=tag, bucket=key.label, batch=batch) \
+                if spans.is_on() else None
             try:
                 A, B = _warm_inputs(key, batch)
                 for d in (need or want):
@@ -558,6 +573,7 @@ class ExecutableCache:
                     else:
                         self.run(key, A, B, device=d)
             except Exception as e:  # noqa: BLE001 — policy decides
+                spans.end(sp, outcome="failed", error=type(e).__name__)
                 if on_error is None:
                     raise
                 on_error(key, batch, e)
@@ -576,6 +592,9 @@ class ExecutableCache:
                 primes = max(0, len(need or want) - 1)
             if primes:
                 metrics.inc("serve.device_primes", primes)
+            # the artifact-restore outcome rides on the entry's span:
+            # restored-vs-compiled-vs-skipped is THE cold-start question
+            spans.end(sp, outcome=outcome, origin=origin, primes=primes)
             if verbose:
                 extra = f" +{primes} device prime(s)" if primes else ""
                 print(

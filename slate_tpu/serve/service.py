@@ -95,10 +95,25 @@ counters, ``serve.replica.<i>.dispatched``, ``serve.batched``,
 ``serve.numerical_errors``, ``serve.corrupt_result``; per-bucket
 compile/run split via the cache's instrumented executables;
 ``faults.injected.<site>`` from aux/faults when chaos is on.
+
+Latency observability (this file is where the split is measured):
+``serve.latency.<bucket>.queued`` / ``.execute`` / ``.total``
+histograms per bucket label plus ``serve.latency.replica.<i>.total``
+per lane (``metrics.observe_hist``, log-spaced fixed buckets —
+``tools/latency_report.py`` renders the percentile table), the
+``serve.replica.<i>.oldest_queued_s`` head-of-line age gauge, and the
+``serve.slo_burn.{requests,over_50,over_80,exhausted}`` deadline-budget
+burn tiers.  With ``aux/spans`` on (``SLATE_TPU_TRACE_RING=N``) every
+request carries a trace id and records the full lifecycle span chain
+(``request`` -> ``admit``/``queued``/``coalesce``/``execute`` |
+``direct``/``backoff`` + breaker instants) into the bounded ring;
+``spans.export_chrome(path)`` renders one Perfetto lane per
+replica/worker.  All of it is one branch per call site when off.
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import random
 import threading
@@ -110,7 +125,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from ..aux import faults, metrics
+from ..aux import faults, metrics, spans
 from ..exceptions import InvalidInput, NumericalError, SlateError
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
@@ -166,6 +181,11 @@ class _Request:
     backoff_s: float = 0.0  # last backoff delay (decorrelated jitter state)
     not_before: float = 0.0  # monotonic eligibility time after a retry
     t_submit: float = field(default_factory=time.monotonic)
+    # request-scoped tracing (aux/spans; all None when tracing is off):
+    # trace id, root "request" span (admit -> deliver), live "queued" span
+    trace: Optional[str] = None
+    span: Optional[spans.Span] = None
+    qspan: Optional[spans.Span] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (
@@ -193,6 +213,9 @@ class _Replica:
         # under the service condition lock on every admission/pop
         self.q_gauge = f"serve.replica.{name}.queue_depth"
         self.dispatched_counter = f"serve.replica.{name}.dispatched"
+        self.oldest_gauge = f"serve.replica.{name}.oldest_queued_s"
+        self.lat_hist = f"serve.latency.replica.{name}.total"
+        self.lane = f"replica-{name}"  # span lane label (one Perfetto row)
 
     def alive(self) -> bool:
         return bool(self.thread is not None and self.thread.is_alive())
@@ -386,6 +409,9 @@ class SolverService:
         )
         self._restarts = 0
         self._recent_fail: Deque[float] = deque(maxlen=256)
+        # latency-histogram labels this service has dispatched (the SLO
+        # surface health() reports percentiles for)
+        self._seen_labels: set = set()
         self._t_started = time.monotonic()
         if start:
             self.start()
@@ -406,10 +432,21 @@ class SolverService:
 
     def _gauge_queues_locked(self) -> int:
         total = 0
+        mon = metrics.is_on()
+        now = time.monotonic() if mon else 0.0
         for rep in self._lanes:
             d = len(rep.q)
             total += d
             metrics.gauge(rep.q_gauge, d)
+            if mon:
+                # age of the oldest queued request: queue depth alone
+                # hides a stuck head-of-line request (satellite fix) —
+                # t_submit is monotonic per request, min() is O(depth)
+                # over a bounded queue
+                metrics.gauge(
+                    rep.oldest_gauge,
+                    (now - min(r.t_submit for r in rep.q)) if rep.q else 0.0,
+                )
         metrics.gauge("serve.queue_depth", total)
         return total
 
@@ -596,7 +633,44 @@ class SolverService:
         by size (``shard_threshold``).  Raises :class:`Rejected` when
         the queue is full and :class:`InvalidInput` on non-finite
         operands (before any queue/compile cost; disable with
-        ``validate=False``)."""
+        ``validate=False``).
+
+        With ``aux/spans`` on (``SLATE_TPU_TRACE_RING``), the request
+        gets a trace id and a root ``request`` span spanning admit ->
+        deliver, with ``admit``/``queued``/``coalesce``/``execute`` |
+        ``direct``/``backoff`` children and breaker instants — one
+        complete chain per delivered request in the Chrome export."""
+        if not spans.is_on():
+            return self._submit(routine, A, B, deadline, retries,
+                                precision, sharded)
+        tr = spans.new_trace()
+        root = spans.start("request", trace=tr, lane="client",
+                           routine=routine)
+        admit = spans.start("admit", trace=tr, parent=root, lane="client")
+        try:
+            fut = self._submit(routine, A, B, deadline, retries,
+                               precision, sharded, _trace=tr, _root=root)
+        except BaseException as e:
+            # admission rejected this request (Rejected/InvalidInput/
+            # shape errors): the chain closes here, outcome on both
+            spans.end(admit, outcome=type(e).__name__)
+            spans.end(root, outcome=type(e).__name__)
+            raise
+        spans.end(admit, outcome="enqueued")
+        return fut
+
+    def _submit(
+        self,
+        routine: str,
+        A,
+        B,
+        deadline: Optional[float] = None,
+        retries: int = 0,
+        precision: Optional[str] = None,
+        sharded: Optional[bool] = None,
+        _trace: Optional[str] = None,
+        _root: Optional[spans.Span] = None,
+    ) -> Future:
         A = np.asarray(A)
         B = np.asarray(B)
         if B.ndim == 1:
@@ -657,7 +731,14 @@ class SolverService:
                 time.monotonic() + deadline if deadline is not None else None
             ),
             retries=int(retries),
+            trace=_trace, span=_root,
         )
+        if _root is not None:
+            spans.annotate(
+                _root,
+                bucket=key.label if key is not None else None,
+                sharded=bool(key is not None and key.mesh),
+            )
         with self._cond:
             if self._stopped:
                 # a stopped service has no worker to ever resolve the
@@ -676,6 +757,10 @@ class SolverService:
                 rep = self._shard_rep
             else:
                 rep = self._pick_replica_locked(key)
+            if _root is not None:
+                req.qspan = spans.start(
+                    "queued", trace=_trace, parent=_root, lane=rep.lane,
+                )
             rep.q.append(req)
             self._gauge_queues_locked()
             self._cond.notify_all()
@@ -721,12 +806,16 @@ class SolverService:
     def health(self) -> dict:
         """Liveness/readiness snapshot for external probes: total +
         per-replica queue depth vs limit, per-replica worker liveness,
-        lifetime restarts, dispatch counts and breaker states, and the
-        recent failure rate (last 60 s over a bounded window).  Cheap
-        enough to poll.  The legacy top-level ``breakers`` map merges
-        the per-replica tables (worst state wins) so existing probes
-        keep working; ``replicas`` (and ``sharded``, when a mesh is
-        configured) carry the placement-aware detail."""
+        lifetime restarts, dispatch counts, breaker states and the age
+        of each lane's oldest queued request, the recent failure rate
+        (last 60 s over a bounded window), and — with metrics on — the
+        SLO surface: per-bucket p50/p95/p99 total latency
+        (``latency``) and the deadline-budget burn tiers
+        (``slo_burn``).  Cheap enough to poll.  The legacy top-level
+        ``breakers`` map merges the per-replica tables (worst state
+        wins) so existing probes keep working; ``replicas`` (and
+        ``sharded``, when a mesh is configured) carry the
+        placement-aware detail."""
         now = time.monotonic()
         window_s = 60.0
         rank = {
@@ -752,6 +841,12 @@ class SolverService:
                     else None,
                     "queue_depth": len(rep.q),
                     "inflight": len(rep.inflight),
+                    # a deep queue and a STUCK queue look identical in
+                    # queue_depth; the head-of-line age disambiguates
+                    "oldest_queued_s": (
+                        (now - min(r.t_submit for r in rep.q))
+                        if rep.q else 0.0
+                    ),
                     "worker_alive": rep.alive(),
                     "restarts": rep.restarts,
                     "dispatched": rep.dispatched,
@@ -762,9 +857,28 @@ class SolverService:
             restore_result = (
                 dict(self._restore_result) if self._restore_result else None
             )
+            seen_labels = sorted(self._seen_labels)
         shard_lane = lanes.pop() if self._shard_rep is not None else None
         if shard_lane is not None:
             shard_lane["mesh"] = self.placement.mesh
+        # the SLO surface: per-bucket tail percentiles (total = admit ->
+        # deliver) from the serve.latency histograms, plus the
+        # deadline-budget burn counters — only populated while metrics
+        # are on (health() stays cheap either way)
+        latency: Dict[str, dict] = {}
+        slo_burn: Dict[str, int] = {}
+        if metrics.is_on():
+            for lbl in seen_labels:
+                s = metrics.hist_summary(f"serve.latency.{lbl}.total")
+                if s:
+                    latency[lbl] = {
+                        k: s[k] for k in ("count", "p50", "p95", "p99")
+                    }
+            slo_burn = {
+                name.rsplit(".", 1)[1]: int(v)
+                for name, v in metrics.counters().items()
+                if name.startswith("serve.slo_burn.")
+            }
         return {
             "ok": running and alive,
             "phase": phase,
@@ -782,6 +896,8 @@ class SolverService:
             ),
             "replicas": lanes,
             "sharded": shard_lane,
+            "latency": latency,
+            "slo_burn": slo_burn,
             "failures_60s": len(recent),
             "failure_rate_60s": len(recent) / window_s,
             "uptime_s": now - self._t_started,
@@ -905,6 +1021,8 @@ class SolverService:
             # coalesce — their batch point is 1 (the mesh owns shape
             # parallelism, replica scale-out owns throughput)
             return [first]
+        csp = spans.start("coalesce", trace=first.trace, parent=first.span,
+                          lane=rep.lane) if first.trace is not None else None
         if self.batch_max > 1 and self.batch_window_s > 0:
             with self._cond:
                 now = time.monotonic()
@@ -926,6 +1044,7 @@ class SolverService:
             keep.extend(rep.q)
             rep.q = keep
             self._gauge_queues_locked()
+        spans.end(csp, coalesced=len(batch))
         live = []
         for r in batch:
             if r.expired():
@@ -965,6 +1084,24 @@ class SolverService:
         rep.dispatched += len(batch)
         metrics.inc(rep.dispatched_counter, len(batch))
         key = batch[0].key
+        if metrics.is_on():
+            # queued half of the latency split: admit -> FIRST dispatch
+            # (coalesce window included — that wait IS queueing).  A
+            # retried request is not re-observed: its second wait is
+            # backoff, already visible in the serve.retry_backoff_s
+            # timer and its backoff span — and one observation per
+            # request keeps the queued count aligned with total's, the
+            # subtraction premise of tools/latency_report.py
+            now = time.monotonic()
+            lbl = self._lat_label(batch[0])
+            for r in batch:
+                if r.attempt == 0:
+                    metrics.observe_hist(
+                        f"serve.latency.{lbl}.queued", now - r.t_submit
+                    )
+        if spans.is_on():
+            for r in batch:
+                spans.end(r.qspan, outcome="dispatched", replica=rep.name)
         if key is None:
             for r in batch:
                 self._direct(r)
@@ -973,6 +1110,8 @@ class SolverService:
         if br.state == _bk.BREAKER_OPEN:
             if br.try_half_open(time.monotonic(), self.breaker_cooldown_s):
                 metrics.inc("serve.breaker_half_open")
+                spans.event("breaker_half_open", trace=batch[0].trace,
+                            lane=rep.lane, bucket=key.label)
             else:
                 for r in batch:  # open: route direct until the cooldown
                     self._direct(r)
@@ -987,6 +1126,8 @@ class SolverService:
                 metrics.inc("serve.breaker_open")
                 metrics.inc(f"serve.replica.{rep.name}.breaker_open")
                 metrics.inc("serve.degraded")  # legacy alias: open events
+                spans.event("breaker_open", trace=batch[0].trace,
+                            lane=rep.lane, bucket=key.label)
             retryable = [r for r in batch if r.retries > 0]
             rest = [r for r in batch if r.retries <= 0]
             for r in reversed(retryable):
@@ -1003,9 +1144,13 @@ class SolverService:
                 metrics.inc("serve.breaker_open")
                 metrics.inc(f"serve.replica.{rep.name}.breaker_open")
                 metrics.inc("serve.degraded")
+                spans.event("breaker_open", trace=batch[0].trace,
+                            lane=rep.lane, bucket=key.label, corrupt=True)
         elif br.record_success():
             metrics.inc("serve.breaker_closed")  # half-open probe healed
             metrics.inc(f"serve.replica.{rep.name}.breaker_closed")
+            spans.event("breaker_closed", trace=batch[0].trace,
+                        lane=rep.lane, bucket=key.label)
         # resolve futures only AFTER the breaker transition committed: a
         # client that wakes from .result() must observe consistent
         # breaker metrics / health() state
@@ -1025,7 +1170,23 @@ class SolverService:
         r.not_before = time.monotonic() + r.backoff_s
         metrics.inc("serve.retries")
         metrics.observe("serve.retry_backoff_s", r.backoff_s)
+        if r.trace is not None and spans.is_on():
+            # the planned backoff window as a span: a slow request whose
+            # time went into retry delay shows it on its own timeline
+            # (the chaos span test asserts exactly this interval)
+            t = spans.now()
+            spans.record(
+                "backoff", t, t + r.backoff_s, trace=r.trace,
+                parent=r.span, lane=rep.lane,
+                backoff_s=round(r.backoff_s, 6), retries_left=r.retries,
+                attempt=r.attempt,
+            )
         with self._cond:
+            if r.span is not None and spans.is_on():
+                r.qspan = spans.start(
+                    "queued", trace=r.trace, parent=r.span, lane=rep.lane,
+                    retry=True,
+                )
             rep.q.appendleft(r)
             self._cond.notify_all()
 
@@ -1053,6 +1214,8 @@ class SolverService:
             metrics.inc("serve.batch_pad")
         A_b = np.stack([p[0] for p in pads])
         B_b = np.stack([p[1] for p in pads])
+        t_exec = time.monotonic()
+        t_exec_pc = spans.now() if spans.is_on() else 0.0
         if rep.device is not None:
             # replica pinning: the dispatch (and its per-device compiled
             # variant) lands on this replica's device
@@ -1060,17 +1223,44 @@ class SolverService:
         else:
             X_b, info_b = self.cache.run(key, A_b, B_b)
         now = time.monotonic()
+        exec_s = now - t_exec
+        mon = metrics.is_on()
+        if mon:
+            with self._cond:
+                self._seen_labels.add(key.label)
+        if spans.is_on():
+            t1_pc = spans.now()
+            for r in batch:
+                if r.trace is not None:
+                    # one execute span per request (the batch interval;
+                    # every delivered trace keeps a complete chain even
+                    # when its wall time was shared with batch peers)
+                    spans.record(
+                        "execute", t_exec_pc, t1_pc, trace=r.trace,
+                        parent=r.span, lane=rep.lane, bucket=key.label,
+                        batch=len(batch),
+                    )
         deliver = []
         corrupt = 0
         for i, r in enumerate(batch):
             metrics.inc(
                 "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
             )
+            if mon:
+                # execute/total halves of the split, per bucket AND per
+                # replica — one observation per delivered request (a
+                # batch peer shares the batch's execute wall; requests
+                # that degrade to _direct get total there instead)
+                metrics.observe_hist(
+                    f"serve.latency.{key.label}.execute", exec_s
+                )
             late = r.deadline is not None and now > r.deadline
             info = int(info_b[i]) if i < len(info_b) else 0
             if info != 0:
                 if late:
                     self._miss_late()
+                if mon:
+                    self._observe_total(rep, key.label, r, now)
                 metrics.inc("serve.numerical_errors")
                 deliver.append(functools.partial(
                     _resolve_exc, r.future,
@@ -1108,31 +1298,96 @@ class SolverService:
                 continue
             if late:
                 self._miss_late()  # finished late; still delivered
-            deliver.append(functools.partial(_resolve, r.future, X))
+            if mon:
+                self._observe_total(rep, key.label, r, now)
+            deliver.append(functools.partial(_resolve, r.future, X, r))
         if len(batch) > 1:
             metrics.inc("serve.batched")
             metrics.inc("serve.batched_requests", len(batch))
         return deliver, corrupt
+
+    @staticmethod
+    def _lat_label(req: _Request) -> str:
+        """Histogram label of a request: the bucket label, or
+        ``<routine>.direct`` for keyless (direct-only) requests."""
+        return (
+            req.key.label if req.key is not None
+            else f"{req.routine}.direct"
+        )
+
+    def _observe_total(self, rep: Optional[_Replica], label: str,
+                       req: _Request, now: float) -> None:
+        """Total (admit -> deliver) latency into the per-bucket and
+        per-replica histograms, plus the deadline-budget burn counters
+        (``serve.slo_burn.*``).  Callers gate on ``metrics.is_on()``."""
+        total = now - req.t_submit
+        metrics.observe_hist(f"serve.latency.{label}.total", total)
+        if rep is not None:
+            metrics.observe_hist(rep.lat_hist, total)
+        if req.deadline is not None:
+            budget = req.deadline - req.t_submit
+            if budget > 0:
+                # each delivered deadline request lands in exactly one
+                # burn tier: <=50% is healthy headroom, the rest is the
+                # SLO melting in slow motion (exhausted == delivered
+                # late, the deadline_miss_late companion)
+                burn = total / budget
+                metrics.inc("serve.slo_burn.requests")
+                if burn > 1.0:
+                    metrics.inc("serve.slo_burn.exhausted")
+                elif burn > 0.8:
+                    metrics.inc("serve.slo_burn.over_80")
+                elif burn > 0.5:
+                    metrics.inc("serve.slo_burn.over_50")
 
     def _direct(self, req: _Request, batched_error: Optional[Exception] = None) -> None:
         if req.key is not None:
             metrics.inc("serve.fallbacks")  # degradation, not routing
         else:
             metrics.inc("serve.direct_only")  # e.g. underdetermined gels
+        # a context-managed span (not start/end): it is this thread's
+        # spans.current() while the driver runs, so annotations from
+        # inside (e.g. refine iteration counts) land on it
+        cm = (
+            spans.span("direct", trace=req.trace, parent=req.span,
+                       routine=req.routine)
+            if req.trace is not None and spans.is_on()
+            else contextlib.nullcontext()
+        )
         try:
-            with metrics.phase(f"serve.direct.{req.routine}"):
-                X = direct_call(req.routine, req.A, req.B)
+            with cm:
+                with metrics.phase(f"serve.direct.{req.routine}"):
+                    X = direct_call(req.routine, req.A, req.B)
+                spans.annotate(outcome="ok")
         except Exception as e:  # noqa: BLE001 — futures carry the error
+            # the span closed with outcome=<exception type> at __exit__
             if batched_error is not None:
                 e.__context__ = batched_error
             _resolve_exc(req.future, e, req=req)
             return
-        if req.deadline is not None and time.monotonic() > req.deadline:
+        now = time.monotonic()
+        if req.deadline is not None and now > req.deadline:
             self._miss_late()
-        _resolve(req.future, X)
+        if metrics.is_on():
+            lbl = self._lat_label(req)
+            with self._cond:
+                self._seen_labels.add(lbl)
+            self._observe_total(None, lbl, req, now)
+        _resolve(req.future, X, req)
 
 
-def _resolve(fut: Future, value) -> None:
+def _finish_spans(req: Optional[_Request], outcome: str) -> None:
+    """Close a request's span chain at resolution: any still-open
+    queued span, then the root (idempotent — the first outcome wins,
+    mirroring Future.set_result)."""
+    if req is None or req.span is None or not spans.is_on():
+        return
+    spans.end(req.qspan, outcome=outcome)
+    spans.end(req.span, outcome=outcome)
+
+
+def _resolve(fut: Future, value, req: Optional[_Request] = None) -> None:
+    _finish_spans(req, "ok")
     if not fut.done():
         fut.set_result(value)
 
@@ -1140,6 +1395,7 @@ def _resolve(fut: Future, value) -> None:
 def _resolve_exc(
     fut: Future, exc: Exception, req: Optional[_Request] = None
 ) -> None:
+    _finish_spans(req, type(exc).__name__)
     if req is not None and isinstance(exc, SlateError):
         exc.with_context(
             routine=req.routine,

@@ -75,6 +75,123 @@ def test_phase_always_measures_without_recording():
 
 
 # ---------------------------------------------------------------------------
+# histograms: log buckets, percentiles, deltas, JSONL
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentiles_accurate_to_a_bucket():
+    """p50/p95/p99 from the log-spaced buckets track the exact sample
+    percentiles within one bucket ratio (10/decade => ~26% worst case;
+    lognormal latencies land well inside that)."""
+    import random
+
+    random.seed(7)
+    vals = sorted(random.lognormvariate(-5, 1) for _ in range(4000))
+    metrics.on()
+    for v in vals:
+        metrics.observe_hist("lat", v)
+    for p in (50, 95, 99):
+        est = metrics.percentile("lat", p)
+        exact = vals[int(p / 100 * len(vals)) - 1]
+        assert est == pytest.approx(exact, rel=0.3), p
+    s = metrics.hist_summary("lat")
+    assert s["count"] == 4000
+    assert s["min_s"] == pytest.approx(vals[0], abs=1e-6)  # 6-dp rounded
+    assert s["max_s"] == pytest.approx(vals[-1], abs=1e-6)
+    assert s["p50"] <= s["p95"] <= s["p99"] <= s["max_s"]
+
+
+def test_histogram_extremes_clamped():
+    metrics.on()
+    metrics.observe_hist("h", 1e-9)   # underflow bucket
+    metrics.observe_hist("h", 5e3)    # overflow bucket
+    assert metrics.percentile("h", 1) == pytest.approx(1e-9)
+    assert metrics.percentile("h", 99) == pytest.approx(5e3)
+
+
+def test_histogram_single_observation_exact():
+    metrics.on()
+    metrics.observe_hist("one", 0.0123)
+    # min/max clamping makes a single-sample histogram exact at every p
+    assert metrics.percentile("one", 50) == pytest.approx(0.0123)
+    assert metrics.percentile("one", 99) == pytest.approx(0.0123)
+
+
+def test_observe_hist_off_is_noop():
+    assert not metrics.is_on()
+    metrics.observe_hist("h", 1.0)
+    metrics.on()
+    assert metrics.histograms() == {}
+    assert metrics.percentile("h", 50) is None
+    assert metrics.hist_summary("h") is None
+
+
+def test_deltas_hist_windows_percentiles():
+    metrics.on()
+    metrics.observe_hist("d", 100.0)  # pre-window outlier
+    with metrics.deltas() as d:
+        for v in (0.001, 0.002, 0.004, 0.008):
+            metrics.observe_hist("d", v)
+        w = d.hist("d")
+    assert w["count"] == 4
+    assert w["total_s"] == pytest.approx(0.015)
+    assert w["p99"] < 0.02  # the pre-window 100s sample is excluded
+    assert d.hist("missing") is None
+
+
+def test_hist_jsonl_round_trip_and_report(tmp_path):
+    metrics.on()
+    for v in (0.001, 0.01, 0.1):
+        metrics.observe_hist("serve.latency.test.total", v)
+    rep = metrics.report()
+    assert "histogram" in rep and "serve.latency.test.total" in rep
+    path = str(tmp_path / "h.jsonl")
+    metrics.dump(path)
+    rows = metrics.load_jsonl(path)
+    h = [r for r in rows if r["type"] == "hist"]
+    assert len(h) == 1 and h[0]["name"] == "serve.latency.test.total"
+    assert h[0]["count"] == 3
+    assert sum(c for _le, c in h[0]["buckets"]) == 3
+    # bucket upper edges bracket the observations
+    les = [le for le, _c in h[0]["buckets"]]
+    assert all(isinstance(le, float) for le in les)
+    # the percentile helper re-ranks from the wire form the same way
+    counts = [0] * (len(metrics.HIST_EDGES) + 1)
+    edge_index = {f"{e:.9g}": i for i, e in enumerate(metrics.HIST_EDGES)}
+    for le, c in h[0]["buckets"]:
+        counts[edge_index[f"{le:.9g}"]] = c
+    est = metrics.Histogram.percentile_from(counts, 99)
+    assert est == pytest.approx(h[0]["p99"], rel=0.35)
+    assert metrics.summary()["histograms"]["serve.latency.test.total"][
+        "count"] == 3
+
+
+def test_driver_phase_feeds_histogram():
+    """kind="driver" phases (the @instrumented decorator) land in a
+    same-named histogram — factor/solve percentiles for free."""
+    metrics.on()
+
+    @metrics.instrumented("hist_drv")
+    def drv():
+        return 1
+
+    for _ in range(3):
+        drv()
+    assert metrics.hist_summary("hist_drv")["count"] == 3
+    # plain phases do NOT (timers already cover them)
+    with metrics.phase("plain"):
+        pass
+    assert metrics.hist_summary("plain") is None
+
+
+def test_hist_reset_clears():
+    metrics.on()
+    metrics.observe_hist("h", 1.0)
+    metrics.reset()
+    assert metrics.histograms() == {}
+
+
+# ---------------------------------------------------------------------------
 # zero overhead when off
 # ---------------------------------------------------------------------------
 

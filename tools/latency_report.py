@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Per-bucket/per-replica latency percentile table from a metrics JSONL.
+
+    python tools/latency_report.py out.jsonl [--p99-budget 0.5]
+
+Rows come from the ``serve.latency.*`` histograms the SolverService
+records (slate_tpu/serve/service.py): per bucket label, the
+**queued** (admit -> dispatch, coalesce window included), **execute**
+(padded-batch dispatch wall) and **total** (admit -> deliver) splits;
+per replica lane, the total.  Histogram JSONL lines carry
+count/min/max/p50/p95/p99 plus the nonzero ``[le, count]`` bucket rows
+on the fixed log lattice (``metrics.HIST_EDGES``), so any other
+percentile can be re-ranked from the same dump.
+
+Underneath the table: the deadline-budget burn tiers
+(``serve.slo_burn.*``) and the head-of-line age gauges
+(``serve.replica.<i>.oldest_queued_s``).
+
+Exit status is the **SLO verdict**: with ``--p99-budget S``, any
+bucket whose total p99 exceeds ``S`` seconds exits nonzero (what the
+``run_tests.py --latency`` gate fails on), as does a JSONL with no
+latency histograms at all (a budget over no data verifies nothing).
+
+Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
+serving workload (examples/ex21_tracing.py shows the loop).
+"""
+
+import argparse
+import json
+import re
+import sys
+
+_LAT_RE = re.compile(
+    r"^serve\.latency\.(?P<scope>.+)\.(?P<split>queued|execute|total)$"
+)
+
+SPLITS = ("queued", "execute", "total")
+
+
+def load_records(path):
+    hists, counters, gauges = {}, {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            # cumulative snapshots: last value wins (same semantics as
+            # the sibling reports — summing re-dumped JSONLs inflates)
+            if r.get("type") == "hist":
+                hists[r["name"]] = r
+            elif r.get("type") == "counter":
+                counters[r["name"]] = r.get("value", 0)
+            elif r.get("type") == "gauge":
+                gauges[r["name"]] = r.get("value", 0)
+    return hists, counters, gauges
+
+
+def latency_rows(hists):
+    """{scope: {split: hist-record}}; scope is a bucket label or
+    ``replica.<name>``."""
+    rows = {}
+    for name, rec in hists.items():
+        m = _LAT_RE.match(name)
+        if not m:
+            continue
+        rows.setdefault(m.group("scope"), {})[m.group("split")] = rec
+    return rows
+
+
+def _ms(rec, field):
+    if rec is None:
+        return "-"
+    return f"{rec[field] * 1e3:.1f}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="latency_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS output)")
+    ap.add_argument("--p99-budget", type=float, default=None,
+                    help="SLO verdict: fail when any bucket's total p99 "
+                         "exceeds this many seconds")
+    args = ap.parse_args(argv)
+
+    hists, counters, gauges = load_records(args.jsonl)
+    rows = latency_rows(hists)
+    buckets = {s: r for s, r in rows.items() if not s.startswith("replica.")}
+    replicas = {s: r for s, r in rows.items() if s.startswith("replica.")}
+
+    if not rows:
+        print("(no serve.latency.* histograms in this JSONL — did the "
+              "stream go through a SolverService with metrics on?)")
+        return 1 if args.p99_budget is not None else 0
+
+    hdr = (f"{'bucket':38} {'count':>6} {'queued p50/p99':>15} "
+           f"{'exec p50/p99':>15} {'total p50':>10} {'p95':>8} "
+           f"{'p99(ms)':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    over = []
+    for scope in sorted(buckets):
+        r = buckets[scope]
+        total = r.get("total")
+        q, x = r.get("queued"), r.get("execute")
+        count = (total or q or x or {}).get("count", 0)
+        print(
+            f"{scope:38} {count:6d} "
+            f"{_ms(q, 'p50'):>7}/{_ms(q, 'p99'):>7} "
+            f"{_ms(x, 'p50'):>7}/{_ms(x, 'p99'):>7} "
+            f"{_ms(total, 'p50'):>10} {_ms(total, 'p95'):>8} "
+            f"{_ms(total, 'p99'):>8}"
+        )
+        if (args.p99_budget is not None and total is not None
+                and total["p99"] > args.p99_budget):
+            over.append((scope, total["p99"]))
+
+    if replicas:
+        print()
+        hdr = (f"{'replica':>10} {'count':>6} {'total p50':>10} "
+               f"{'p95':>8} {'p99(ms)':>8} {'oldest_queued_s':>16}")
+        print(hdr)
+        print("-" * len(hdr))
+        for scope in sorted(replicas):
+            t = replicas[scope].get("total")
+            name = scope.split(".", 1)[1]
+            oldest = gauges.get(f"serve.replica.{name}.oldest_queued_s")
+            print(
+                f"{name:>10} {(t or {}).get('count', 0):6d} "
+                f"{_ms(t, 'p50'):>10} {_ms(t, 'p95'):>8} "
+                f"{_ms(t, 'p99'):>8} "
+                f"{oldest if oldest is not None else '-':>16}"
+            )
+
+    burn = {k.rsplit(".", 1)[1]: int(v) for k, v in counters.items()
+            if k.startswith("serve.slo_burn.")}
+    if burn:
+        total_b = burn.get("requests", 0)
+        tiers = ", ".join(f"{k}={v}" for k, v in sorted(burn.items())
+                          if k != "requests")
+        print(f"\nslo burn (of {total_b} deadline requests): "
+              + (tiers or "all under 50% of budget"))
+
+    if over:
+        for scope, p99 in over:
+            print(f"FAIL: {scope} total p99 {p99 * 1e3:.1f} ms exceeds "
+                  f"the {args.p99_budget * 1e3:.1f} ms budget")
+        return 1
+    if args.p99_budget is not None:
+        print(f"\np99 budget ok: every bucket under "
+              f"{args.p99_budget * 1e3:.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
