@@ -587,6 +587,64 @@ def main(argv=None):
 
     run_entry("serve_latency", entry_serve_latency)
 
+    # -- factor-once solve-many: a warmed repeated-A stream (1 factor +
+    # N right-hand sides) through the factor cache's trsm-only solve
+    # buckets vs the same stream refactoring every request.  The
+    # headline is speedup_vs_refactor: steady-state O(n^2) vs O(n^3)
+    # per request (the hit/miss deltas prove which path served) -------
+    def entry_factor_solve_many():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.factor_cache import FactorCache
+        from slate_tpu.serve.service import SolverService
+
+        nfc = 1024 if on_tpu else 128
+        reqs = 32
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((nfc, nfc)) + nfc * np.eye(nfc)
+        Bs = [rng.standard_normal((nfc, 4)) for _ in range(8)]
+        out = {"n": nfc, "requests": reqs}
+        rates = {}
+        for mode in ("refactor", "factor_cache"):
+            # False = explicitly off (None would re-resolve the
+            # SLATE_TPU_FACTOR_CACHE env and poison the baseline)
+            fc = FactorCache(max_entries=8) if mode == "factor_cache" \
+                else False
+            svc = SolverService(
+                cache=ExecutableCache(manifest_path=None), batch_max=8,
+                batch_window_s=0.001, factor_cache=fc,
+            )
+            # warm: one solve registers (and, with the cache, factors);
+            # warmup() then precompiles the registered buckets so the
+            # measured stream is compile-free on both paths
+            svc.submit("gesv", A, Bs[0]).result(timeout=600)
+            svc.warmup()
+            t0 = time.perf_counter()
+            with _m.deltas() as d:
+                futs = [
+                    svc.submit("gesv", A, Bs[i % len(Bs)])
+                    for i in range(reqs)
+                ]
+                for f in futs:
+                    assert np.all(np.isfinite(f.result(timeout=600)))
+                hits = int(d.get("serve.factor_cache.hit"))
+                misses = int(d.get("serve.factor_cache.miss"))
+            dt = time.perf_counter() - t0
+            svc.stop()
+            rates[mode] = reqs / dt
+            out[mode] = {
+                "requests_per_s": round(reqs / dt, 1),
+                "seconds": round(dt, 3),
+                "hits": hits,
+                "misses": misses,
+            }
+        out["speedup_vs_refactor"] = round(
+            rates["factor_cache"] / max(rates["refactor"], 1e-9), 2
+        )
+        return out
+
+    run_entry("factor_solve_many", entry_factor_solve_many)
+
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
 

@@ -503,6 +503,89 @@ def latency_gate() -> int:
         )
 
 
+# Env-activated repeated-A stream for the --factor gate:
+# SLATE_TPU_FACTOR_CACHE=1 + SLATE_TPU_METRICS are read at import (the
+# production activation path).  One submit factors and caches; the
+# warmed 20-request same-A stream must be trsm-only (hits) and
+# compile-free; the JSONL is joined by tools/factor_report.py.
+_FACTOR_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+svc = SolverService(cache=ExecutableCache(manifest_path=None), batch_max=4,
+                    batch_window_s=0.002, dim_floor=16, nrhs_floor=4)
+assert svc.factor_cache is not None, "SLATE_TPU_FACTOR_CACHE must arm it"
+rng = np.random.default_rng(0)
+n = 12
+A = rng.standard_normal((n, n)) + n * np.eye(n)
+B0 = rng.standard_normal((n, 2))
+X0 = svc.submit("gesv", A, B0).result(timeout=300)
+assert np.abs(X0 - np.linalg.solve(A, B0)).max() < 1e-9
+svc.warmup()  # the miss registered the solve bucket; precompile it
+with metrics.deltas() as d:
+    futs = [svc.submit("gesv", A, rng.standard_normal((n, 2)))
+            for _ in range(20)]
+    for f in futs:
+        assert np.all(np.isfinite(f.result(timeout=300)))
+    hits = d.get("serve.factor_cache.hit")
+    comp = d.get("jit.compilations")
+assert hits >= 19, hits
+assert comp == 0, f"warmed repeated-A stream compiled: {comp}"
+svc.stop()
+print(f"factor driver: 1 factor + 20 trsm-only solves, "
+      f"{int(hits)} hits, 0 compiles")
+"""
+
+
+def factor_gate() -> int:
+    """Factor-cache gate, two legs: (1) the factor-cache suite
+    (keying, budgets, up/downdate, solve-phase manifest/artifact
+    round-trips, the warmed repeated-A acceptance stream); (2) an
+    env-activated repeated-A stream (SLATE_TPU_FACTOR_CACHE=1 +
+    SLATE_TPU_METRICS, the production activation path) whose JSONL is
+    joined by tools/factor_report.py — a repeated-A stream with zero
+    hits fails the gate."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_factor_cache.py",
+         "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    jsonl = os.path.join(
+        tempfile.gettempdir(), f"factor_{os.getpid()}.jsonl"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", SLATE_TPU_METRICS=jsonl,
+        SLATE_TPU_FACTOR_CACHE="1",
+    )
+    env.pop("SLATE_TPU_FAULTS", None)
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-c", _FACTOR_DRIVER], env=env, cwd=here
+        )
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "factor_report.py"),
+             jsonl],
+            cwd=here,
+        )
+    finally:
+        try:
+            os.unlink(jsonl)
+        except OSError:
+            pass
+
+
 # Restart-drill drivers for the --coldstart gate.  Each runs in its OWN
 # subprocess so the restore leg is a true fresh interpreter: nothing
 # carries over but the artifact dir + manifest on disk.
@@ -706,6 +789,11 @@ def main() -> int:
                     help="run the span/histogram suites + a traced "
                          "faulty serve stream (Chrome-export chain "
                          "check) + the latency_report p99 gate")
+    ap.add_argument("--factor", action="store_true",
+                    help="run the factor-cache suite + an "
+                         "env-activated repeated-A stream gated by "
+                         "tools/factor_report.py (zero hits on a "
+                         "repeated-A stream fails)")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -728,6 +816,8 @@ def main() -> int:
         return sharded()
     if args.latency:
         return latency_gate()
+    if args.factor:
+        return factor_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

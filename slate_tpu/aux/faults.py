@@ -34,6 +34,12 @@ Sites (:data:`SITES`) and where they are checked:
     ``artifact_load_fail`` deserialization of a verified artifact
                        raises (``ArtifactStore.load``) — the degrade
                        ladder must fall through to a recompile
+    ``factor_stale``   a factor-cache hit silently serves a factor
+                       whose fingerprint no longer matches A (finite
+                       but WRONG — unlike result_corrupt's NaN): the
+                       hit path's residual validation must catch it,
+                       bump ``serve.factor_cache.stale``, and re-solve
+                       direct (``serve.service`` solve-phase dispatch)
 
 Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
 site, so the fire pattern is a pure function of ``seed`` and the call
@@ -95,6 +101,7 @@ SITES = (
     "artifact_corrupt",
     "artifact_stale",
     "artifact_load_fail",
+    "factor_stale",
 )
 
 
@@ -289,6 +296,20 @@ def corrupt(site: str, arr: np.ndarray) -> np.ndarray:
         return arr
     out = np.array(arr)  # fresh writable copy — device views are read-only
     out.reshape(-1)[0] = np.nan
+    return out
+
+
+def perturb(site: str, arr: np.ndarray) -> np.ndarray:
+    """Return ``arr`` with its first element perturbed to a FINITE but
+    wrong value when the site fires (factor_stale: a silently-mismatched
+    factor — NaN would trip the cheap finiteness check, which is not
+    the validation under test), unchanged otherwise."""
+    if not _enabled:
+        return arr
+    if fire(site) is None:
+        return arr
+    out = np.array(arr)  # fresh writable copy — cached views stay intact
+    out.reshape(-1)[0] = out.reshape(-1)[0] * 2 + 1
     return out
 
 

@@ -131,6 +131,20 @@ def potrs(
     return X
 
 
+def potrs_from_global(Lg: jnp.ndarray, Bg: jnp.ndarray) -> jnp.ndarray:
+    """potrs-style solve-only entry point over global arrays: solve
+    L L^H X = B by two trsm sweeps against a clean lower-triangular
+    factor.  The O(n^2) steady-state kernel of the serve factor
+    cache's trsm-only (``phase="solve"``) bucket family; fully
+    traceable (jit/vmap)."""
+    cplx = jnp.iscomplexobj(Lg)
+    Y = lax.linalg.triangular_solve(Lg, Bg, left_side=True, lower=True)
+    return lax.linalg.triangular_solve(
+        Lg, Y, left_side=True, lower=True, transpose_a=True,
+        conjugate_a=cplx,
+    )
+
+
 @instrumented("posv")
 def posv(
     A: HermitianMatrix, B: Matrix, opts: Optional[Options] = None

@@ -290,6 +290,21 @@ def getrs_nopiv(LU: Matrix, B: Matrix, opts=None) -> Matrix:
     return getrs(LU, None, B, opts)
 
 
+def getrs_from_global(LUg: jnp.ndarray, Bg: jnp.ndarray) -> jnp.ndarray:
+    """getrs-style solve-only entry point over global arrays: two trsm
+    sweeps against a packed LU (unit-lower L below the diagonal, U on
+    and above), B already row-permuted (P B).  This is the O(n^2)
+    steady-state kernel of the serve factor cache's trsm-only
+    (``phase="solve"``) bucket family — the factorization's row
+    permutation is a host-side gather, so the traced program is pure
+    triangular algebra and exports custom-call-free under the
+    recursive schedule's jax lowering.  Fully traceable (jit/vmap)."""
+    Y = lax.linalg.triangular_solve(
+        LUg, Bg, left_side=True, lower=True, unit_diagonal=True
+    )
+    return lax.linalg.triangular_solve(LUg, Y, left_side=True, lower=False)
+
+
 @instrumented("gesv")
 def gesv(
     A: Matrix, B: Matrix, opts: Optional[Options] = None

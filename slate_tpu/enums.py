@@ -353,6 +353,9 @@ class Option(enum.Enum):
     ServeReplicas = "serve_replicas"  # data-parallel replica worker count
     ServeMesh = "serve_mesh"  # spmd submesh "PxQ" for sharded routing
     ServeShardThreshold = "serve_shard_threshold"  # n >= this routes sharded
+    ServeFactorCache = "serve_factor_cache"  # enable the factorization cache
+    ServeFactorCacheEntries = "serve_factor_cache_entries"  # LRU entry cap
+    ServeFactorCacheBytes = "serve_factor_cache_bytes"  # LRU byte budget
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

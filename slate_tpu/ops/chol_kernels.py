@@ -405,6 +405,78 @@ def chol_recursive(
 
 
 # ---------------------------------------------------------------------------
+# Rank-k Cholesky up/downdate: L' L'^H = L L^H ± U U^H in O(k n^2),
+# the incremental-edit path of the serve factor cache (a rank-k change
+# to A re-keys a cached factor without the O(n^3) refactor).
+# ---------------------------------------------------------------------------
+
+
+def chol_rank1_update(
+    L: jnp.ndarray, u: jnp.ndarray, downdate: bool = False
+) -> jnp.ndarray:
+    """Rank-1 update (``downdate=False``: A + u u^H) or downdate
+    (A - u u^H) of a lower Cholesky factor, column-at-a-time with
+    full-vector masks (one fori_loop, static shapes — O(n^2) work).
+
+    Per column k (lkk = L[k,k] real-positive, sigma = ±1):
+    ``t = u[k]/lkk``, ``c = sqrt(1 + sigma |t|^2)``, then
+    ``L'[j,k] = (L[j,k] + sigma conj(t) u[j]) / c`` for j > k,
+    ``L'[k,k] = c lkk``, and ``u <- (u - t L[:,k]) / c`` (the OLD
+    column) — the hyperbolic analogue of the Givens sweep, valid for
+    complex Hermitian A since the diagonal stays real.
+
+    A downdate past positive definiteness (1 - |t|^2 <= 0) yields NaN
+    columns via the sqrt, the same breakdown contract as
+    ``chol_unblocked`` — callers check finiteness and refactor.
+    """
+    n = L.shape[0]
+    sigma = -1.0 if downdate else 1.0
+    idx = jnp.arange(n)
+    rdt = jnp.finfo(L.dtype).dtype  # real dtype of (possibly complex) L
+
+    def body(k, carry):
+        L, u = carry
+        lkk = jnp.real(L[k, k])
+        t = u[k] / lkk.astype(L.dtype)
+        c = jnp.sqrt(
+            jnp.asarray(1.0, rdt) + sigma * jnp.real(t * jnp.conj(t))
+        )
+        colk = L[:, k]
+        below = idx > k
+        newcol = jnp.where(
+            below,
+            (colk + (sigma * jnp.conj(t)) * u) / c.astype(L.dtype),
+            colk,
+        )
+        newcol = newcol.at[k].set((c * lkk).astype(L.dtype))
+        u = jnp.where(
+            below, (u - t * colk) / c.astype(L.dtype),
+            jnp.zeros((), L.dtype),
+        )
+        return L.at[:, k].set(newcol), u
+
+    L, _ = lax.fori_loop(0, n, body, (L, u.astype(L.dtype)))
+    return jnp.tril(L)
+
+
+def chol_update(
+    L: jnp.ndarray, U: jnp.ndarray, downdate: bool = False
+) -> jnp.ndarray:
+    """Rank-k Cholesky up/downdate: ``L' L'^H = L L^H ± U U^H`` with U
+    of shape (n, k) or (n,) — k sequential rank-1 sweeps (each column's
+    sweep transforms only L; the columns are independent updates of the
+    running factor).  O(k n^2) total; ``downdate`` is static."""
+    U2 = U if U.ndim == 2 else U[:, None]
+    n, k = U2.shape
+
+    def body(i, L):
+        u = lax.dynamic_slice(U2, (0, i), (n, 1))[:, 0]
+        return chol_rank1_update(L, u, downdate)
+
+    return lax.fori_loop(0, k, body, L)
+
+
+# ---------------------------------------------------------------------------
 # FLOP accounting.  Pure-python structural mirrors of the schedules
 # above: every gemm/trsm/base-case the traced program will execute is
 # counted at the shape it executes at (masked full-shape ops count at

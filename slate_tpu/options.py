@@ -63,6 +63,13 @@ _DEFAULTS = {
     # throughput, large -> the SLATE process grid for capability);
     # one value with PlacementPolicy's constructor default
     Option.ServeShardThreshold: DEFAULT_SHARD_THRESHOLD,
+    # factor cache (serve/factor_cache.py): OFF by default — the
+    # repeated-A trsm-only fast path is an opt-in workload declaration
+    # (SLATE_TPU_FACTOR_CACHE env overrides; one branch on the hot
+    # path when off)
+    Option.ServeFactorCache: False,
+    Option.ServeFactorCacheEntries: 32,  # LRU entry cap
+    Option.ServeFactorCacheBytes: 1 << 30,  # LRU byte budget (1 GiB)
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

@@ -6,6 +6,9 @@ a durable executable artifact store for crash-safe cold starts
 (`artifacts`, ``SLATE_TPU_ARTIFACTS=/dir``), a mesh-aware placement
 tier — replica scale-out + spmd submesh routing (`placement`,
 ``Option.ServeReplicas/ServeMesh/ServeShardThreshold``) — a
+factor-once/solve-many factorization cache dispatching trsm-only
+executables on repeated-A traffic (`factor_cache`,
+``SLATE_TPU_FACTOR_CACHE``) — a
 deadline-aware batching service with a cold/restoring/ready readiness
 phase (`service`), and thin sync wrappers (`api`):
 ``serve.gesv/posv/gels``, ``serve.submit``, ``serve.warmup``,
@@ -25,6 +28,9 @@ _API = (
     "gesv", "posv", "gels", "submit", "warmup", "restore", "wait_ready",
     "configure", "shutdown", "get_service", "get_cache", "health",
     "InvalidInput",
+    # factor cache (factor once, solve many)
+    "get_factor_cache", "factor_fingerprint", "invalidate",
+    "invalidate_all", "update_factor",
 )
 _SERVICE = (
     "SolverService", "Rejected", "DeadlineExceeded", "decorrelated_backoff",
@@ -37,10 +43,17 @@ _BUCKETS = (
 )
 _ARTIFACTS = ("ArtifactStore", "ARTIFACTS_ENV", "store_from_env")
 _PLACEMENT = ("PlacementPolicy",)
-_SUBMODULES = ("api", "buckets", "cache", "service", "artifacts", "placement")
+_FACTOR = (
+    "FactorCache", "FactorEntry", "matrix_fingerprint",
+    "FACTOR_CACHE_ENV",
+)
+_SUBMODULES = (
+    "api", "buckets", "cache", "service", "artifacts", "placement",
+    "factor_cache",
+)
 
 __all__ = list(
-    _API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS + _PLACEMENT
+    _API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS + _PLACEMENT + _FACTOR
 ) + list(_SUBMODULES)
 
 
@@ -60,6 +73,10 @@ def __getattr__(name: str):
     if name in _PLACEMENT:
         return getattr(
             importlib.import_module(".placement", __name__), name
+        )
+    if name in _FACTOR:
+        return getattr(
+            importlib.import_module(".factor_cache", __name__), name
         )
     if name in _SUBMODULES:
         # the advertised submodules themselves (serve.placement,

@@ -80,6 +80,12 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         faults_spec=str(get_option(opts, Option.Faults) or ""),
     )
     cfg.update(kw)
+    if cfg.get("factor_cache") is None:
+        # per-call opts can enable the factor cache too (the service's
+        # own fallback resolution only sees process defaults + env)
+        from .factor_cache import cache_from_options
+
+        cfg["factor_cache"] = cache_from_options(opts)
     if cfg.get("placement") is None:
         # build the policy AFTER kw lands so the replicas shorthand is
         # honored (an eager placement= in cfg would make SolverService
@@ -214,3 +220,55 @@ def health() -> dict:
 def get_cache() -> ExecutableCache:
     """The process service's executable cache (manifest control)."""
     return get_service().cache
+
+
+# -- factor cache (factor once, solve many) ---------------------------------
+
+
+def get_factor_cache():
+    """The process service's :class:`~slate_tpu.serve.factor_cache.
+    FactorCache`, or None when disabled (the default —
+    ``SLATE_TPU_FACTOR_CACHE=1`` / ``Option.ServeFactorCache`` turn it
+    on)."""
+    return get_service().factor_cache
+
+
+def factor_fingerprint(routine: str, A) -> str:
+    """The matrix fingerprint ``submit(routine, A, ...)`` will key the
+    factor cache by (A's bytes + dtype + shape + routine + the
+    service's schedule) — the handle for :func:`invalidate` /
+    :func:`update_factor`."""
+    from .factor_cache import matrix_fingerprint
+
+    svc = get_service()
+    return matrix_fingerprint(np.asarray(A), routine,
+                              schedule=svc.schedule)
+
+
+def invalidate(fp: str) -> bool:
+    """Drop one fingerprint's cached factor — the next same-A request
+    pays a counted refactor (``serve.factor_cache.invalidate``).
+    Returns whether it was cached; False too when the cache is off."""
+    fc = get_service().factor_cache
+    return fc.invalidate(fp) if fc is not None else False
+
+
+def invalidate_all() -> int:
+    """Drop every cached factor; returns the count dropped (0 when the
+    cache is off)."""
+    fc = get_service().factor_cache
+    return fc.invalidate_all() if fc is not None else 0
+
+
+def update_factor(fp: str, A_new, U, downdate: bool = False):
+    """Rank-k up/downdate of a cached factor for an incrementally
+    edited A (``A_new = A ± U U^H``): posv entries update the Cholesky
+    factor in O(k n^2), gesv entries refactor (counted).  Returns the
+    NEW fingerprint the entry is re-keyed to (what ``submit(A_new,..)``
+    will hit), or None when ``fp`` is not cached / the cache is off —
+    just submit A_new and let the miss path factor it."""
+    fc = get_service().factor_cache
+    if fc is None:
+        return None
+    return fc.update(fp, np.asarray(A_new), np.asarray(U),
+                     downdate=downdate)

@@ -54,6 +54,12 @@ DEFAULT_SHARD_THRESHOLD = 2048
 #: overrides against this same check)
 PRECISIONS = ("full", "mixed")
 
+#: accepted BucketKey.phase values: "full" runs the whole factor+solve
+#: pipeline; "solve" is the trsm-only family the factor cache
+#: dispatches on a hit (gesv: pre-permuted rows + two trsm sweeps,
+#: posv: two trsm sweeps) — O(n^2 nrhs) against the full phase's O(n^3)
+PHASES = ("full", "solve")
+
 
 def check_precision(precision: str) -> str:
     """Validate a serving-precision string; returns it unchanged."""
@@ -63,6 +69,15 @@ def check_precision(precision: str) -> str:
             f"({'|'.join(PRECISIONS)})"
         )
     return precision
+
+
+def check_phase(phase: str) -> str:
+    """Validate a serving-phase string; returns it unchanged."""
+    if phase not in PHASES:
+        raise ValueError(
+            f"unknown serving phase {phase!r} ({'|'.join(PHASES)})"
+        )
+    return phase
 
 
 def parse_mesh(mesh: str) -> Tuple[int, int]:
@@ -183,7 +198,17 @@ class BucketKey:
     the same bucket shape traced for different mesh shapes is a
     different program, so manifests warm — and the artifact store
     fingerprints — per mesh shape (ROADMAP item 2's remnant: sharded
-    executables no longer collide with the single-device key)."""
+    executables no longer collide with the single-device key).
+
+    ``phase`` selects how much of the pipeline the executable runs:
+    ``"full"`` (factor + solve — the legacy default, so old manifests
+    round-trip unchanged) or ``"solve"`` (trsm-only: the cheap family
+    the factor cache dispatches on a hit, taking the *factor* as its
+    first operand — gesv rides pre-permuted rows + two trsm sweeps,
+    posv two trsm sweeps).  A first-class key field: the solve-phase
+    executable is a different program over the same bucket shape, so
+    manifests warm it separately and its artifact fingerprint never
+    collides with the full-phase sibling's."""
 
     routine: str
     m: int  # row bucket
@@ -195,6 +220,7 @@ class BucketKey:
     schedule: str = "auto"  # factorization schedule (Option.Schedule)
     precision: str = "full"  # solve path: full | mixed
     mesh: str = ""  # placement: "" = single device | "PxQ" spmd submesh
+    phase: str = "full"  # pipeline slice: full (factor+solve) | solve
 
     @property
     def label(self) -> str:
@@ -205,6 +231,7 @@ class BucketKey:
             + (f".{self.schedule}" if self.schedule != "auto" else "")
             + (f".{self.precision}" if self.precision != "full" else "")
             + (f".mesh{self.mesh}" if self.mesh else "")
+            + (f".{self.phase}" if self.phase != "full" else "")
         )
 
     def to_json(self) -> dict:
@@ -213,6 +240,7 @@ class BucketKey:
             "nrhs": self.nrhs, "dtype": self.dtype, "nb": self.nb,
             "tag": self.tag, "schedule": self.schedule,
             "precision": self.precision, "mesh": self.mesh,
+            "phase": self.phase,
         }
 
     @staticmethod
@@ -224,7 +252,15 @@ class BucketKey:
             schedule=str(d.get("schedule", "auto")),
             precision=str(d.get("precision", "full")),
             mesh=check_mesh(str(d.get("mesh", ""))),
+            phase=check_phase(str(d.get("phase", "full"))),
         )
+
+    def solve_sibling(self) -> "BucketKey":
+        """The trsm-only (phase="solve") twin of a full-phase bucket —
+        the executable the factor cache dispatches on a hit."""
+        import dataclasses
+
+        return dataclasses.replace(self, phase="solve")
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +349,7 @@ def bucket_for(
     schedule: str = "auto",
     precision: str = "full",
     mesh: str = "",
+    phase: str = "full",
 ) -> BucketKey:
     """Map one request onto its BucketKey.  gesv/posv are square
     (m == n); gels buckets rows and columns independently (m >= n —
@@ -324,9 +361,22 @@ def bucket_for(
     by placement: ``"PxQ"`` routes it through the spmd drivers on that
     submesh (gesv/posv full-precision only — the sharded solvers have
     no mixed or least-squares trace; serve/placement enforces the
-    routing policy, this validates the combination)."""
+    routing policy, this validates the combination).  ``phase`` keys
+    the pipeline slice: the ``"solve"`` (trsm-only) family exists for
+    gesv/posv at full precision on a single device only — the factor
+    cache owns the factor, the mesh and mixed tiers have no
+    factor-reuse trace."""
     check_precision(precision)
+    check_phase(phase)
     mesh = check_mesh(mesh)
+    if phase != "full" and (
+        routine not in ("gesv", "posv") or precision != "full" or mesh
+    ):
+        raise ValueError(
+            "solve-phase buckets exist for single-device full-precision "
+            f"gesv/posv only (routine={routine!r}, "
+            f"precision={precision!r}, mesh={mesh!r})"
+        )
     dt = np.dtype(dtype).name
     rb = bucket_dim(nrhs, nrhs_floor)
     if routine in ("gesv", "posv"):
@@ -340,7 +390,7 @@ def bucket_for(
         S = bucket_dim(n, floor)
         return BucketKey(
             routine, S, S, rb, dt, _serve_nb(S), tag, schedule, precision,
-            mesh,
+            mesh, phase,
         )
     if routine == "gels":
         if m < n:
@@ -413,6 +463,27 @@ def pad_waste(key: BucketKey, m: int, n: int, nrhs: int) -> int:
     return max(padded - true, 0)
 
 
+def phase_flops(key: BucketKey, batch: int = 1) -> float:
+    """Model FLOPs of one dispatch of this bucket's executable — the
+    schedule-accounting mirror behind the factor cache's ≤ 10%
+    acceptance criterion (the solve-only family must cost an order
+    less than its full-phase sibling).  Full phase: the factorization
+    (gesv 2/3 n^3, posv 1/3 n^3) plus the two trsm sweeps; solve
+    phase: the trsm sweeps alone (2 n^2 nrhs — the row permute is a
+    gather, FLOP-free).  Per-item, times the batch point."""
+    n, r = float(key.n), float(key.nrhs)
+    solve = 2.0 * n * n * r
+    if key.phase == "solve":
+        return batch * solve
+    if key.routine == "gesv":
+        return batch * (2.0 / 3.0 * n**3 + solve)
+    if key.routine == "posv":
+        return batch * (1.0 / 3.0 * n**3 + solve)
+    # gels: QR factor + apply + triangular solve (m >= n)
+    m = float(key.m)
+    return batch * (2.0 * m * n * n - 2.0 / 3.0 * n**3 + 2.0 * m * n * r)
+
+
 # ---------------------------------------------------------------------------
 # fingerprinting (the durable-artifact identity, serve/artifacts.py)
 # ---------------------------------------------------------------------------
@@ -446,7 +517,8 @@ def manifest_dumps(entries) -> str:
                 ({**k.to_json(), "batch": int(b)} for k, b in entries),
                 key=lambda e: (e["routine"], e["m"], e["n"], e["nrhs"],
                                e["dtype"], e["tag"], e["schedule"],
-                               e["precision"], e["mesh"], e["batch"]),
+                               e["precision"], e["mesh"], e["phase"],
+                               e["batch"]),
             ),
         },
         indent=1,

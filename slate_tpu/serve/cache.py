@@ -23,7 +23,11 @@ vmapped over the leading axis (Matrix construction from the padded
 globals happens inside the trace; tile layouts are static per bucket).
 Only two batch points exist per key (1 and batch_max, see
 ``buckets.batch_bucket``), so the executable set stays bounded and
-deterministic.
+deterministic.  Solve-phase keys (the factor cache's trsm-only
+family) take the FACTOR as their first operand, unbatched:
+``fn(F: (Mb, Nb), B_batch) -> (X_batch, info_batch)`` via
+``vmap(in_axes=(None, 0))`` — one factor serves the whole coalesced
+batch without a batch-sized host copy or bb resident device copies.
 """
 
 from __future__ import annotations
@@ -89,6 +93,37 @@ def _build_core(key: BucketKey) -> Callable:
             return X[None], jnp.reshape(info, (1,))
 
         return core
+
+    if key.phase == "solve":
+        # trsm-only bucket (the factor cache's hit family): the first
+        # operand is the bucket-padded FACTOR ([[LU,0],[0,I]] with the
+        # rows of B pre-permuted on host for gesv, [[L,0],[0,I]] for
+        # posv), not A — two triangular sweeps, O(n^2 nrhs) against the
+        # full family's O(n^3).  Pure lax triangular algebra: no
+        # Matrix/tile round trip, and the exported module is custom-
+        # call-free on every backend where triangular_solve lowers
+        # natively.
+        import jax.numpy as jnp
+
+        if key.routine == "gesv":
+
+            def core(Fg, Bg):
+                X = _lu.getrs_from_global(Fg, Bg)
+                return X, jnp.zeros((), jnp.int32)
+
+            return core
+
+        if key.routine == "posv":
+
+            def core(Fg, Bg):
+                X = _chol.potrs_from_global(Fg, Bg)
+                return X, jnp.zeros((), jnp.int32)
+
+            return core
+
+        raise ValueError(
+            f"solve-phase serving supports gesv/posv, not {key.routine!r}"
+        )
 
     if key.precision == "mixed":
         # mixed-precision bucket: low-precision factor + device-resident
@@ -183,11 +218,17 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
 
 def _warm_inputs(key: BucketKey, batch: int) -> Tuple[np.ndarray, np.ndarray]:
     """Well-conditioned dummy operands for a warmup compile: identity A
-    (SPD, pivot-free, full rank) and zero B."""
+    (SPD, pivot-free, full rank — and a valid LU/Cholesky factor for
+    the solve-phase family, whose first operand is the unbatched
+    factor) and zero B."""
     dt = np.dtype(key.dtype)
-    A = np.zeros((batch, key.m, key.n), dtype=dt)
     d = min(key.m, key.n)
-    A[:, np.arange(d), np.arange(d)] = 1
+    if key.phase == "solve":
+        A = np.zeros((key.m, key.n), dtype=dt)
+        A[np.arange(d), np.arange(d)] = 1
+    else:
+        A = np.zeros((batch, key.m, key.n), dtype=dt)
+        A[:, np.arange(d), np.arange(d)] = 1
     B = np.zeros((batch, key.m, key.nrhs), dtype=dt)
     return A, B
 
@@ -301,12 +342,18 @@ class ExecutableCache:
 
     def _arg_specs(self, key: BucketKey, batch: int):
         """ShapeDtypeStructs of one executable's padded batch operands
-        (the jax.export symbol table for save/load)."""
+        (the jax.export symbol table for save/load).  Solve-phase keys
+        take the factor unbatched."""
         import jax
 
         dt = np.dtype(key.dtype)
+        A_spec = (
+            jax.ShapeDtypeStruct((key.m, key.n), dt)
+            if key.phase == "solve"
+            else jax.ShapeDtypeStruct((batch, key.m, key.n), dt)
+        )
         return (
-            jax.ShapeDtypeStruct((batch, key.m, key.n), dt),
+            A_spec,
             jax.ShapeDtypeStruct((batch, key.m, key.nrhs), dt),
         )
 
@@ -390,11 +437,14 @@ class ExecutableCache:
                 # run() always builds them fresh from the request's
                 # host arrays, so the factorizations work in place
                 # instead of paying a batch-sized copy per dispatch
-                # (XLA:CPU has no donation and would warn).
+                # (XLA:CPU has no donation and would warn).  Solve-
+                # phase cores map over B only: the factor is ONE
+                # unbatched operand shared by the whole batch.
+                in_axes = (None, 0) if key.phase == "solve" else (0, 0)
                 jit_kw = {}
                 if jax.default_backend() != "cpu":
                     jit_kw["donate_argnums"] = (0, 1)
-                jitted = jax.jit(jax.vmap(core), **jit_kw)
+                jitted = jax.jit(jax.vmap(core, in_axes=in_axes), **jit_kw)
             if self.artifacts is not None and not (
                 self.artifacts.verified_cache_seed(key, batch)
             ):
@@ -408,7 +458,8 @@ class ExecutableCache:
                 # the export attempt is a full retrace on the worker
                 # thread.
                 export_target = (
-                    jax.jit(jax.vmap(core)) if jit_kw else jitted
+                    jax.jit(jax.vmap(core, in_axes=in_axes))
+                    if jit_kw else jitted
                 )
                 self.artifacts.save(
                     key, batch, export_target, self._arg_specs(key, batch)
@@ -446,7 +497,12 @@ class ExecutableCache:
 
         faults.sleep("latency")
         faults.check("execute")
-        exe = self.executable(key, A_batch.shape[0])
+        # the batch point: the leading axis of A for the full family,
+        # of B for the solve family (whose factor operand is unbatched)
+        batch = (
+            B_batch.shape[0] if key.phase == "solve" else A_batch.shape[0]
+        )
+        exe = self.executable(key, batch)
         if device is not None and not key.mesh:
             # straight host -> replica-device transfer: an asarray first
             # would commit the batch to the default device and pay a
@@ -459,7 +515,7 @@ class ExecutableCache:
             B = jnp.asarray(B_batch)
         X, info = exe(A, B)
         with self._lock:
-            self._primed.setdefault((key, A_batch.shape[0]), set()).add(
+            self._primed.setdefault((key, batch), set()).add(
                 _device_id(None if key.mesh else device)
             )
         X = faults.corrupt("result_corrupt", np.asarray(X))

@@ -1,0 +1,532 @@
+"""Serve-level factorization cache: factor once, solve many.
+
+Real solver traffic re-uses A — one design matrix against a stream of
+right-hand sides, one preconditioner across thousands of solves — yet
+every ``serve.gesv/posv`` request pays the full O(n^3) factorization
+even when A is byte-identical to the last request.  This module is the
+Clipper-style caching layer (NSDI'17, PAPERS.md) extended from
+*predictions* to *factors*: an LRU of factorizations keyed by a matrix
+fingerprint, so a repeated-A solve costs O(n^2) — exactly the
+``getrs``/``potrs`` split (permute + trsm) SLATE makes at the driver
+layer, lifted to the serving tier.
+
+Keying
+------
+:func:`matrix_fingerprint` — sha256 over A's bytes + dtype + shape +
+routine family + factorization schedule + precision.  Any drift in any
+component is a different factor identity: an entry can never be served
+against an A it was not computed from (and the service's residual
+validation backstops even that — see ``factor_stale`` below).
+
+Entries
+-------
+A :class:`FactorEntry` holds the factor **padded to its serve bucket**
+(``[[L, 0], [0, I]]`` / ``[[LU, 0], [0, I]]`` — the exact first operand
+of the trsm-only ``phase="solve"`` bucket executable, see
+serve/buckets.py), the true dimension, the net row permutation for LU,
+and the replica lane that produced it (the service routes hits back to
+that lane so the solve dispatch lands on the device already holding
+the factor's compiled variant).
+
+Budgets & lifecycle
+-------------------
+LRU with BOTH an entry-count and a byte budget
+(``Option.ServeFactorCacheEntries`` / ``Option.ServeFactorCacheBytes``,
+or the ``SLATE_TPU_FACTOR_CACHE`` env grammar below).  Explicit
+invalidation (:meth:`FactorCache.invalidate` / ``invalidate_all`` —
+``serve.invalidate(fp)`` at the api) and rank-k up/downdate for
+incrementally-edited A (:meth:`FactorCache.update`): posv entries
+update the cached Cholesky factor in O(k n^2) via
+``ops/chol_kernels.chol_update``; LU has no comparably stable in-place
+analogue, so gesv entries fall back to a counted refactor
+(``serve.factor_cache.update_refactor``).  Eviction and invalidation
+both degrade a later hit to a counted refactor — never a wrong X.
+
+Activation
+----------
+Off by default (``Option.ServeFactorCache = False``): a service
+without a cache has ``factor_cache is None`` and the hot path pays one
+branch.  Enable per process with ``SLATE_TPU_FACTOR_CACHE=1`` (or
+``entries=64,bytes=2e9``), per service with
+``SolverService(factor_cache=FactorCache(...))``.
+
+Metrics: ``serve.factor_cache.{hit,miss,evict,invalidate,update,
+update_refactor,refactor,spill,stale}`` counters plus the
+``serve.factor_cache.bytes`` / ``.entries`` gauges — each event also
+emitted per bucket (``serve.factor_cache.<label>.<event>``) and per
+fingerprint (``serve.factor_cache.fp.<fp12>.<event>``, the
+``tools/factor_report.py`` join key).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..aux import metrics
+from .buckets import BucketKey
+
+FACTOR_CACHE_ENV = "SLATE_TPU_FACTOR_CACHE"
+
+DEFAULT_MAX_ENTRIES = 32
+DEFAULT_MAX_BYTES = 1 << 30  # 1 GiB of factors
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+
+def matrix_fingerprint(
+    A: np.ndarray,
+    routine: str,
+    schedule: str = "auto",
+    precision: str = "full",
+) -> str:
+    """sha256 hex digest of one matrix's factor identity: A's bytes +
+    dtype + shape + routine family + schedule + precision.  The
+    schedule/precision components are part of the identity because the
+    factor the cache stores was produced under them — a deployment
+    that flips Option.Schedule must refactor, not reuse."""
+    A = np.ascontiguousarray(A)
+    h = hashlib.sha256()
+    h.update(
+        f"{routine}|{np.dtype(A.dtype).name}|{A.shape[0]}x{A.shape[1]}"
+        f"|{schedule}|{precision}|".encode()
+    )
+    h.update(A.data)
+    return h.hexdigest()
+
+
+#: cardinality cap on the per-fingerprint metric family: unlike every
+#: other serve.* family (bounded by bucket labels), fp-keyed counters
+#: grow with DISTINCT matrices — a churning-A service would otherwise
+#: leak one registry key per request, forever.  Past the cap, events
+#: still count globally and per bucket; the overflow itself is counted.
+FP_METRIC_CAP = 256
+_fp_seen: set = set()
+_fp_lock = threading.Lock()
+
+
+def record(event: str, fp: Optional[str] = None,
+           label: Optional[str] = None, n: int = 1) -> None:
+    """One factor-cache event into the metrics registry: global +
+    per-bucket + per-fingerprint (12-hex prefix — the factor_report
+    join key, capped at :data:`FP_METRIC_CAP` distinct fingerprints),
+    mirroring the serve.artifact_* naming scheme."""
+    metrics.inc(f"serve.factor_cache.{event}", n)
+    if label:
+        metrics.inc(f"serve.factor_cache.{label}.{event}", n)
+    if fp:
+        fp12 = fp[:12]
+        with _fp_lock:
+            tracked = fp12 in _fp_seen
+            if not tracked and len(_fp_seen) < FP_METRIC_CAP:
+                _fp_seen.add(fp12)
+                tracked = True
+        if tracked:
+            metrics.inc(f"serve.factor_cache.fp.{fp12}.{event}", n)
+        else:
+            metrics.inc("serve.factor_cache.fp_overflow", n)
+
+
+def _fp_gauge(fp: str, value: float) -> None:
+    """Per-fingerprint bytes gauge, under the same cardinality cap."""
+    fp12 = fp[:12]
+    with _fp_lock:
+        tracked = fp12 in _fp_seen
+        if not tracked and len(_fp_seen) < FP_METRIC_CAP:
+            _fp_seen.add(fp12)
+            tracked = True
+    if tracked:
+        metrics.gauge(f"serve.factor_cache.fp.{fp12}.bytes", value)
+
+
+# ---------------------------------------------------------------------------
+# entries
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FactorEntry:
+    """One cached factorization, ready for the solve-phase executable."""
+
+    fp: str  # matrix_fingerprint of the A it was computed from
+    routine: str  # gesv | posv
+    key: BucketKey  # the FULL-phase bucket key of the request stream
+    factor: np.ndarray  # (S, S) bucket-padded factor global (LU or L)
+    perm: Optional[np.ndarray]  # (n,) forward row permutation (gesv)
+    n: int  # true dimension of A
+    replica: Optional[str] = None  # lane that factored it (device affinity)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.factor.nbytes) + (
+            int(self.perm.nbytes) if self.perm is not None else 0
+        )
+
+    @property
+    def solve_key(self) -> BucketKey:
+        return self.key.solve_sibling()
+
+
+# ---------------------------------------------------------------------------
+# factor production / direct solve-from-factor (driver entry points)
+# ---------------------------------------------------------------------------
+
+
+def factor_only(routine: str, A: np.ndarray, schedule: str = "auto"):
+    """Factor one TRUE-shape A through the drivers; returns
+    ``(factor_global, perm_or_None)``.  gesv: getrf (LU + net forward
+    row permutation, truncated to the leading n rows — the drivers'
+    identity-spliced padding guarantees partial pivoting never pulls a
+    pad row into the leading block); posv: potrf (clean lower L).
+    Raises NumericalError on a nonzero info — a failed factor is never
+    cached."""
+    from ..drivers import chol as _chol
+    from ..drivers import lu as _lu
+    from ..enums import Option, Uplo
+    from ..exceptions import NumericalError
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    n = A.shape[0]
+    nb = min(64, n)
+    opts = {Option.Schedule: schedule}
+    if routine == "gesv":
+        LU, piv, info = _lu.getrf(Matrix.from_global(A, nb), opts)
+        if int(info) != 0:
+            raise NumericalError(f"getrf: singular U({int(info)})", int(info))
+        perm = np.asarray(piv.perm)[:n].astype(np.int64)
+        if perm.size and int(perm.max()) >= n:
+            # cannot happen for the identity-spliced padded LU, but a
+            # factor whose permutation escapes the leading block could
+            # not be replayed against a bucket-padded B — refuse to
+            # cache rather than risk a wrong X
+            raise NumericalError("getrf: pivot escaped the leading block")
+        return np.asarray(LU.to_global()), perm
+    if routine == "posv":
+        L, info = _chol.potrf(
+            HermitianMatrix.from_global(A, nb, uplo=Uplo.Lower), opts
+        )
+        if int(info) != 0:
+            raise NumericalError(f"potrf: not SPD at {int(info)}", int(info))
+        return np.tril(np.asarray(L.to_global())), None
+    raise ValueError(f"factor cache supports gesv/posv, not {routine!r}")
+
+
+def solve_from_factor(entry: FactorEntry, B: np.ndarray) -> np.ndarray:
+    """Direct (unbatched, eager) trsm-only solve from a cached entry —
+    the same math as the solve-phase bucket executable, used when a
+    same-A request finds the factor mid-flight (a burst whose first
+    member just factored) and by parity checks."""
+    from ..drivers import chol as _chol
+    from ..drivers import lu as _lu
+
+    n = entry.n
+    F = entry.factor[:n, :n]
+    B = np.asarray(B)
+    if entry.routine == "gesv":
+        X = _lu.getrs_from_global(F, B[entry.perm])
+    else:
+        X = _chol.potrs_from_global(F, B)
+    return np.asarray(X)
+
+
+def residual_ok(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> bool:
+    """Normwise backward-residual check of one served solve:
+    ``max|A X - B| <= sqrt(eps) * (|A|_inf |X|_inf + |B|_inf)``.  A
+    numerically stable solve sits at ~n*eps regardless of cond(A); a
+    factor that no longer matches A (the ``factor_stale`` chaos site,
+    bit rot, a mis-applied update) lands at O(1) — orders past the
+    sqrt(eps) fence, so the hit path re-solves direct instead of
+    delivering a wrong X."""
+    if not np.all(np.isfinite(X)):
+        return False
+    dt = np.result_type(A, X)
+    eps = np.finfo(np.dtype(dt).type(0).real.dtype).eps
+    R = A @ X - B
+    scale = (
+        np.abs(A).max(initial=0.0) * np.abs(X).max(initial=0.0)
+        + np.abs(B).max(initial=0.0)
+    )
+    return float(np.abs(R).max(initial=0.0)) <= np.sqrt(eps) * max(
+        scale, eps
+    )
+
+
+# jitted rank-k Cholesky up/downdate, cached per (downdate, shape/dtype
+# via jax's own cache); downdate is a static python bool
+_update_jits: Dict[bool, object] = {}
+_update_lock = threading.Lock()
+
+
+def _chol_update_jit(downdate: bool):
+    import functools
+
+    import jax
+
+    from ..ops import chol_kernels
+
+    with _update_lock:
+        fn = _update_jits.get(bool(downdate))
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                chol_kernels.chol_update, downdate=bool(downdate)
+            ))
+            _update_jits[bool(downdate)] = fn
+        return fn
+
+
+# ---------------------------------------------------------------------------
+# the cache
+# ---------------------------------------------------------------------------
+
+
+class FactorCache:
+    """LRU factor cache with an entry-count and a byte budget.
+    Thread-safe (admission and every replica worker touch it); all
+    bookkeeping is O(1) per operation plus the eviction walk."""
+
+    def __init__(
+        self,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+    ):
+        self.max_entries = max(int(max_entries), 1)
+        self.max_bytes = max(int(max_bytes), 1)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()
+        self._bytes = 0
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def fingerprints(self) -> list:
+        """Cached fingerprints, least-recently-used first."""
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+            }
+
+    def _gauges_locked(self) -> None:
+        metrics.gauge("serve.factor_cache.bytes", self._bytes)
+        metrics.gauge("serve.factor_cache.entries", len(self._entries))
+
+    # -- core --------------------------------------------------------------
+
+    def get(self, fp: str) -> Optional[FactorEntry]:
+        """The entry for one fingerprint (refreshing its LRU position),
+        or None.  Does NOT count hit/miss — the service counts those at
+        the dispatch that actually serves (or misses) the factor."""
+        with self._lock:
+            entry = self._entries.get(fp)
+            if entry is not None:
+                self._entries.move_to_end(fp)
+            return entry
+
+    def put(self, entry: FactorEntry, replica: Optional[str] = None) -> bool:
+        """Insert (or refresh) one entry, evicting LRU entries past
+        either budget.  Returns False when the entry ALONE exceeds the
+        byte budget (uncacheable — counted, never stored: a later
+        repeat of that A refactors, which is the budget doing its
+        job)."""
+        if replica is not None:
+            entry.replica = replica
+        if entry.nbytes > self.max_bytes:
+            record("uncacheable", fp=entry.fp, label=entry.key.label)
+            return False
+        with self._lock:
+            old = self._entries.pop(entry.fp, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[entry.fp] = entry
+            self._bytes += entry.nbytes
+            while self._entries and (
+                len(self._entries) > self.max_entries
+                or self._bytes > self.max_bytes
+            ):
+                vfp, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                record("evict", fp=vfp, label=victim.key.label)
+                _fp_gauge(vfp, 0)
+            if entry.fp in self._entries:
+                _fp_gauge(entry.fp, entry.nbytes)
+            self._gauges_locked()
+            return entry.fp in self._entries
+
+    def invalidate(self, fp: str) -> bool:
+        """Drop one fingerprint's factor; the next same-A request pays
+        a counted refactor.  Returns whether it was present."""
+        with self._lock:
+            entry = self._entries.pop(fp, None)
+            if entry is None:
+                return False
+            self._bytes -= entry.nbytes
+            record("invalidate", fp=fp, label=entry.key.label)
+            _fp_gauge(fp, 0)
+            self._gauges_locked()
+            return True
+
+    def invalidate_all(self) -> int:
+        """Drop every factor; returns the count dropped."""
+        with self._lock:
+            n = len(self._entries)
+            for fp, entry in self._entries.items():
+                record("invalidate", fp=fp, label=entry.key.label)
+                _fp_gauge(fp, 0)
+            self._entries.clear()
+            self._bytes = 0
+            self._gauges_locked()
+            return n
+
+    # -- rank-k up/downdate ------------------------------------------------
+
+    def update(
+        self,
+        fp: str,
+        A_new: np.ndarray,
+        U: np.ndarray,
+        downdate: bool = False,
+    ) -> Optional[str]:
+        """Re-key one entry to an incrementally-edited A:
+        ``A_new = A ± U U^H`` (update / downdate, U of shape (n, k) or
+        (n,)).  posv entries apply the O(k n^2) Cholesky up/downdate
+        kernel to the cached factor; gesv entries — and any posv
+        up/downdate that breaks down (a downdate past positive
+        definiteness) — fall back to a full refactor of ``A_new``
+        (``serve.factor_cache.update_refactor``).  Either way the
+        entry is re-keyed to ``matrix_fingerprint(A_new)``, so the
+        caller's next ``submit(A_new, B)`` hits.  Returns the new
+        fingerprint, or None when ``fp`` is not cached (the caller
+        should just submit A_new and let the miss path factor it)."""
+        with self._lock:
+            entry = self._entries.pop(fp, None)
+            if entry is not None:
+                self._bytes -= entry.nbytes
+        if entry is None:
+            return None
+        A_new = np.ascontiguousarray(A_new)
+        if A_new.shape[0] != entry.n:
+            # a different-size A is a different problem, not an update
+            self.put(entry)  # put the untouched entry back
+            raise ValueError(
+                f"update: A_new is {A_new.shape[0]}x{A_new.shape[1]}, "
+                f"entry holds n={entry.n}"
+            )
+        new_fp = matrix_fingerprint(
+            A_new, entry.routine, schedule=entry.key.schedule,
+            precision=entry.key.precision,
+        )
+        factor = None
+        perm = entry.perm
+        if entry.routine == "posv":
+            U2 = np.asarray(U, dtype=entry.factor.dtype)
+            if U2.ndim == 1:
+                U2 = U2[:, None]
+            S = entry.factor.shape[0]
+            Up = np.zeros((S, U2.shape[1]), dtype=entry.factor.dtype)
+            Up[: entry.n] = U2  # pad rows untouched: I stays I
+            F = np.asarray(_chol_update_jit(bool(downdate))(
+                entry.factor, Up
+            ))
+            if np.all(np.isfinite(F)):
+                factor = F
+                record("update", fp=new_fp, label=entry.key.label)
+            # non-finite = downdate breakdown (A_new not SPD under the
+            # cached factor's rounding): refactor from A_new below
+        if factor is None:
+            from .buckets import pad_square
+
+            raw, perm = factor_only(
+                entry.routine, A_new, schedule=entry.key.schedule
+            )
+            factor = pad_square(raw, entry.factor.shape[0])
+            record("update", fp=new_fp, label=entry.key.label)
+            record("update_refactor", fp=new_fp, label=entry.key.label)
+        new_entry = FactorEntry(
+            fp=new_fp, routine=entry.routine, key=entry.key,
+            factor=factor, perm=perm, n=entry.n, replica=entry.replica,
+        )
+        self.put(new_entry)
+        return new_fp
+
+
+# ---------------------------------------------------------------------------
+# env/options activation: SLATE_TPU_FACTOR_CACHE=1 | entries=N,bytes=M
+# ---------------------------------------------------------------------------
+
+
+def parse_env_spec(spec: str) -> Optional[dict]:
+    """Parse the ``SLATE_TPU_FACTOR_CACHE`` grammar: empty/``0``/``off``
+    -> None (disabled), ``1``/``on`` -> enabled with defaults, or a
+    comma list of ``entries=<int>`` / ``bytes=<float>`` overrides."""
+    spec = (spec or "").strip()
+    if not spec or spec.lower() in ("0", "off", "false", "no"):
+        return None
+    if spec.lower() in ("1", "on", "true", "yes"):
+        return {}
+    out: dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        k, sep, v = item.partition("=")
+        k, v = k.strip().lower(), v.strip()
+        if not sep:
+            raise ValueError(
+                f"{FACTOR_CACHE_ENV}={spec!r}: expected k=v, got {item!r}"
+            )
+        if k == "entries":
+            out["max_entries"] = int(v)
+        elif k == "bytes":
+            out["max_bytes"] = int(float(v))
+        else:
+            raise ValueError(
+                f"{FACTOR_CACHE_ENV}={spec!r}: unknown key {k!r} "
+                "(entries|bytes)"
+            )
+    return out
+
+
+def cache_from_options(opts=None) -> Optional[FactorCache]:
+    """Resolve the process/service default: ``SLATE_TPU_FACTOR_CACHE``
+    wins (env grammar above), else ``Option.ServeFactorCache`` with the
+    ``ServeFactorCacheEntries`` / ``ServeFactorCacheBytes`` budgets.
+    None = disabled — the service hot path stays one branch."""
+    from ..enums import Option
+    from ..options import get_option
+
+    kw = parse_env_spec(os.environ.get(FACTOR_CACHE_ENV, ""))
+    if kw is None:
+        if not bool(get_option(opts, Option.ServeFactorCache)):
+            return None
+        kw = {}
+    kw.setdefault(
+        "max_entries",
+        int(get_option(opts, Option.ServeFactorCacheEntries)),
+    )
+    kw.setdefault(
+        "max_bytes", int(get_option(opts, Option.ServeFactorCacheBytes))
+    )
+    return FactorCache(**kw)

@@ -121,7 +121,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -129,6 +129,16 @@ from ..aux import faults, metrics, spans
 from ..exceptions import InvalidInput, NumericalError, SlateError
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
+from .factor_cache import (
+    FactorCache,
+    FactorEntry,
+    cache_from_options,
+    factor_only,
+    matrix_fingerprint,
+    residual_ok,
+    solve_from_factor,
+)
+from .factor_cache import record as _fc_record
 from .placement import PlacementPolicy
 
 
@@ -181,6 +191,11 @@ class _Request:
     backoff_s: float = 0.0  # last backoff delay (decorrelated jitter state)
     not_before: float = 0.0  # monotonic eligibility time after a retry
     t_submit: float = field(default_factory=time.monotonic)
+    # factor-cache state (both None/False when the cache is off):
+    # the matrix fingerprint of A, and whether admission missed (the
+    # request factors via _factor_direct instead of the batched path)
+    factor_fp: Optional[str] = None
+    factor_miss: bool = False
     # request-scoped tracing (aux/spans; all None when tracing is off):
     # trace id, root "request" span (admit -> deliver), live "queued" span
     trace: Optional[str] = None
@@ -270,6 +285,27 @@ class SolverService:
         (1 replica, no mesh) reproduces the single-worker service.
     replicas: shorthand override for ``placement.replicas`` when no
         explicit policy is passed.
+    factor_cache: :class:`~slate_tpu.serve.factor_cache.FactorCache`
+        for factor-once/solve-many traffic.  None (default) resolves
+        from ``SLATE_TPU_FACTOR_CACHE`` / ``Option.ServeFactorCache*``
+        — disabled by default, leaving every path byte-identical to
+        the cache-less service (one ``is None`` branch at admission);
+        ``False`` disables it explicitly, overriding the env (for
+        baseline / A-B services).
+        When enabled: gesv/posv full-precision single-device requests
+        are fingerprinted at admission; a hit dispatches the trsm-only
+        ``phase="solve"`` bucket executable against the cached factor
+        on the replica that owns it (when that lane's breaker is open
+        the request SPILLS off the batched solve executable — counted
+        ``serve.factor_cache.spill`` — onto the direct factor path,
+        which reuses the still-healthy factor or refactors if it is
+        gone), a miss factors ONCE through
+        the direct drivers, caches the factor, and registers the solve
+        bucket in the warmup manifest so the steady state is warmed,
+        batched, and compile-free.  Every hit is residual-validated —
+        a factor that no longer matches A (the ``factor_stale`` chaos
+        site) is dropped and the request re-solved direct, never a
+        wrong X.
     faults_spec: aux/faults grammar string; arms + enables injection
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
@@ -302,6 +338,7 @@ class SolverService:
         precision: Optional[str] = None,
         placement: Optional[PlacementPolicy] = None,
         replicas: Optional[int] = None,
+        factor_cache: Union[FactorCache, bool, None] = None,
         faults_spec: Optional[str] = None,
         restore_on_start: Optional[bool] = None,
         start: bool = True,
@@ -358,6 +395,16 @@ class SolverService:
         self.placement = (
             placement if placement is not None
             else PlacementPolicy.from_options(replicas=replicas)
+        )
+        # factor cache: default OFF (cache_from_options returns None
+        # unless the env/options enable it) — the hot path then pays
+        # exactly one `is None` branch per admission.  ``False`` is
+        # the explicit off-switch: it wins over SLATE_TPU_FACTOR_CACHE
+        # (a baseline/AB service must be able to opt out of the env)
+        self.factor_cache = (
+            None if factor_cache is False
+            else factor_cache if factor_cache is not None
+            else cache_from_options()
         )
         if self.placement.mesh:
             # fail FAST, and against the SAME device pool the sharded
@@ -725,12 +772,37 @@ class SolverService:
                 floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
                 schedule=self.schedule, precision=prec, mesh=mesh,
             )
+        # factor cache (ONE branch when disabled): fingerprint eligible
+        # requests, classify hit (dispatch the trsm-only solve bucket
+        # against the cached factor) vs miss (factor once via
+        # _factor_direct, then cache)
+        fc = self.factor_cache
+        fp: Optional[str] = None
+        hit: Optional[FactorEntry] = None
+        full_key = key
+        if (
+            fc is not None and key is not None and not key.mesh
+            and prec == "full" and routine in ("gesv", "posv")
+        ):
+            fp = matrix_fingerprint(
+                A, routine, schedule=self.schedule, precision=prec
+            )
+            hit = fc.get(fp)
+            if hit is not None:
+                # the REQUEST's solve bucket, not the entry's: a same-A
+                # request with a different nrhs bucket must dispatch at
+                # its own shape (the factor pad depends only on n, so
+                # the cached factor fits every sibling)
+                key = full_key.solve_sibling()
+            else:
+                _fc_record("miss", fp=fp, label=key.label)
         req = _Request(
             routine=routine, key=key, A=A, B=B, m=m, n=n, nrhs=nrhs,
             deadline=(
                 time.monotonic() + deadline if deadline is not None else None
             ),
             retries=int(retries),
+            factor_fp=fp, factor_miss=bool(fp is not None and hit is None),
             trace=_trace, span=_root,
         )
         if _root is not None:
@@ -757,6 +829,33 @@ class SolverService:
                 rep = self._shard_rep
             else:
                 rep = self._pick_replica_locked(key)
+                if hit is not None:
+                    # factors are device-pinned: route the hit to the
+                    # lane whose device already holds the factor's
+                    # compiled variant — unless that lane's breaker for
+                    # the solve bucket is cooling down, in which case
+                    # the request SPILLS off the batched solve
+                    # executable (counted) onto the direct factor path
+                    # of the selected healthy lane, which still reuses
+                    # the healthy factor (residual-fenced) or refactors
+                    # if it is gone — never a dispatch into a
+                    # known-sick path, never a wrong X
+                    own = next(
+                        (r for r in self._replicas
+                         if r.name == hit.replica), None
+                    )
+                    if own is not None:
+                        b = own.breakers.get(key)
+                        if b is not None and b.cooling_down(
+                            time.monotonic(), self.breaker_cooldown_s
+                        ):
+                            _fc_record(
+                                "spill", fp=fp, label=full_key.label
+                            )
+                            req.key = key = full_key
+                            req.factor_miss = True
+                        else:
+                            rep = own
             if _root is not None:
                 req.qspan = spans.start(
                     "queued", trace=_trace, parent=_root, lane=rep.lane,
@@ -898,6 +997,10 @@ class SolverService:
             "sharded": shard_lane,
             "latency": latency,
             "slo_burn": slo_burn,
+            "factor_cache": (
+                self.factor_cache.stats()
+                if self.factor_cache is not None else None
+            ),
             "failures_60s": len(recent),
             "failure_rate_60s": len(recent) / window_s,
             "uptime_s": now - self._t_started,
@@ -1027,7 +1130,8 @@ class SolverService:
             with self._cond:
                 now = time.monotonic()
                 if not any(
-                    r.key == first.key and r.not_before <= now
+                    r.key == first.key and r.factor_fp == first.factor_fp
+                    and r.not_before <= now
                     for r in rep.q
                 ):
                     self._cond.wait(self.batch_window_s)
@@ -1037,7 +1141,16 @@ class SolverService:
             now = time.monotonic()
             while rep.q and len(batch) < self.batch_max:
                 r = rep.q.popleft()
-                if r.key == first.key and r.not_before <= now:
+                # factor-cache requests additionally match on the
+                # matrix fingerprint: a solve-phase batch shares ONE
+                # factor operand, and a miss batch must not mix
+                # different A's (factor_fp is None for everything else
+                # — plain traffic coalesces exactly as before)
+                if (
+                    r.key == first.key
+                    and r.factor_fp == first.factor_fp
+                    and r.not_before <= now
+                ):
                     batch.append(r)
                 else:
                     keep.append(r)
@@ -1106,6 +1219,14 @@ class SolverService:
             for r in batch:
                 self._direct(r)
             return
+        if batch[0].factor_miss:
+            # factor-cache miss: factor ONCE through the drivers — the
+            # factor is the product being cached, and the batched full
+            # executable discards it — solve, cache, and register the
+            # solve bucket in the warmup manifest for the hits to come
+            for r in batch:
+                self._factor_direct(rep, r)
+            return
         br = self._breaker(rep, key)
         if br.state == _bk.BREAKER_OPEN:
             if br.try_half_open(time.monotonic(), self.breaker_cooldown_s):
@@ -1146,6 +1267,12 @@ class SolverService:
                 metrics.inc("serve.degraded")
                 spans.event("breaker_open", trace=batch[0].trace,
                             lane=rep.lane, bucket=key.label, corrupt=True)
+        elif corrupt is None:
+            # the batched path never executed (a solve batch whose
+            # factor was evicted in flight, demoted item-by-item):
+            # neither success nor failure — a half-open probe stays
+            # pending for the next real dispatch
+            pass
         elif br.record_success():
             metrics.inc("serve.breaker_closed")  # half-open probe healed
             metrics.inc(f"serve.replica.{rep.name}.breaker_closed")
@@ -1200,6 +1327,8 @@ class SolverService:
         items (a garbage batch is a breaker failure, not a success —
         nonzero ``info`` is NOT corruption: it is a numerical property
         of the input, no fault of the batched path)."""
+        if key.phase == "solve":
+            return self._execute_solve_batched(rep, key, batch)
         if key.mesh:
             # sharded buckets have one batch point: the executable is
             # the spmd program, not a vmap
@@ -1305,6 +1434,217 @@ class SolverService:
             metrics.inc("serve.batched")
             metrics.inc("serve.batched_requests", len(batch))
         return deliver, corrupt
+
+    def _execute_solve_batched(
+        self, rep: _Replica, key: _bk.BucketKey, batch: List[_Request]
+    ):
+        """The factor-cache hit path: run one trsm-only batch against
+        the cached factor (same-fingerprint requests only — the
+        coalescer guarantees it); returns ``(deliver, corrupt)`` with
+        the same contract as :meth:`_execute_batched`.
+
+        Every delivered item is residual-validated: a finite-but-wrong
+        X (the ``factor_stale`` chaos site, a mis-applied update, bit
+        rot in the cached factor) drops the factor and re-solves via
+        the factor path — ``serve.factor_cache.stale`` — while a
+        non-finite X keeps the full path's corrupt-result contract
+        (breaker failure + direct re-solve; the executable, not the
+        factor, is implicated).  An entry evicted or invalidated
+        between admission and dispatch demotes every item to a counted
+        refactor (``serve.factor_cache.refactor``) — never a wrong X.
+        """
+        fc = self.factor_cache
+        entry = fc.get(batch[0].factor_fp) if fc is not None else None
+        if entry is None:
+            # corrupt=None: the solve executable never ran, so the
+            # caller must NOT treat this as a batched-path success — a
+            # half-open breaker's probe stays pending (record_success
+            # here would close it without the suspect path ever
+            # executing)
+            deliver = []
+            for r in batch:
+                _fc_record("refactor", fp=r.factor_fp)
+                deliver.append(functools.partial(self._factor_direct, rep, r))
+            return deliver, None
+        self.cache.ensure_manifest(key, (1, self.batch_max))
+        bb = _bk.batch_bucket(len(batch), self.batch_max)
+        F = np.asarray(entry.factor)
+        if faults.is_on():
+            # factor_stale: serve a factor whose fingerprint silently
+            # no longer matches A — finite, wrong, and caught only by
+            # the residual validation below
+            F = faults.perturb("factor_stale", F)
+        Bs = []
+        for r in batch:
+            B = np.asarray(r.B)
+            if entry.perm is not None:
+                B = B[entry.perm]  # P B on host: the gather is free
+            Bs.append(_bk.pad_rhs(B, key.m, key.nrhs))
+        while len(Bs) < bb:  # repeat-pad to the fixed batch point
+            Bs.append(Bs[0])
+            metrics.inc("serve.batch_pad")
+        # the factor rides UNBATCHED (the solve executable maps over B
+        # only): no bb-sized host copy, no bb resident device copies
+        B_b = np.stack(Bs)
+        t_exec = time.monotonic()
+        t_exec_pc = spans.now() if spans.is_on() else 0.0
+        if rep.device is not None:
+            X_b, _info_b = self.cache.run(key, F, B_b, device=rep.device)
+        else:
+            X_b, _info_b = self.cache.run(key, F, B_b)
+        now = time.monotonic()
+        exec_s = now - t_exec
+        mon = metrics.is_on()
+        if mon:
+            with self._cond:
+                self._seen_labels.add(key.label)
+        if spans.is_on():
+            t1_pc = spans.now()
+            for r in batch:
+                if r.trace is not None:
+                    spans.record(
+                        "execute", t_exec_pc, t1_pc, trace=r.trace,
+                        parent=r.span, lane=rep.lane, bucket=key.label,
+                        batch=len(batch), factor_hit=True,
+                    )
+        deliver = []
+        corrupt = 0
+        stale = False
+        for i, r in enumerate(batch):
+            metrics.inc(
+                "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
+            )
+            if mon:
+                # the trsm-only half of the latency story: the solve
+                # bucket label carries the ".solve" suffix, so these
+                # land in serve.latency.<bucket>.solve.{execute,total}
+                metrics.observe_hist(
+                    f"serve.latency.{key.label}.execute", exec_s
+                )
+            X = _bk.crop_result(key, X_b[i], r.n, r.nrhs)
+            late = r.deadline is not None and now > r.deadline
+            if not np.all(np.isfinite(X)):
+                # corrupted executable result (result_corrupt site /
+                # bad kernel): identical contract to the full path —
+                # breaker failure + direct re-solve; the cached factor
+                # is not implicated
+                inputs_ok = self.validate or (
+                    np.all(np.isfinite(r.A)) and np.all(np.isfinite(r.B))
+                )
+                if inputs_ok:
+                    metrics.inc("serve.corrupt_result")
+                    self._note_failure()
+                    corrupt += 1
+                deliver.append(functools.partial(self._direct, r))
+                continue
+            if not residual_ok(r.A, r.B, X):
+                # finite but WRONG: the factor no longer matches A —
+                # drop it and re-solve through the factor path (which
+                # refactors and re-caches a fresh entry)
+                _fc_record("stale", fp=entry.fp, label=entry.key.label)
+                stale = True
+                deliver.append(functools.partial(self._factor_direct, rep, r))
+                continue
+            _fc_record("hit", fp=entry.fp, label=entry.key.label)
+            if r.span is not None and spans.is_on():
+                spans.annotate(r.span, factor_hit=True)
+            if late:
+                self._miss_late()
+            if mon:
+                self._observe_total(rep, key.label, r, now)
+            deliver.append(functools.partial(_resolve, r.future, X, r))
+        if stale and fc is not None:
+            fc.invalidate(entry.fp)
+        if len(batch) > 1:
+            metrics.inc("serve.batched")
+            metrics.inc("serve.batched_requests", len(batch))
+        return deliver, corrupt
+
+    def _factor_direct(self, rep: Optional[_Replica], req: _Request) -> None:
+        """The factor-cache miss/refactor path: one direct driver
+        factorization whose factor is CAPTURED (padded to the bucket,
+        cached, its solve bucket registered in the warmup manifest) and
+        whose solve is the trsm-only sweep from those factors — the
+        request pays O(n^3) exactly once per distinct A.  Re-checks the
+        cache first: in a same-A burst the first member factors and the
+        rest find the entry mid-flight (counted hits, trsm-only)."""
+        fc = self.factor_cache
+        fp = req.factor_fp
+        fkey = req.key
+        if fkey is not None and fkey.phase != "full":
+            import dataclasses
+
+            fkey = dataclasses.replace(fkey, phase="full")
+        entry = fc.get(fp) if (fc is not None and fp) else None
+        cm = (
+            spans.span("factor", trace=req.trace, parent=req.span,
+                       routine=req.routine)
+            if req.trace is not None and spans.is_on()
+            else contextlib.nullcontext()
+        )
+        try:
+            with cm:
+                with metrics.phase(f"serve.factor.{req.routine}"):
+                    faults.sleep("latency")
+                    faults.check("execute")
+                    X = None
+                    if entry is not None:
+                        # the factor landed while this request was
+                        # queued (same-A burst) or the request spilled
+                        # here off a cooling lane: trsm-only, a hit —
+                        # under the SAME residual fence as the batched
+                        # hit path ("never a wrong X" admits no side
+                        # door; a mis-keyed update would slip through
+                        # here otherwise)
+                        X = solve_from_factor(entry, req.B)
+                        if residual_ok(req.A, req.B, X):
+                            _fc_record("hit", fp=fp, label=entry.key.label)
+                            spans.annotate(factor_hit=True)
+                        else:
+                            _fc_record(
+                                "stale", fp=fp, label=entry.key.label
+                            )
+                            fc.invalidate(fp)
+                            entry, X = None, None
+                    if entry is None:
+                        raw, perm = factor_only(
+                            req.routine, req.A, schedule=self.schedule
+                        )
+                        entry = FactorEntry(
+                            fp=fp, routine=req.routine, key=fkey,
+                            factor=_bk.pad_square(raw, fkey.n), perm=perm,
+                            n=req.n,
+                        )
+                        if fc is not None and fp:
+                            fc.put(
+                                entry,
+                                replica=rep.name if rep is not None else None,
+                            )
+                            # the hits to come ride the warmed manifest:
+                            # register the solve bucket NOW so the next
+                            # warmup()/restore() precompiles it
+                            self.cache.ensure_manifest(
+                                entry.solve_key, (1, self.batch_max)
+                            )
+                        X = solve_from_factor(entry, req.B)
+                spans.annotate(outcome="ok")
+        except Exception as e:  # noqa: BLE001 — futures carry the error
+            _resolve_exc(req.future, e, req=req)
+            return
+        now = time.monotonic()
+        if req.deadline is not None and now > req.deadline:
+            self._miss_late()
+        if metrics.is_on():
+            # observe total under the DISPATCH key's label (req.key:
+            # the full label for misses, the .solve label for items
+            # demoted off a solve batch) so it pairs with the queued
+            # observation _execute made under the same label — the
+            # subtraction premise of tools/latency_report.py
+            lbl = self._lat_label(req)
+            with self._cond:
+                self._seen_labels.add(lbl)
+            self._observe_total(rep, lbl, req, now)
+        _resolve(req.future, X, req)
 
     @staticmethod
     def _lat_label(req: _Request) -> str:

@@ -1,0 +1,595 @@
+"""Factor-cache tests: slate_tpu/serve/factor_cache + the solve-phase
+(trsm-only) bucket family + the chol up/downdate kernel.
+
+A module-scoped ExecutableCache is shared across service tests so each
+(bucket, batch) executable compiles once for the whole file (the
+test_serve pattern); services are built per test against small bucket
+floors.  The ISSUE acceptance stream (1 factorization + >= 20 warmed
+same-A solves, hit >= 19, 0 compiles, parity, eviction + invalidation
+fallbacks) lives here; the <= 10% solve-vs-full executable-cost
+criterion is asserted through the schedule-accounting mirror
+(``buckets.phase_flops``) at the production bucket shapes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import (
+    FactorCache,
+    FactorEntry,
+    factor_only,
+    matrix_fingerprint,
+    parse_env_spec,
+    residual_ok,
+    solve_from_factor,
+)
+from slate_tpu.serve.service import SolverService
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _svc(shared_cache, **kw):
+    kw.setdefault("batch_max", 4)
+    kw.setdefault("batch_window_s", 0.002)
+    kw.setdefault("dim_floor", FLOOR)
+    kw.setdefault("nrhs_floor", NRHS_FLOOR)
+    return SolverService(cache=shared_cache, **kw)
+
+
+def _gesv_prob(n, seed=0, nrhs=2):
+    r = np.random.default_rng(seed)
+    return (r.standard_normal((n, n)) + n * np.eye(n),
+            r.standard_normal((n, nrhs)))
+
+
+def _posv_prob(n, seed=0, nrhs=2):
+    r = np.random.default_rng(seed)
+    G = r.standard_normal((n, n))
+    return G @ G.T + n * np.eye(n), r.standard_normal((n, nrhs))
+
+
+# ---------------------------------------------------------------------------
+# BucketKey.phase
+# ---------------------------------------------------------------------------
+
+
+def test_phase_label_and_roundtrip():
+    k = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                      nrhs_floor=NRHS_FLOOR)
+    assert k.phase == "full" and not k.label.endswith(".solve")
+    s = k.solve_sibling()
+    assert s.phase == "solve" and s.label == k.label + ".solve"
+    assert s != k
+    assert bk.BucketKey.from_json(s.to_json()) == s
+    # manifest round-trip keeps both phases distinct
+    text = bk.manifest_dumps([(k, 1), (s, 1), (s, 4)])
+    back = bk.manifest_loads(text)
+    assert (k, 1) in back and (s, 1) in back and (s, 4) in back
+
+
+def test_legacy_manifest_defaults_phase_full():
+    e = {"routine": "gesv", "m": 16, "n": 16, "nrhs": 4,
+         "dtype": "float64", "nb": 16, "tag": "", "batch": 1}
+    k = bk.BucketKey.from_json(e)
+    assert k.phase == "full"
+    assert "phase" in k.to_json()  # re-serializes canonically
+
+
+def test_bucket_for_phase_validation():
+    kw = dict(floor=FLOOR, nrhs_floor=NRHS_FLOOR)
+    with pytest.raises(ValueError):
+        bk.bucket_for("gels", 32, 16, 2, np.float64, phase="solve", **kw)
+    with pytest.raises(ValueError):
+        bk.bucket_for("gesv", 16, 16, 2, np.float64, phase="solve",
+                      precision="mixed", **kw)
+    with pytest.raises(ValueError):
+        bk.bucket_for("gesv", 16, 16, 2, np.float64, phase="solve",
+                      mesh="2x2", **kw)
+    with pytest.raises(ValueError):
+        bk.bucket_for("gesv", 16, 16, 2, np.float64, phase="nope", **kw)
+
+
+def test_phase_flops_solve_under_10pct():
+    """The ISSUE acceptance cost criterion via the accounting mirror:
+    at the production bucket shapes the trsm-only executable models
+    <= 10% of its full-phase sibling's FLOPs."""
+    for routine, n, nrhs in (("gesv", 256, 8), ("gesv", 512, 8),
+                             ("posv", 512, 8), ("gesv", 2048, 8)):
+        k = bk.bucket_for(routine, n, n, nrhs, np.float64)
+        full = bk.phase_flops(k)
+        solve = bk.phase_flops(k.solve_sibling())
+        assert solve <= 0.10 * full, (routine, n, solve / full)
+        # batch scaling is linear on both
+        assert bk.phase_flops(k, 4) == pytest.approx(4 * full)
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+
+def test_matrix_fingerprint_sensitivity():
+    A = np.arange(16.0).reshape(4, 4)
+    fp = matrix_fingerprint(A, "gesv")
+    assert fp == matrix_fingerprint(A.copy(), "gesv")  # bytes, not id
+    A2 = A.copy()
+    A2[0, 0] = 1.0
+    assert matrix_fingerprint(A2, "gesv") != fp  # any byte drift rekeys
+    assert matrix_fingerprint(A, "posv") != fp
+    assert matrix_fingerprint(A.astype(np.float32), "gesv") != fp
+    assert matrix_fingerprint(A, "gesv", schedule="recursive") != fp
+    # non-contiguous views hash their logical bytes
+    F = np.asfortranarray(A)
+    assert matrix_fingerprint(F, "gesv") == fp
+
+
+def test_parse_env_spec():
+    assert parse_env_spec("") is None
+    assert parse_env_spec("0") is None
+    assert parse_env_spec("off") is None
+    assert parse_env_spec("1") == {}
+    assert parse_env_spec("entries=8,bytes=2e6") == {
+        "max_entries": 8, "max_bytes": 2_000_000
+    }
+    with pytest.raises(ValueError):
+        parse_env_spec("entries")
+    with pytest.raises(ValueError):
+        parse_env_spec("nope=3")
+
+
+# ---------------------------------------------------------------------------
+# FactorCache unit (no service, no jax dispatch)
+# ---------------------------------------------------------------------------
+
+
+def _entry(fp, n=4, routine="gesv", S=16):
+    key = bk.bucket_for(routine, n, n, 2, np.float64, floor=S,
+                        nrhs_floor=NRHS_FLOOR)
+    F = np.eye(S)
+    perm = np.arange(n, dtype=np.int64) if routine == "gesv" else None
+    return FactorEntry(fp=fp, routine=routine, key=key, factor=F,
+                       perm=perm, n=n)
+
+
+def test_lru_entry_budget_eviction():
+    fc = FactorCache(max_entries=2, max_bytes=1 << 30)
+    assert fc.put(_entry("a" * 64)) and fc.put(_entry("b" * 64))
+    assert fc.get("a" * 64) is not None  # refresh: "b" becomes LRU
+    fc.put(_entry("c" * 64))
+    assert fc.get("b" * 64) is None and fc.get("a" * 64) is not None
+    assert metrics.counters().get("serve.factor_cache.evict") == 1
+    assert len(fc) == 2
+
+
+def test_byte_budget_eviction_and_uncacheable():
+    one = _entry("a" * 64).nbytes
+    fc = FactorCache(max_entries=100, max_bytes=int(one * 2.5))
+    for c in "abc":
+        fc.put(_entry(c * 64))
+    assert len(fc) == 2 and fc.bytes <= fc.max_bytes
+    assert fc.get("a" * 64) is None  # LRU paid the byte budget
+    # an entry that alone exceeds the budget is never stored
+    big = FactorCache(max_entries=4, max_bytes=one - 1)
+    assert big.put(_entry("d" * 64)) is False
+    assert len(big) == 0
+    assert metrics.counters().get("serve.factor_cache.uncacheable") == 1
+
+
+def test_invalidate_and_invalidate_all():
+    fc = FactorCache(max_entries=8)
+    fc.put(_entry("a" * 64))
+    fc.put(_entry("b" * 64))
+    assert fc.invalidate("a" * 64) is True
+    assert fc.invalidate("a" * 64) is False  # already gone
+    assert fc.invalidate_all() == 1
+    assert len(fc) == 0 and fc.bytes == 0
+    c = metrics.counters()
+    assert c.get("serve.factor_cache.invalidate") == 2
+
+
+# ---------------------------------------------------------------------------
+# chol up/downdate kernel
+# ---------------------------------------------------------------------------
+
+
+def _chol(A):
+    return np.linalg.cholesky(A)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_chol_rank1_update_matches_refactor(dtype, rng):
+    from slate_tpu.ops.chol_kernels import chol_rank1_update
+
+    n = 24
+    G = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        G = G + 1j * rng.standard_normal((n, n))
+    A = G @ np.conj(G).T + n * np.eye(n, dtype=dtype)
+    u = rng.standard_normal(n).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        u = u + 1j * rng.standard_normal(n)
+    L = _chol(A)
+    L1 = np.asarray(chol_rank1_update(L, u))
+    ref = _chol(A + np.outer(u, np.conj(u)))
+    assert np.abs(L1 - ref).max() < 1e-10
+
+
+def test_chol_update_rank2_and_downdate(rng):
+    from slate_tpu.ops.chol_kernels import chol_update
+
+    n = 20
+    G = rng.standard_normal((n, n))
+    A = G @ G.T + n * np.eye(n)
+    U = rng.standard_normal((n, 2))
+    L = _chol(A)
+    up = np.asarray(chol_update(L, U))
+    assert np.abs(up - _chol(A + U @ U.T)).max() < 1e-10
+    # downdate back: recover the original factor
+    down = np.asarray(chol_update(up, U, downdate=True))
+    assert np.abs(down - L).max() < 1e-8
+
+
+def test_chol_downdate_breakdown_yields_nan(rng):
+    from slate_tpu.ops.chol_kernels import chol_rank1_update
+
+    n = 8
+    A = np.eye(n)
+    u = np.zeros(n)
+    u[0] = 2.0  # A - u u^T is indefinite
+    L = _chol(A)
+    out = np.asarray(chol_rank1_update(L, u, downdate=True))
+    assert not np.all(np.isfinite(out))
+
+
+# ---------------------------------------------------------------------------
+# factor production + residual fence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("routine", ["gesv", "posv"])
+def test_factor_only_and_solve_from_factor(routine, rng):
+    n = 12
+    A, B = (_gesv_prob if routine == "gesv" else _posv_prob)(n, seed=3)
+    F, perm = factor_only(routine, A)
+    assert (perm is None) == (routine == "posv")
+    key = bk.bucket_for(routine, n, n, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    entry = FactorEntry(fp="x" * 64, routine=routine, key=key,
+                        factor=bk.pad_square(F, key.n), perm=perm, n=n)
+    X = solve_from_factor(entry, B)
+    ref = np.linalg.solve(A, B)
+    assert np.abs(X - ref).max() < 1e-9
+    assert residual_ok(A, B, X)
+    assert not residual_ok(A, B, X + 0.1)  # wrong X trips the fence
+    assert not residual_ok(A, B, X * np.nan)
+
+
+def test_update_posv_rekeys_and_matches(rng):
+    n = 12
+    A, B = _posv_prob(n, seed=4)
+    fc = FactorCache(max_entries=4)
+    F, _ = factor_only("posv", A)
+    key = bk.bucket_for("posv", n, n, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    fp = matrix_fingerprint(A, "posv", schedule=key.schedule)
+    fc.put(FactorEntry(fp=fp, routine="posv", key=key,
+                       factor=bk.pad_square(F, key.n), perm=None, n=n))
+    u = rng.standard_normal(n)
+    A2 = A + np.outer(u, u)
+    fp2 = fc.update(fp, A2, u)
+    assert fp2 == matrix_fingerprint(A2, "posv", schedule=key.schedule)
+    assert fc.get(fp) is None and fc.get(fp2) is not None
+    X = solve_from_factor(fc.get(fp2), B)
+    assert np.abs(X - np.linalg.solve(A2, B)).max() < 1e-8
+    c = metrics.counters()
+    assert c.get("serve.factor_cache.update") == 1
+    assert not c.get("serve.factor_cache.update_refactor")
+    # unknown fp -> None (caller just submits A2)
+    assert fc.update("z" * 64, A2, u) is None
+
+
+def test_update_gesv_falls_back_to_refactor(rng):
+    n = 12
+    A, B = _gesv_prob(n, seed=5)
+    fc = FactorCache(max_entries=4)
+    F, perm = factor_only("gesv", A)
+    key = bk.bucket_for("gesv", n, n, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    fp = matrix_fingerprint(A, "gesv", schedule=key.schedule)
+    fc.put(FactorEntry(fp=fp, routine="gesv", key=key,
+                       factor=bk.pad_square(F, key.n), perm=perm, n=n))
+    u = rng.standard_normal(n)
+    A2 = A + np.outer(u, u)
+    fp2 = fc.update(fp, A2, u)
+    X = solve_from_factor(fc.get(fp2), B)
+    assert np.abs(X - np.linalg.solve(A2, B)).max() < 1e-9
+    assert metrics.counters().get(
+        "serve.factor_cache.update_refactor") == 1
+
+
+# ---------------------------------------------------------------------------
+# solve-phase executables: manifest + artifact identity
+# ---------------------------------------------------------------------------
+
+
+def test_solve_artifact_never_collides_with_full(tmp_path):
+    """ISSUE satellite: a solve-phase artifact has its own path AND its
+    own fingerprint; a fresh-store restore brings both phases live from
+    distinct files."""
+    from slate_tpu.serve.artifacts import ArtifactStore
+
+    full = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                         nrhs_floor=NRHS_FLOOR, schedule="recursive")
+    solve = full.solve_sibling()
+    assert bk.fingerprint(bk.content_fields(full, 1)) != bk.fingerprint(
+        bk.content_fields(solve, 1)
+    )
+    store = ArtifactStore(str(tmp_path / "a"))
+    assert store.path_for(full, 1) != store.path_for(solve, 1)
+
+    man = str(tmp_path / "m.json")
+    cache = ExecutableCache(manifest_path=man,
+                            artifact_dir=str(tmp_path / "a"))
+    cache.ensure_manifest(full, (1,))
+    cache.ensure_manifest(solve, (1,))
+    cache.warmup(batch_max=1)
+    headers = [h for h in cache.artifacts.entries() if "fields" in h]
+    phases = {h["fields"]["phase"] for h in headers}
+    assert phases == {"full", "solve"}
+    fps = {h["fingerprint"] for h in headers}
+    assert len(fps) == len(headers)  # no collisions
+    # fresh store, fresh cache: restore proves two distinct paths load
+    cache2 = ExecutableCache(manifest_path=man,
+                             artifact_dir=str(tmp_path / "a"))
+    res = cache2.restore(batch_max=1)
+    assert res["entries"] == 2 and res["failed"] == 0
+    assert res["restored"] + res["compiled"] == 2
+
+
+# ---------------------------------------------------------------------------
+# service end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_by_default(shared_cache):
+    svc = _svc(shared_cache)
+    try:
+        assert svc.factor_cache is None
+        A, B = _gesv_prob(12, seed=6)
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B).result(timeout=300)
+            assert not d.get("serve.factor_cache.miss")
+            assert not d.get("serve.factor_cache.hit")
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        assert svc.health()["factor_cache"] is None
+    finally:
+        svc.stop()
+
+
+def test_acceptance_repeated_A_stream(shared_cache):
+    """ISSUE acceptance: after one submit(A, B0) factorization, a
+    >= 20-request warmed same-A stream is trsm-only (hit >= 19), pays
+    ZERO compiles, and matches the direct driver."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B0 = _gesv_prob(12, seed=7)
+        with metrics.deltas() as d:
+            X0 = svc.submit("gesv", A, B0).result(timeout=300)
+            assert d.get("serve.factor_cache.miss") == 1
+        assert np.abs(X0 - np.linalg.solve(A, B0)).max() < 1e-9
+        svc.warmup()  # the miss registered the solve bucket
+        rng = np.random.default_rng(8)
+        Bs = [rng.standard_normal((12, 2)) for _ in range(20)]
+        with metrics.deltas() as d:
+            futs = [svc.submit("gesv", A, B) for B in Bs]
+            Xs = [f.result(timeout=300) for f in futs]
+            assert d.get("serve.factor_cache.hit") >= 19
+            assert d.get("jit.compilations") == 0, (
+                "warmed repeated-A stream must not compile")
+        for B, X in zip(Bs, Xs):
+            assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        assert svc.health()["factor_cache"]["entries"] == 1
+    finally:
+        svc.stop()
+
+
+def test_posv_hit_parity(shared_cache):
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B = _posv_prob(12, seed=9)
+        svc.submit("posv", A, B).result(timeout=300)
+        svc.warmup()
+        with metrics.deltas() as d:
+            X = svc.submit("posv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.hit") == 1
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+    finally:
+        svc.stop()
+
+
+def test_eviction_tight_byte_budget_counted_refactor(shared_cache):
+    """A budget too small to hold any factor degrades every request to
+    a counted refactor — correct X, zero hits, never an error."""
+    fc = FactorCache(max_entries=8, max_bytes=64)  # no factor fits
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, _ = _gesv_prob(12, seed=10)
+        rng = np.random.default_rng(11)
+        with metrics.deltas() as d:
+            for _ in range(3):
+                B = rng.standard_normal((12, 2))
+                X = svc.submit("gesv", A, B).result(timeout=300)
+                assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+            assert d.get("serve.factor_cache.hit") == 0
+            assert d.get("serve.factor_cache.miss") == 3
+            assert d.get("serve.factor_cache.uncacheable") == 3
+        assert len(fc) == 0
+    finally:
+        svc.stop()
+
+
+def test_invalidation_falls_back_counted(shared_cache):
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B = _gesv_prob(12, seed=12)
+        svc.submit("gesv", A, B).result(timeout=300)
+        svc.warmup()
+        fp = matrix_fingerprint(A, "gesv", schedule=svc.schedule)
+        assert fc.invalidate(fp)
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.miss") == 1
+            assert d.get("serve.factor_cache.hit") == 0
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        # and the refactor re-cached: the next request hits again
+        with metrics.deltas() as d:
+            svc.submit("gesv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.hit") == 1
+    finally:
+        svc.stop()
+
+
+def test_factor_stale_chaos_revalidates(shared_cache):
+    """The factor_stale site serves a silently-wrong factor on a hit:
+    the residual fence must catch it, count it, and re-solve — the
+    delivered X is still correct."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B = _gesv_prob(12, seed=13)
+        svc.submit("gesv", A, B).result(timeout=300)
+        svc.warmup()
+        faults.arm("factor_stale", once=True)
+        faults.on()
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.stale") == 1
+        faults.reset()
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_spill_on_open_breaker(shared_cache):
+    """A hit whose owning lane's solve-bucket breaker is cooling down
+    spills off the batched solve executable (counted) — the direct
+    path may still reuse the healthy cached factor, but it never
+    dispatches into the sick executable, and X stays right."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B = _gesv_prob(12, seed=14)
+        svc.submit("gesv", A, B).result(timeout=300)
+        svc.warmup()
+        fp = matrix_fingerprint(A, "gesv", schedule=svc.schedule)
+        skey = fc.get(fp).solve_key
+        rep = svc._replicas[0]
+        br = svc._breaker(rep, skey)
+        br.state = bk.BREAKER_OPEN
+        br.opened_at = time.monotonic()
+
+        def _runs():
+            return sum(
+                v["count"] for k, v in metrics.timers().items()
+                if k.startswith(f"serve.{skey.label}.b")
+                and k.endswith(".run")
+            )
+
+        runs0 = _runs()
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.spill") == 1
+        # the solve EXECUTABLE never dispatched into the sick lane
+        assert _runs() == runs0
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        br.state = bk.BREAKER_CLOSED  # leave the shared lane healthy
+    finally:
+        svc.stop()
+
+
+def test_hit_with_different_nrhs_bucket(shared_cache):
+    """A same-A request whose B is wider than the factoring request's
+    dispatches at ITS OWN solve bucket (the cached factor depends only
+    on n) — not the entry's, which would crash the pad."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        A, B2 = _gesv_prob(12, seed=18, nrhs=2)   # nrhs bucket 4
+        svc.submit("gesv", A, B2).result(timeout=300)
+        svc.warmup()
+        rng = np.random.default_rng(19)
+        B8 = rng.standard_normal((12, 7))          # nrhs bucket 8
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B8).result(timeout=300)
+            assert d.get("serve.factor_cache.hit") == 1
+            assert d.get("serve.breaker_open") == 0
+        assert np.abs(X - np.linalg.solve(A, B8)).max() < 1e-9
+    finally:
+        svc.stop()
+
+
+def test_mixed_and_gels_ineligible(shared_cache):
+    """Mixed-precision and gels traffic never touches the factor cache
+    (no fingerprint, no counters)."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc)
+    try:
+        rng = np.random.default_rng(15)
+        A = rng.standard_normal((20, 12))
+        B = rng.standard_normal((20, 2))
+        with metrics.deltas() as d:
+            svc.submit("gels", A, B).result(timeout=300)
+            assert not d.get("serve.factor_cache.miss")
+        assert len(fc) == 0
+    finally:
+        svc.stop()
+
+
+def test_same_A_burst_factors_once(shared_cache):
+    """A burst of same-A requests admitted before the factor lands
+    must not factor N times: the first member factors, the rest find
+    the entry mid-flight (counted hits)."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc, start=False)
+    try:
+        A, _ = _gesv_prob(12, seed=16)
+        rng = np.random.default_rng(17)
+        futs = [svc.submit("gesv", A, rng.standard_normal((12, 2)))
+                for _ in range(4)]
+        with metrics.deltas() as d:
+            svc.start()
+            Xs = [f.result(timeout=300) for f in futs]
+        for X in Xs:
+            assert np.all(np.isfinite(X))
+        c = metrics.counters()
+        assert c.get("serve.factor_cache.hit", 0) >= 1
+        assert c.get("serve.factor_cache.miss") == 4  # admission misses
+        assert len(fc) == 1  # one factor serves the whole burst
+    finally:
+        svc.stop()

@@ -15,6 +15,7 @@ counters that should have absorbed it:
     artifact_corrupt   -> serve.artifact_corrupt
     artifact_stale     -> serve.artifact_stale
     artifact_load_fail -> serve.artifact_load_fail
+    factor_stale       -> serve.factor_cache.stale
 
 For the artifact sites the detection counter IS the containment
 signal: an injected corruption that the verification ladder counted
@@ -66,6 +67,10 @@ RECOVERY = {
     "artifact_corrupt": ("serve.artifact_corrupt",),
     "artifact_stale": ("serve.artifact_stale",),
     "artifact_load_fail": ("serve.artifact_load_fail",),
+    # detection == containment for the factor-cache hit path too: a
+    # counted stale means the residual validation caught the mismatched
+    # factor and the item was re-solved direct, never delivered wrong
+    "factor_stale": ("serve.factor_cache.stale",),
 }
 
 #: sites whose zero-recovery outcome is legitimate (see module doc)

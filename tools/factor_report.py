@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Factor-cache report over a metrics JSONL: the per-fingerprint
+hit/miss/evict/bytes table, plus the global lifecycle counters.
+
+Reads a ``SLATE_TPU_METRICS`` dump from a factor-cache run
+(``SLATE_TPU_FACTOR_CACHE=1`` or an explicit
+``SolverService(factor_cache=...)``) and groups the
+``serve.factor_cache.fp.<fp12>.*`` counters by fingerprint:
+
+    fp            hit  miss  evict  inval  update  stale      bytes
+    ------------  ---  ----  -----  -----  ------  -----  ---------
+    3f2a9c01d4e7   37     1      0      0       1      0    2097152
+
+A **repeated-A stream that never hits** is the failure this tool
+gates on: some fingerprint was requested at least twice (miss >= 2)
+with zero eviction or invalidation to explain the re-miss, and the
+whole run recorded zero hits — the cache is configured but not
+serving (a keying regression, a broken hit path, or an entry that
+never survived ``put``).  That exits nonzero so CI can gate on it
+(``run_tests.py --factor`` does).  A stream with hits, or whose
+re-misses are explained by eviction/invalidation pressure, passes.
+
+Usage:
+    SLATE_TPU_METRICS=/tmp/fc.jsonl SLATE_TPU_FACTOR_CACHE=1 python app.py
+    python tools/factor_report.py /tmp/fc.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict
+
+PREFIX = "serve.factor_cache.fp."
+
+#: per-fp columns, in display order (counter suffixes under PREFIX)
+EVENTS = ("hit", "miss", "evict", "invalidate", "update",
+          "update_refactor", "stale", "refactor", "spill", "uncacheable")
+
+#: global counters summarized under the table
+GLOBALS = tuple(f"serve.factor_cache.{e}" for e in EVENTS)
+
+
+def _rows(path: str):
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("type") == "counter":
+                counters[row["name"]] = float(row.get("value", 0))
+            elif row.get("type") == "gauge":
+                gauges[row["name"]] = float(row.get("value", 0))
+    return counters, gauges
+
+
+def analyze(path: str):
+    """(per-fp table rows, global counter dict, flagged?)."""
+    counters, gauges = _rows(path)
+    per_fp: Dict[str, dict] = defaultdict(lambda: {e: 0 for e in EVENTS})
+    for name, v in counters.items():
+        if not name.startswith(PREFIX):
+            continue
+        rest = name[len(PREFIX):]
+        fp, _, event = rest.partition(".")
+        if event in EVENTS:
+            per_fp[fp][event] = int(v)
+    for name, v in gauges.items():
+        if name.startswith(PREFIX) and name.endswith(".bytes"):
+            fp = name[len(PREFIX):].rsplit(".", 1)[0]
+            per_fp[fp]["bytes"] = int(v)
+    table = [
+        {"fp": fp, "bytes": row.get("bytes", 0), **row}
+        for fp, row in sorted(per_fp.items())
+    ]
+    tot = {g.rsplit(".", 1)[1]: int(counters.get(g, 0)) for g in GLOBALS}
+    # the gate: a repeated-A stream (same fp missed >= 2 times) with no
+    # eviction/invalidation pressure to explain it, and zero hits
+    # anywhere — the cache is on but not serving
+    total_hits = tot.get("hit", 0)
+    repeated_unexplained = any(
+        r["miss"] >= 2 and r["evict"] == 0 and r["invalidate"] == 0
+        for r in table
+    )
+    flagged = bool(table) and total_hits == 0 and repeated_unexplained
+    return table, tot, flagged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a factor-cache run")
+    args = ap.parse_args(argv)
+
+    table, tot, flagged = analyze(args.jsonl)
+    if not table:
+        print("no serve.factor_cache.fp.* counters in this JSONL "
+              "(factor cache off, or no eligible traffic)")
+        return 0
+    cols = ("hit", "miss", "evict", "invalidate", "update", "stale",
+            "spill")
+    widths = [max(len(c) + 2, 7) for c in cols]
+    hdr = (f"{'fp':14}" + "".join(f"{c:>{w}}" for c, w in zip(cols, widths))
+           + f"{'bytes':>11}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in table:
+        print(
+            f"{r['fp']:14}"
+            + "".join(f"{r[c]:{w}d}" for c, w in zip(cols, widths))
+            + f"{r.get('bytes', 0):11d}"
+        )
+    print(
+        "\ntotals: "
+        + " ".join(f"{k}={v}" for k, v in sorted(tot.items()) if v)
+    )
+    if flagged:
+        print(
+            "\nFLAG: repeated-A stream (same fingerprint missed >= 2x "
+            "with no evict/invalidate pressure) recorded ZERO hits — "
+            "the factor cache is configured but not serving"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,19 +4,21 @@
     python tools/warmup_report.py out.jsonl [--manifest warmup.json]
 
 Rows come from the
-``serve.<routine>.<MxNxR>.<dtype>[.tag][.schedule][.precision][.meshPxQ].b<batch>``
+``serve.<routine>.<MxNxR>.<dtype>[.tag][.schedule][.precision][.meshPxQ][.phase].b<batch>``
 compile/run timers that the serving cache's instrumented executables
 record (slate_tpu/serve/cache.py) — the ``schedule`` (PR3),
-``precision`` (PR5) and ``mesh`` placement (PR8) BucketKey fields are
+``precision`` (PR5), ``mesh`` placement (PR8) and ``phase`` (PR10
+factor cache: ``solve`` = trsm-only) BucketKey fields are
 part of the bucket label (omitted at their defaults
-"auto"/"full"/single-device) and get their own columns here; the mesh
-column prints ``-`` for single-device buckets and ``PxQ`` for
-executables traced through the spmd drivers on that submesh.  With
-``--manifest`` the table is joined against the warmup manifest so
-buckets that were never compiled in this JSONL (stale manifest
+"auto"/"full"/single-device/"full") and get their own columns here;
+the mesh column prints ``-`` for single-device buckets and ``PxQ``
+for executables traced through the spmd drivers on that submesh.
+With ``--manifest`` the table is joined against the warmup manifest
+so buckets that were never compiled in this JSONL (stale manifest
 entries) and compiles missing from the manifest (warmup gap — the
 next cold start pays them) are both flagged; manifest entries that
-predate the schedule/precision/mesh fields are flagged ``legacy(...)``
+predate the schedule/precision/mesh/phase fields are flagged
+``legacy(...)``
 — they load with the documented defaults (mesh-less entries load as
 single-device) and re-serialize canonically on the next manifest
 flush.
@@ -35,10 +37,11 @@ _BUCKET_RE = re.compile(
 )
 
 #: non-default label suffixes (buckets.BucketKey.label appends schedule
-#: when != "auto", precision when != "full", and meshPxQ when sharded,
-#: in that order)
+#: when != "auto", precision when != "full", meshPxQ when sharded, and
+#: phase when != "full", in that order)
 _SCHEDULES = ("flat", "recursive")
 _PRECISIONS = ("mixed",)
+_PHASES = ("solve",)
 _MESH_RE = re.compile(r"^mesh(\d+x\d+)$")
 
 
@@ -53,12 +56,14 @@ def load_jsonl(path):
 
 
 def split_label(bucket):
-    """(schedule, precision, mesh) parsed off a bucket label's tail —
-    the JSONL-only fallback when no manifest is given (a tag that
-    collides with a schedule/precision/mesh literal is misread here;
-    the manifest join is the ground truth)."""
+    """(schedule, precision, mesh, phase) parsed off a bucket label's
+    tail — the JSONL-only fallback when no manifest is given (a tag
+    that collides with a schedule/precision/mesh/phase literal is
+    misread here; the manifest join is the ground truth)."""
     parts = bucket.split(".")
-    schedule, precision, mesh = "auto", "full", ""
+    schedule, precision, mesh, phase = "auto", "full", "", "full"
+    if parts and parts[-1] in _PHASES:
+        phase = parts.pop()
     if parts:
         m = _MESH_RE.match(parts[-1])
         if m:
@@ -68,7 +73,7 @@ def split_label(bucket):
         precision = parts.pop()
     if parts and parts[-1] in _SCHEDULES:
         schedule = parts.pop()
-    return schedule, precision, mesh
+    return schedule, precision, mesh, phase
 
 
 def bucket_rows(records):
@@ -104,11 +109,12 @@ def manifest_index(path):
         doc = json.load(f)
     idx = {}
     for e in doc.get("entries", []):
-        legacy = [k for k in ("schedule", "precision", "mesh")
+        legacy = [k for k in ("schedule", "precision", "mesh", "phase")
                   if k not in e]
         schedule = str(e.get("schedule", "auto"))
         precision = str(e.get("precision", "full"))
         mesh = str(e.get("mesh", ""))
+        phase = str(e.get("phase", "full"))
         bucket = f"{e['routine']}.{e['m']}x{e['n']}x{e['nrhs']}.{e['dtype']}"
         if e.get("tag"):
             bucket += f".{e['tag']}"
@@ -119,9 +125,11 @@ def manifest_index(path):
             bucket += f".{precision}"
         if mesh:
             bucket += f".mesh{mesh}"
+        if phase != "full":
+            bucket += f".{phase}"
         idx[(bucket, int(e.get("batch", 1)))] = {
             "schedule": schedule, "precision": precision, "mesh": mesh,
-            "legacy": legacy,
+            "phase": phase, "legacy": legacy,
         }
     return idx
 
@@ -143,8 +151,8 @@ def main(argv=None):
         return 0
 
     hdr = (f"{'bucket':44} {'batch':>5} {'schedule':>9} {'precision':>9} "
-           f"{'mesh':>6} {'compiles':>8} {'compile(s)':>11} {'runs':>6} "
-           f"{'mean_run(ms)':>13} {'note':>16}")
+           f"{'mesh':>6} {'phase':>6} {'compiles':>8} {'compile(s)':>11} "
+           f"{'runs':>6} {'mean_run(ms)':>13} {'note':>16}")
     print(hdr)
     print("-" * len(hdr))
     legacy_total = 0
@@ -154,9 +162,9 @@ def main(argv=None):
         mentry = midx.get(key) if midx is not None else None
         if mentry is not None:
             schedule, precision = mentry["schedule"], mentry["precision"]
-            mesh = mentry["mesh"]
+            mesh, phase = mentry["mesh"], mentry["phase"]
         else:
-            schedule, precision, mesh = split_label(bucket)
+            schedule, precision, mesh, phase = split_label(bucket)
         mesh_col = mesh or "-"  # "-" = single-device placement
         notes = []
         if midx is not None:
@@ -168,20 +176,21 @@ def main(argv=None):
                 legacy_total += 1
                 notes.append(
                     "legacy(%s)" % (
-                        "all" if len(mentry["legacy"]) == 3
+                        "all" if len(mentry["legacy"]) == 4
                         else "+".join(mentry["legacy"])
                     )
                 )
         note = ",".join(notes)
         if row is None:
             print(f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
-                  f"{mesh_col:>6} {0:8d} {'-':>11} {0:6d} {'-':>13} "
-                  f"{note:>16}")
+                  f"{mesh_col:>6} {phase:>6} {0:8d} {'-':>11} {0:6d} "
+                  f"{'-':>13} {note:>16}")
             continue
         mean_run = (row["run_s"] / row["runs"] * 1e3) if row["runs"] else 0.0
         print(
             f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
-            f"{mesh_col:>6} {row['compiles']:8d} {row['compile_s']:11.2f} "
+            f"{mesh_col:>6} {phase:>6} {row['compiles']:8d} "
+            f"{row['compile_s']:11.2f} "
             f"{row['runs']:6d} {mean_run:13.2f} {note:>16}"
         )
     total_c = sum(r["compile_s"] for r in rows.values())
@@ -191,8 +200,8 @@ def main(argv=None):
     if legacy_total:
         print(f"{legacy_total} manifest entr"
               f"{'y' if legacy_total == 1 else 'ies'} predate the "
-              "schedule/precision/mesh fields (defaulted to "
-              "auto/full/single-device); re-save the manifest to "
+              "schedule/precision/mesh/phase fields (defaulted to "
+              "auto/full/single-device/full); re-save the manifest to "
               "upgrade in place")
     return 0
 
