@@ -46,37 +46,64 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
 
 
 def _gflops(name, hand_flops, best_s):
-    """GFLOP/s with the numerator from the captured ``cost_analysis()``
-    record when one exists (metrics.costs(); the BENCH_NOTES demand —
-    measured program, not a derived formula), keeping the hand formula
-    as a cross-check.  XLA reports -1 for unknowable costs (e.g. CPU
-    while loops): that is "no data", never zero, so the model numerator
-    is used and the source is labeled."""
+    """GFLOP/s with the numerator from the build-time registry record
+    when one exists (metrics.costs(), populated by _bench's devmon
+    capture; the BENCH_NOTES demand — measured program, not a derived
+    formula), keeping the hand formula as a cross-check.  XLA reports
+    -1 for unknowable costs (e.g. CPU while loops): that is "no data",
+    never zero, so the model numerator is used and the source is
+    labeled.  The registry's memory_analysis fields ride along so the
+    trajectory is bench_diff-able on peak memory, not just rates."""
     from slate_tpu.aux import metrics
 
     out = {"gflops_model": round(hand_flops / best_s / 1e9, 1)}
-    xla = metrics.costs().get(name, {}).get("flops", -1.0)
+    rec = metrics.costs().get(name, {})
+    xla = rec.get("flops", -1.0)
     if xla is not None and xla > 0:
         out["gflops"] = round(xla / best_s / 1e9, 1)
         out["flops_source"] = "xla_cost_analysis"
     else:
         out["gflops"] = out["gflops_model"]
         out["flops_source"] = "model"
+    if rec.get("bytes_accessed"):
+        out["bytes_accessed"] = int(rec["bytes_accessed"])
+    if rec.get("peak_bytes"):
+        out["peak_bytes"] = int(rec["peak_bytes"])
     return out
 
 
 def _bench(step_fn, warm_args, trials, name=None):
-    """Best-of wall time with host readback as the barrier.  With a name,
-    the step jit is metrics-instrumented: compile vs run split per entry
-    and cost_analysis flops/bytes (capture defaults off on accelerators;
-    SLATE_TPU_METRICS_COST=1 opts in).  Deliberately NOT
-    metrics.measure_best: the steps here carry the trial perturbation IN
-    the jitted signature (t) and chain K dependent ops — re-wrapping them
-    in measure_best's scalarizer would change the measured program."""
+    """Best-of wall time with host readback as the barrier.  With a
+    name, the step is AOT-compiled ONCE via the devmon capture path
+    (lower -> compile -> cost_analysis + memory_analysis), so the one
+    compile every entry pays anyway is also the flops/bytes/peak-
+    memory evidence — on every backend, with no AOT second compile
+    (the per-call capture this replaces defaulted OFF on accelerators
+    and left flops_source "no data" there); the compiled executable is
+    then metrics-instrumented for the compile/run timer split.
+    Deliberately NOT metrics.measure_best: the steps here carry the
+    trial perturbation IN the jitted signature (t) and chain K
+    dependent ops — re-wrapping them in measure_best's scalarizer
+    would change the measured program."""
     if name is not None:
-        from slate_tpu.aux import metrics
+        from slate_tpu.aux import devmon, metrics
 
-        step_fn = metrics.instrument_jit(step_fn, name)
+        t0 = time.perf_counter()
+        compiled, _cost = devmon.capture_jitted(
+            step_fn, (*warm_args, 0.0), name=name
+        )
+        if compiled is not None:
+            # the AOT capture WAS the entry's backend compile: record
+            # it under the compile timer/counters ourselves; the
+            # wrapper below is told the executable is precompiled so
+            # every dispatch (first warm call included) logs as a run
+            metrics.observe(f"{name}.compile", time.perf_counter() - t0)
+            metrics.inc("jit.compilations")
+            metrics.inc(f"{name}.compilations")
+            step_fn = compiled  # reuse the capture compile as the build
+        step_fn = metrics.instrument_jit(
+            step_fn, name, precompiled=compiled is not None
+        )
     float(step_fn(*warm_args, 0.0))  # compile + warmup
     best = float("inf")
     for trial in range(trials):
@@ -322,9 +349,10 @@ def main(argv=None):
     from slate_tpu.aux import metrics
 
     metrics.on()
-    # note: cost_analysis capture defaults OFF on accelerators inside the
-    # metrics layer itself (the AOT second compile can wedge the remote-
-    # compile service mid-entry); SLATE_TPU_METRICS_COST=1 opts back in.
+    # flops/bytes/peak-memory come from _bench's build-time devmon
+    # capture (the AOT compile IS the entry's one build — no second
+    # compile, so the numerators exist on accelerators too, where the
+    # old per-call capture defaulted OFF and reported "no data")
     on_tpu = any(d.platform != "cpu" for d in jax.devices()) and not args.quick
     trials = 5 if on_tpu else 2
     extra = {}
@@ -479,10 +507,14 @@ def main(argv=None):
         out = {"n": nserve, "requests": reqs, "devices": ndev}
         rates = {}
         for nrep_i in (1, nrep):
+            # factor_cache=False: this entry measures dispatch spread,
+            # and an env-armed cache would detour the repeated-A probs
+            # onto unwarmed solve buckets (cold compiles mid-stream)
             svc = SolverService(
                 cache=ExecutableCache(manifest_path=None), batch_max=8,
                 batch_window_s=0.001,
                 placement=PlacementPolicy(replicas=nrep_i),
+                factor_cache=False,
             )
             key = _bk.bucket_for("gesv", nserve, nserve, 4, np.float64)
             svc.cache.ensure_manifest(key, (1, 8))
@@ -547,6 +579,7 @@ def main(argv=None):
         svc = SolverService(
             cache=ExecutableCache(manifest_path=None), batch_max=8,
             batch_window_s=0.001, dim_floor=16, nrhs_floor=4,
+            factor_cache=False,  # tail latency of the DIRECT bucket path
         )
         keys = {
             n: _bk.bucket_for("gesv", n, n, 4, np.float64,

@@ -1,0 +1,81 @@
+"""ex23: the device telemetry plane — cost/memory registry, HBM
+gauges, roofline attribution (README "Device telemetry").
+
+A warmed serve stream with devmon on (``SLATE_TPU_DEVMON=1`` in
+production; ``devmon.on()`` here):
+
+  1. every cold build captures the executable's ``cost_analysis()``
+     (flops, bytes accessed) and ``memory_analysis()`` (argument/
+     output/temp/peak bytes) into the per-bucket registry, persisted
+     beside the warmup manifest
+  2. ``health()`` surfaces the registry per warmed bucket, threads
+     peak-bytes into each latency row ("slow because big" vs "slow
+     because cold"), and snapshots per-device memory — gracefully
+     ``None`` on CPU, where ``memory_stats`` does not exist
+  3. roofline attribution joins registry flops/bytes with the
+     measured run wall: achieved GFLOP/s, arithmetic intensity, and
+     the compute- vs memory-bound verdict against the device's peaks
+     (``SLATE_TPU_PEAKS`` overrides the built-in table)
+"""
+
+from _common import check, np
+
+from slate_tpu.aux import devmon, metrics
+from slate_tpu.serve import api as serve
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+
+devmon.on()
+metrics.on()
+rng = np.random.default_rng(23)
+
+n, nrhs, N = 24, 3, 8
+svc = serve.configure(
+    cache=ExecutableCache(manifest_path=None), batch_max=4,
+    batch_window_s=0.002, dim_floor=16, nrhs_floor=4,
+)
+key = bk.bucket_for("gesv", n, n, nrhs, np.float64,
+                    floor=16, nrhs_floor=4)
+svc.cache.ensure_manifest(key, (1, 4))
+svc.warmup()  # cold builds: the registry captures here
+
+# -- 1: a warmed compile-free stream --------------------------------------
+with metrics.deltas() as d:
+    for _ in range(N):
+        A = rng.standard_normal((n, n)) + n * np.eye(n)
+        B = rng.standard_normal((n, nrhs))
+        X = serve.gesv(A, B)
+        check("warmed solve", np.abs(X - np.linalg.solve(A, B)).max(),
+              1e-9)
+    assert int(d.get("jit.compilations")) == 0, "steady state compiled"
+
+# -- 2: the health() device surface ---------------------------------------
+h = svc.health()
+rec = h["cost"][key.label][1]
+print(f"registry[{key.label}.b1]: {rec['flops']:.0f} flops, "
+      f"{rec['bytes_accessed']:.0f} B accessed, "
+      f"peak {rec['peak_bytes']} B "
+      f"(arg {rec['argument_bytes']} + temp {rec['temp_bytes']})")
+assert rec["flops"] > 0 and rec["peak_bytes"] > 0
+lat = h["latency"][key.label]
+print(f"latency[{key.label}]: p99 {lat['p99'] * 1e3:.2f} ms at peak "
+      f"{lat['peak_bytes']} B — big or cold, one row answers it")
+for dev in h["devices"]:
+    # CPU has no memory_stats: byte fields are None, never a crash
+    print(f"device {dev['id']} ({dev['kind']}): "
+          f"bytes_in_use={dev['bytes_in_use']} "
+          f"peak={dev['peak_bytes_in_use']}")
+
+# -- 3: roofline attribution ----------------------------------------------
+peaks = devmon.peaks_for()
+run = metrics.timers()[f"serve.{key.label}.b1.run"]
+rl = devmon.roofline(rec["flops"], rec["bytes_accessed"],
+                     run["total_s"] / run["count"], peaks)
+print(f"roofline[{key.label}.b1]: {rl['achieved_gflops']:.2f} GFLOP/s "
+      f"at AI {rl['intensity']:.2f} flop/B vs ridge "
+      f"{rl['ridge']:.2f} -> {rl['bound'].upper()}-bound, "
+      f"{rl['frac_of_roof'] * 100:.1f}% of roof ({peaks['source']} peaks)")
+assert rl["bound"] in ("compute", "memory")
+
+svc.stop()
+print("device telemetry: registry + gauges + roofline, all live")

@@ -10,6 +10,11 @@
   (SLATE_TPU_TRACE_RING=N), Chrome trace-event export for Perfetto.
 - aux.faults: deterministic seedable fault injection over named sites
   in the serve/driver dispatch path (SLATE_TPU_FAULTS spec).
+- aux.devmon: device telemetry plane — per-executable cost/memory
+  capture (cost_analysis + memory_analysis at build time), per-device
+  memory gauges with graceful None on backends without memory_stats,
+  and the roofline peaks table (SLATE_TPU_PEAKS override); armed by
+  SLATE_TPU_DEVMON=1, one bool per call site when off.
 """
 
-from . import faults, metrics, spans, trace  # noqa: F401
+from . import devmon, faults, metrics, spans, trace  # noqa: F401

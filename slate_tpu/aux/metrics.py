@@ -530,35 +530,38 @@ def _capture_cost_enabled() -> bool:
 
 
 def _cost_analysis(jitted, args, kw) -> Optional[dict]:
-    """flops / bytes via the AOT path (lower -> compile -> cost_analysis).
-    This compiles the program a second time (the dispatch cache is not
-    shared with AOT), so it runs at most once per (name, signature) and
-    only when SLATE_TPU_METRICS_COST is on."""
+    """Cost/memory record via the AOT path (lower -> compile ->
+    devmon.analyze_compiled — ONE extraction shared with the device
+    telemetry plane, so this legacy capture emits the same record
+    schema: flops/bytes plus the memory_analysis fields and the
+    device kind the report tools key peaks on).  This compiles the
+    program a second time (the dispatch cache is not shared with
+    AOT), so it runs at most once per (name, signature) and only when
+    SLATE_TPU_METRICS_COST is on."""
     try:
-        ca = jitted.lower(*args, **kw).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        if not isinstance(ca, dict):
-            return None
-        out = {}
-        for key, label in (("flops", "flops"),
-                           ("bytes accessed", "bytes_accessed"),
-                           ("transcendentals", "transcendentals")):
-            v = ca.get(key)
-            if v is not None:
-                out[label] = float(v)
-        return out or None
+        from . import devmon  # lazy: devmon imports metrics at module load
+
+        out = devmon.analyze_compiled(jitted.lower(*args, **kw).compile())
+        if out:
+            out["device_kind"] = devmon.default_device_kind()
+        return out
     except Exception:  # noqa: BLE001 — attribution must never break a run
         return None
 
 
-def instrument_jit(jitted, name: str, capture_cost: bool = True):
+def instrument_jit(jitted, name: str, capture_cost: bool = True,
+                   precompiled: bool = False):
     """Wrap a ``jax.jit`` callable: per dispatch, record wall time into
     ``<name>.compile`` (first dispatch for a new shape signature — the
     compile+trace+execute wall) or ``<name>.run`` (cached executable),
     count ``jit.compilations``, and capture ``cost_analysis`` flops/bytes
     at compile time.  Tracer arguments (calls inlined into an outer jit)
-    pass straight through with only a ``<name>.traced_calls`` counter."""
+    pass straight through with only a ``<name>.traced_calls`` counter.
+
+    ``precompiled=True`` declares the callable an already-built AOT
+    executable (a ``Lowered.compile()`` result): every dispatch is a
+    run, never a compile — the caller owns the compile accounting
+    (bench.py's devmon capture path records it explicitly)."""
     seen_sigs = set()  # fallback signature tracking if _cache_size is absent
 
     def _cache_size():
@@ -595,7 +598,9 @@ def instrument_jit(jitted, name: str, capture_cost: bool = True):
             pass
         stop = time.perf_counter()
         after = _cache_size()
-        if after is not None:
+        if precompiled:
+            compiled = False
+        elif after is not None:
             compiled = after > (before or 0)
         else:
             sig = tuple(
@@ -612,16 +617,12 @@ def instrument_jit(jitted, name: str, capture_cost: bool = True):
             if capture_cost and _capture_cost_enabled():
                 cost = _cost_analysis(jitted, args, kw)
                 if cost:
-                    with _lock:
-                        _costs[name] = cost
-                    # XLA reports -1 for unknowable costs (e.g. CPU
-                    # while loops); keep the raw value in the cost
-                    # record but never gauge/rate from it
-                    if cost.get("flops", -1) > 0:
-                        gauge(f"{name}.flops", cost["flops"])
-                    if "bytes_accessed" in cost:
-                        gauge(f"{name}.bytes_accessed",
-                              cost["bytes_accessed"])
+                    # one canonical store-and-gauge path with the
+                    # devmon capture.  XLA's -1 "unknowable cost"
+                    # sentinel is dropped by the shared extractor
+                    # (devmon.analyze_compiled), so an absent key —
+                    # not a raw -1 — is the registry's no-data marker
+                    record_cost(name, cost)
                     extra = cost
             _emit_event(name, start, stop, "compile", extra)
         else:
@@ -683,6 +684,26 @@ def gated_jit(fn, name: str, donate_argnums=(), **jit_kw):
         return holder[0](*args, **kw)
 
     return gate
+
+
+def record_cost(name: str, cost: dict) -> None:
+    """Record one executable's cost/memory attribution under ``name``
+    (the devmon capture path: flops / bytes_accessed plus the
+    memory_analysis argument/output/temp/peak byte fields), so the
+    JSONL dump carries a ``{"type": "cost", ...}`` row per executable
+    and :func:`costs` serves it to bench.py / the report tools.  Also
+    mirrors flops/bytes onto the same gauges :func:`instrument_jit`'s
+    capture would have set.  One bool check when metrics are off."""
+    if not _enabled:
+        return
+    with _lock:
+        _costs[name] = dict(cost)
+    if cost.get("flops", -1) > 0:
+        gauge(f"{name}.flops", cost["flops"])
+    if cost.get("bytes_accessed") is not None:
+        gauge(f"{name}.bytes_accessed", cost["bytes_accessed"])
+    if cost.get("peak_bytes") is not None:
+        gauge(f"{name}.peak_bytes", cost["peak_bytes"])
 
 
 def record_factor_flops(routine: str, fl: dict) -> None:

@@ -508,13 +508,27 @@ def fingerprint(fields: dict) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
-def manifest_dumps(entries) -> str:
-    """Serialize [(BucketKey, batch), ...] as the warmup manifest JSON."""
+def manifest_dumps(entries, costs=None) -> str:
+    """Serialize [(BucketKey, batch), ...] as the warmup manifest JSON.
+    ``costs`` is an optional ``{(key, batch): cost-record}`` mapping
+    (the build-time ``cost_analysis``/``memory_analysis`` capture —
+    serve/cache.py's registry): entries with a record get a ``"cost"``
+    field, so the flops/bytes/peak evidence restores with the manifest
+    instead of costing a recapture compile on the next cold start."""
+
+    def entry(k, b):
+        e = {**k.to_json(), "batch": int(b)}
+        if costs:
+            c = costs.get((k, int(b)))
+            if c:
+                e["cost"] = c
+        return e
+
     return json.dumps(
         {
             "version": 1,
             "entries": sorted(
-                ({**k.to_json(), "batch": int(b)} for k, b in entries),
+                (entry(k, b) for k, b in entries),
                 key=lambda e: (e["routine"], e["m"], e["n"], e["nrhs"],
                                e["dtype"], e["tag"], e["schedule"],
                                e["precision"], e["mesh"], e["phase"],
@@ -525,10 +539,36 @@ def manifest_dumps(entries) -> str:
     )
 
 
-def manifest_loads(text: str):
-    """Parse a warmup manifest back into [(BucketKey, batch), ...]."""
-    doc = json.loads(text)
+def _manifest_doc(text_or_doc):
+    """One parse for both loaders: accepts the manifest JSON text or
+    an already-parsed document dict (the cache reads the file once and
+    feeds both loaders from the same doc)."""
+    return (
+        text_or_doc if isinstance(text_or_doc, dict)
+        else json.loads(text_or_doc)
+    )
+
+
+def manifest_loads(text):
+    """Parse a warmup manifest (JSON text or parsed doc) back into
+    [(BucketKey, batch), ...]."""
+    doc = _manifest_doc(text)
     out = []
     for e in doc.get("entries", []):
         out.append((BucketKey.from_json(e), int(e.get("batch", 1))))
+    return out
+
+
+def manifest_cost_loads(text):
+    """Parse the per-entry ``"cost"`` records out of a warmup manifest
+    (JSON text or parsed doc): ``{(BucketKey, batch): cost-record}``.
+    Entries without the field (pre-PR11 manifests, or any devmon-off
+    writer) simply yield nothing — the cache recaptures at the next
+    devmon-on build; tools/warmup_report.py flags them ``no-cost``."""
+    doc = _manifest_doc(text)
+    out = {}
+    for e in doc.get("entries", []):
+        c = e.get("cost")
+        if isinstance(c, dict) and c:
+            out[(BucketKey.from_json(e), int(e.get("batch", 1)))] = dict(c)
     return out

@@ -17,6 +17,15 @@ pointed at the same directory restores the warmed executable set
 (``restore()``) instead of recompiling it — the manifest stays the
 recipe, the artifact store is the baked result.
 
+With ``SLATE_TPU_DEVMON=1`` (aux/devmon) every cold build and artifact
+restore also captures the executable's ``cost_analysis()`` (flops,
+bytes accessed) and ``memory_analysis()`` (argument/output/temp/peak
+bytes) into a per-``(BucketKey, batch)`` registry, persisted beside
+each manifest entry (``"cost"`` field) and surfaced through
+``SolverService.health()`` and the metrics JSONL —
+``tools/roofline_report.py`` joins it with the execute timers into
+compute- vs memory-bound verdicts per bucket.
+
 Executable shape: ``fn(A_batch, B_batch) -> (X_batch, info_batch)``
 with ``A: (batch, Mb, Nb)``, ``B: (batch, Mb, nrhs_b)`` — the drivers
 vmapped over the leading axis (Matrix construction from the padded
@@ -32,6 +41,7 @@ batch without a batch-sized host copy or bb resident device copies.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -40,10 +50,17 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..aux import faults, metrics, spans
+from ..aux import devmon, faults, metrics, spans
 from ..exceptions import NumericalError
 from .artifacts import ArtifactStore, store_from_env
-from .buckets import BucketKey, manifest_dumps, manifest_loads, mesh_fits
+from .buckets import (
+    BucketKey,
+    manifest_cost_loads,
+    manifest_dumps,
+    manifest_loads,
+    mesh_fits,
+    phase_flops,
+)
 
 WARMUP_ENV = "SLATE_TPU_WARMUP"
 
@@ -264,6 +281,12 @@ class ExecutableCache:
         # the SAME executable; the pre-placement single worker
         # serialized builds for free
         self._building: Dict[Tuple[BucketKey, int], threading.Event] = {}
+        # per-executable cost/memory registry (aux/devmon build-time
+        # capture): (key, batch) -> {"flops", "bytes_accessed",
+        # "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
+        # ...}.  Persisted beside each manifest entry ("cost" field) so
+        # a restored process has the evidence without recapturing
+        self._costs: Dict[Tuple[BucketKey, int], dict] = {}
         self.artifacts: Optional[ArtifactStore] = store_from_env(artifact_dir)
         self.manifest_path = (
             manifest_path
@@ -273,7 +296,9 @@ class ExecutableCache:
         if self.manifest_path and os.path.exists(self.manifest_path):
             try:
                 with open(self.manifest_path) as f:
-                    self._entries.update(manifest_loads(f.read()))
+                    doc = json.load(f)  # one parse feeds both loaders
+                self._entries.update(manifest_loads(doc))
+                self._costs.update(manifest_cost_loads(doc))
             except (OSError, ValueError, KeyError, TypeError) as e:
                 # a corrupt manifest must never block serving — but a
                 # silently ignored one hides that every bucket will pay
@@ -321,7 +346,7 @@ class ExecutableCache:
         tmp = f"{self.manifest_path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
-                f.write(manifest_dumps(self._entries) + "\n")
+                f.write(manifest_dumps(self._entries, self._costs) + "\n")
             os.replace(tmp, self.manifest_path)
         except OSError:
             try:
@@ -337,6 +362,96 @@ class ExecutableCache:
                 self.manifest_path = path
             self._flush_locked()
             return self.manifest_path
+
+    # -- cost/memory registry (aux/devmon build-time capture) --------------
+
+    def cost(self, key: BucketKey, batch: int) -> Optional[dict]:
+        """The captured cost/memory record of one executable, or None
+        when devmon never saw it build (devmon off, capture failure,
+        or a pre-cost manifest)."""
+        with self._lock:
+            c = self._costs.get((key, int(batch)))
+            return dict(c) if c else None
+
+    def cost_registry(self) -> Dict[Tuple[BucketKey, int], dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._costs.items()}
+
+    def costs_by_label(self) -> Dict[str, Dict[int, dict]]:
+        """Registry re-keyed ``{bucket label: {batch: record}}`` — the
+        join shape health() and the report tools consume."""
+        out: Dict[str, Dict[int, dict]] = {}
+        with self._lock:
+            for (key, batch), c in self._costs.items():
+                out.setdefault(key.label, {})[int(batch)] = dict(c)
+        return out
+
+    def _capture_cost(self, key: BucketKey, batch: int, jitted,
+                      name: str) -> None:
+        """Devmon build-time capture: AOT lower+compile ``jitted`` at
+        this entry's arg specs, read ``cost_analysis`` +
+        ``memory_analysis``, record under ``name`` (the metrics cost
+        registry -> JSONL) and persist beside the manifest entry.
+        One bool when devmon is off; an already-known entry (restored
+        from a cost-bearing manifest) is never recaptured — the extra
+        backend compile is paid at most once per (bucket, batch) per
+        manifest lifetime.  Capture failure degrades to a counted
+        miss, never a build error."""
+        if not devmon.is_on():
+            return
+        with self._lock:
+            known = self._costs.get((key, batch))
+        if known is not None and known.get("device_kind") in (
+            None, devmon.default_device_kind()
+        ):
+            # restored from a cost-bearing manifest on the same device
+            # kind: the capture is skipped, but the evidence must
+            # still reach THIS process's metrics registry — a
+            # warm-restarted replica's JSONL otherwise carries run
+            # timers with zero cost rows and roofline_report fails its
+            # gate on a healthy stream
+            metrics.record_cost(name, known)
+            return
+        if known is not None:
+            # foreign evidence: the manifest was captured on another
+            # backend (a CPU dev box feeding a TPU replica) — serving
+            # its flops/bytes under this device's roofs would
+            # mis-classify every bucket, so recapture and overwrite
+            metrics.inc("serve.cost_foreign_recaptured")
+        # NOTE: the capture executable cannot replace the dispatch jit
+        # (AOT executables are committed to one device; run() needs
+        # jit's per-device variants for replica pinning), so this IS a
+        # second backend compile — cold-build-only, devmon-gated, and
+        # timed below so warmup cost stays attributable.  record=False:
+        # the record lands once below, after flops_model is attached
+        t0 = time.perf_counter()
+        _compiled, cost = devmon.capture_jitted(
+            jitted, self._arg_specs(key, batch), name=name, record=False,
+        )
+        metrics.observe(f"{name}.cost_capture", time.perf_counter() - t0)
+        if cost is None:
+            metrics.inc("serve.cost_capture_failed")
+            if known is not None:
+                # a failed recapture must not leave the foreign record
+                # live: no evidence beats wrong evidence
+                with self._lock:
+                    self._costs.pop((key, batch), None)
+                    self._flush_locked()
+            return
+        # the hand-model FLOP count rides along as cross-check AND as
+        # the rate fallback: vendor custom calls (CPU trsm/getrf)
+        # report no XLA flops, and a warmed solve bucket must still be
+        # roofline-classifiable (bench.py keeps the same gflops_model
+        # convention)
+        try:
+            cost.setdefault("flops_model", phase_flops(key, batch))
+        except Exception:  # noqa: BLE001 — attribution never breaks a build
+            pass
+        metrics.record_cost(name, cost)
+        metrics.inc("serve.cost_captured")
+        with self._lock:
+            self._costs[(key, batch)] = cost
+            self._flush_locked()
 
     # -- executables -------------------------------------------------------
 
@@ -464,8 +579,15 @@ class ExecutableCache:
                 self.artifacts.save(
                     key, batch, export_target, self._arg_specs(key, batch)
                 )
-        # capture_cost=False: the AOT second compile would double every
-        # warmup (metrics still splits compile-vs-run wall per bucket)
+        # devmon build-time capture (cold build AND artifact restore):
+        # flops/bytes + argument/output/temp/peak bytes per (bucket,
+        # batch), recorded to metrics and persisted beside the manifest
+        # entry.  Gated on devmon (one bool when off) because the AOT
+        # lowering is a second backend compile of the program
+        self._capture_cost(key, batch, jitted, name)
+        # capture_cost=False: instrument_jit's own AOT capture would
+        # double every warmup even with devmon off (metrics still
+        # splits compile-vs-run wall per bucket; devmon owns cost)
         exe = metrics.instrument_jit(jitted, name, capture_cost=False)
         with self._lock:
             prev = self._exes.setdefault((key, batch), exe)

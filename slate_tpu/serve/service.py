@@ -125,7 +125,7 @@ from typing import Deque, Dict, List, Optional, Union
 
 import numpy as np
 
-from ..aux import faults, metrics, spans
+from ..aux import devmon, faults, metrics, spans
 from ..exceptions import InvalidInput, NumericalError, SlateError
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
@@ -910,7 +910,14 @@ class SolverService:
         (last 60 s over a bounded window), and — with metrics on — the
         SLO surface: per-bucket p50/p95/p99 total latency
         (``latency``) and the deadline-budget burn tiers
-        (``slo_burn``).  Cheap enough to poll.  The legacy top-level
+        (``slo_burn``) — and, with devmon on (``SLATE_TPU_DEVMON=1``),
+        the device surface: the per-bucket build-time cost/memory
+        registry (``cost``: flops/bytes + argument/output/temp/peak
+        bytes per batch point), each latency row's ``peak_bytes``
+        (so one probe answers "slow because big" vs "slow because
+        cold"), and per-device memory snapshots (``devices``; byte
+        fields None on backends without ``memory_stats``).  Cheap
+        enough to poll.  The legacy top-level
         ``breakers`` map merges the per-replica tables (worst state
         wins) so existing probes keep working; ``replicas`` (and
         ``sharded``, when a mesh is configured) carry the
@@ -978,6 +985,27 @@ class SolverService:
                 for name, v in metrics.counters().items()
                 if name.startswith("serve.slo_burn.")
             }
+        # the device-telemetry surface (aux/devmon; both None when off
+        # — one bool per probe, the registry deep-copy is never paid):
+        # per-bucket build-time cost/memory registry, peak-bytes
+        # threaded into the latency rows (one report answers "slow
+        # because big" vs "slow because cold"), and a per-device
+        # memory snapshot (bytes_in_use None on backends without
+        # memory_stats — graceful, never a crash)
+        cost = devices = None
+        if devmon.is_on():
+            cost = self.cache.costs_by_label() or None
+            if cost:
+                for lbl, ent in latency.items():
+                    per = cost.get(lbl)
+                    if per:
+                        pk = max(
+                            (c.get("peak_bytes") or 0)
+                            for c in per.values()
+                        )
+                        if pk:
+                            ent["peak_bytes"] = int(pk)
+            devices = devmon.sample_devices()
         return {
             "ok": running and alive,
             "phase": phase,
@@ -997,6 +1025,8 @@ class SolverService:
             "sharded": shard_lane,
             "latency": latency,
             "slo_burn": slo_burn,
+            "cost": cost,
+            "devices": devices,
             "factor_cache": (
                 self.factor_cache.stats()
                 if self.factor_cache is not None else None

@@ -16,6 +16,13 @@ Underneath the table: the deadline-budget burn tiers
 (``serve.slo_burn.*``) and the head-of-line age gauges
 (``serve.replica.<i>.oldest_queued_s``).
 
+The ``peak(MB)`` column joins the device-telemetry registry
+(``SLATE_TPU_DEVMON=1``): each bucket's build-time
+``memory_analysis`` peak bytes (max over its batch points), so one
+table answers "slow because big" vs "slow because cold" — a fat p99
+beside a fat peak is a capacity problem, beside a slim one it is a
+queueing/compile problem.  ``-`` when the run captured no registry.
+
 Exit status is the **SLO verdict**: with ``--p99-budget S``, any
 bucket whose total p99 exceeds ``S`` seconds exits nonzero (what the
 ``run_tests.py --latency`` gate fails on), as does a JSONL with no
@@ -33,12 +40,13 @@ import sys
 _LAT_RE = re.compile(
     r"^serve\.latency\.(?P<scope>.+)\.(?P<split>queued|execute|total)$"
 )
+_COST_RE = re.compile(r"^serve\.(?P<bucket>.+)\.b(?P<batch>\d+)$")
 
 SPLITS = ("queued", "execute", "total")
 
 
 def load_records(path):
-    hists, counters, gauges = {}, {}, {}
+    hists, counters, gauges, peaks = {}, {}, {}, {}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -53,7 +61,15 @@ def load_records(path):
                 counters[r["name"]] = r.get("value", 0)
             elif r.get("type") == "gauge":
                 gauges[r["name"]] = r.get("value", 0)
-    return hists, counters, gauges
+            elif r.get("type") == "cost":
+                # registry peak bytes per bucket label: max over the
+                # label's batch points (the memory column's join key)
+                m = _COST_RE.match(r.get("name", ""))
+                if m and r.get("peak_bytes"):
+                    lbl = m.group("bucket")
+                    peaks[lbl] = max(peaks.get(lbl, 0),
+                                     int(r["peak_bytes"]))
+    return hists, counters, gauges, peaks
 
 
 def latency_rows(hists):
@@ -82,7 +98,7 @@ def main(argv=None):
                          "exceeds this many seconds")
     args = ap.parse_args(argv)
 
-    hists, counters, gauges = load_records(args.jsonl)
+    hists, counters, gauges, peaks = load_records(args.jsonl)
     rows = latency_rows(hists)
     buckets = {s: r for s, r in rows.items() if not s.startswith("replica.")}
     replicas = {s: r for s, r in rows.items() if s.startswith("replica.")}
@@ -94,7 +110,7 @@ def main(argv=None):
 
     hdr = (f"{'bucket':38} {'count':>6} {'queued p50/p99':>15} "
            f"{'exec p50/p99':>15} {'total p50':>10} {'p95':>8} "
-           f"{'p99(ms)':>8}")
+           f"{'p99(ms)':>8} {'peak(MB)':>9}")
     print(hdr)
     print("-" * len(hdr))
     over = []
@@ -103,12 +119,14 @@ def main(argv=None):
         total = r.get("total")
         q, x = r.get("queued"), r.get("execute")
         count = (total or q or x or {}).get("count", 0)
+        pk = peaks.get(scope)
         print(
             f"{scope:38} {count:6d} "
             f"{_ms(q, 'p50'):>7}/{_ms(q, 'p99'):>7} "
             f"{_ms(x, 'p50'):>7}/{_ms(x, 'p99'):>7} "
             f"{_ms(total, 'p50'):>10} {_ms(total, 'p95'):>8} "
-            f"{_ms(total, 'p99'):>8}"
+            f"{_ms(total, 'p99'):>8} "
+            f"{f'{pk / 1e6:.2f}' if pk else '-':>9}"
         )
         if (args.p99_budget is not None and total is not None
                 and total["p99"] > args.p99_budget):

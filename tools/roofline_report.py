@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Roofline attribution table from a metrics JSONL: achieved GFLOP/s,
+arithmetic intensity, and the compute- vs memory-bound verdict per
+warmed serve bucket (Williams, Waterman & Patterson, CACM 2009 —
+PAPERS.md).
+
+    python tools/roofline_report.py out.jsonl [--min-frac 0.0]
+
+Joins two record families the device telemetry plane emits
+(``SLATE_TPU_DEVMON=1`` + ``SLATE_TPU_METRICS=out.jsonl``):
+
+* ``{"type": "cost", "name": "serve.<bucket>.b<batch>", ...}`` — the
+  build-time ``cost_analysis``/``memory_analysis`` registry record
+  (flops, bytes accessed, peak bytes, device kind) captured by
+  serve/cache.py at every cold build and artifact restore;
+* ``{"type": "timer", "name": "serve.<bucket>.b<batch>.run", ...}`` —
+  the steady-state dispatch wall the cache's instrumented executables
+  record (compile wall is excluded by construction).
+
+Per warmed executable: achieved FLOP/s = registry flops / mean run
+wall; intensity = flops / bytes accessed; the verdict compares
+intensity against the device ridge point from the peaks table
+(``aux/devmon.DEFAULT_PEAKS``; override per deployment with
+``SLATE_TPU_PEAKS='{"cpu": {"flops": 5e10, "bytes_per_s": 2e10}}'``).
+This is the measured form of the ROADMAP item-1 claim — whether the
+panel/small-tile buckets, not the trailing gemms, bound the recursive
+schedules is read off the bound column, not asserted.
+
+Exit status is the gate verdict (``run_tests.py --perf``): nonzero
+when the JSONL has no registry cost rows at all, or when any WARMED
+bucket (one with run dispatches) is unclassifiable — no cost record,
+or flops/bytes the roofline cannot rate.  ``--min-frac F`` further
+fails any warmed bucket achieving less than ``F`` of its roof.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_RUN_RE = re.compile(r"^serve\.(?P<exe>.+\.b\d+)\.run$")
+_COST_RE = re.compile(r"^serve\.(?P<exe>.+\.b\d+)$")
+
+
+def load_records(path):
+    costs, runs = {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            # cumulative snapshots: last value wins (same rule as the
+            # sibling reports — summing re-dumped JSONLs inflates)
+            if r.get("type") == "cost":
+                m = _COST_RE.match(r.get("name", ""))
+                if m:
+                    costs[m.group("exe")] = r
+            elif r.get("type") == "timer":
+                m = _RUN_RE.match(r.get("name", ""))
+                if m:
+                    runs[m.group("exe")] = r
+    return costs, runs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="roofline_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS "
+                                  "output from a SLATE_TPU_DEVMON=1 run)")
+    ap.add_argument("--min-frac", type=float, default=None,
+                    help="fail any warmed bucket achieving less than "
+                         "this fraction of its roof")
+    args = ap.parse_args(argv)
+
+    # the peaks table lives in the library (one source of truth with
+    # health()/examples); the tool only needs devmon, not jax
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from slate_tpu.aux import devmon
+
+    costs, runs = load_records(args.jsonl)
+    if not costs:
+        print("(no serve.* cost records in this JSONL — was the stream "
+              "run with SLATE_TPU_DEVMON=1 so the cache captured "
+              "cost/memory at build time?)")
+        return 1
+
+    kinds = {c.get("device_kind", "unknown") for c in costs.values()}
+    peaks = {k: devmon.peaks_for(k) for k in kinds}
+    for k in sorted(kinds):
+        p = peaks[k]
+        print(f"peaks[{k}]: {p['flops'] / 1e9:.1f} GFLOP/s, "
+              f"{p['bytes_per_s'] / 1e9:.1f} GB/s, "
+              f"ridge {p['ridge']:.2f} flop/B ({p['source']})")
+    print()
+
+    hdr = (f"{'executable':46} {'runs':>5} {'mean(ms)':>9} "
+           f"{'GFLOP/s':>9} {'src':>5} {'AI(f/B)':>8} {'roof':>9} "
+           f"{'%roof':>6} {'peak(MB)':>9} {'bound':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    bad = []
+    under = []
+    for exe in sorted(set(costs) | set(runs)):
+        cost = costs.get(exe)
+        run = runs.get(exe)
+        nruns = int(run.get("count", 0)) if run else 0
+        warmed = nruns > 0
+        pk_mb = (
+            f"{cost['peak_bytes'] / 1e6:9.2f}"
+            if cost and cost.get("peak_bytes") else "-"
+        )
+        rl = None
+        fsrc = "xla"
+        mean_s = (
+            float(run.get("total_s", 0.0)) / nruns if warmed else 0.0
+        )
+        if warmed and cost is not None:
+            # vendor custom calls (CPU trsm/getrf) report no XLA flops:
+            # fall back to the registry's hand-model count, labeled
+            flops = cost.get("flops")
+            if not flops or flops <= 0:
+                flops, fsrc = cost.get("flops_model"), "model"
+            rl = devmon.roofline(
+                flops, cost.get("bytes_accessed"), mean_s,
+                peaks.get(cost.get("device_kind", "unknown")),
+            )
+        if rl is None:
+            why = (
+                "cold (no runs)" if not warmed
+                else "NO COST RECORD" if cost is None
+                else "UNRATEABLE (flops/bytes missing or <= 0)"
+            )
+            print(f"{exe:46} {nruns:5d} {'-':>9} {'-':>9} {'-':>5} "
+                  f"{'-':>8} {'-':>9} {'-':>6} {pk_mb:>9} {why:>8}")
+            if warmed:
+                bad.append((exe, why))
+            continue
+        print(
+            f"{exe:46} {nruns:5d} {mean_s * 1e3:9.2f} "
+            f"{rl['achieved_gflops']:9.2f} {fsrc:>5} "
+            f"{rl['intensity']:8.2f} "
+            f"{rl['roof_flops'] / 1e9:9.2f} "
+            f"{rl['frac_of_roof'] * 100:5.1f}% {pk_mb:>9} "
+            f"{rl['bound']:>8}"
+        )
+        if args.min_frac is not None and rl["frac_of_roof"] < args.min_frac:
+            under.append((exe, rl["frac_of_roof"]))
+
+    rc = 0
+    for exe, why in bad:
+        print(f"FAIL: warmed bucket {exe} is unclassifiable ({why})")
+        rc = 1
+    for exe, frac in under:
+        print(f"FAIL: {exe} achieved {frac * 100:.1f}% of roof, below "
+              f"the {args.min_frac * 100:.1f}% floor")
+        rc = 1
+    if rc == 0:
+        n = sum(1 for e in runs if int(runs[e].get('count', 0)) > 0
+                and e in costs)
+        print(f"\nroofline ok: {n} warmed bucket(s) classified")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,16 @@ predate the schedule/precision/mesh/phase fields are flagged
 ``legacy(...)``
 — they load with the documented defaults (mesh-less entries load as
 single-device) and re-serialize canonically on the next manifest
-flush.
+flush.  Entries with no cost record anywhere (a devmon-off build or a
+pre-PR11 writer) are flagged ``no-cost`` separately — the field is
+current, the evidence just has not been captured yet.
+
+The arg/temp/peak byte columns and achieved GF/s come from the
+device-telemetry registry (PR11, ``SLATE_TPU_DEVMON=1``): per-bucket
+``{"type": "cost"}`` JSONL rows captured at build time
+(``cost_analysis`` + ``memory_analysis``), falling back to the
+manifest entries' persisted ``"cost"`` field; achieved GF/s divides
+registry flops by the mean steady-state run wall.
 
 Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
 serving workload (examples/ex16_serving.py shows the whole loop).
@@ -76,6 +85,9 @@ def split_label(bucket):
     return schedule, precision, mesh, phase
 
 
+_COST_RE = re.compile(r"^serve\.(?P<bucket>.+)\.b(?P<batch>\d+)$")
+
+
 def bucket_rows(records):
     """{(bucket, batch): {compiles, compile_s, runs, run_s}} from timer rows."""
     rows = {}
@@ -95,6 +107,18 @@ def bucket_rows(records):
         else:
             row["runs"] += int(r.get("count", 0))
             row["run_s"] += float(r.get("total_s", 0.0))
+    return rows
+
+
+def cost_rows(records):
+    """{(bucket, batch): cost-record} from the registry's JSONL rows."""
+    rows = {}
+    for r in records:
+        if r.get("type") != "cost":
+            continue
+        m = _COST_RE.match(r.get("name", ""))
+        if m:
+            rows[(m.group("bucket"), int(m.group("batch")))] = r
     return rows
 
 
@@ -130,6 +154,8 @@ def manifest_index(path):
         idx[(bucket, int(e.get("batch", 1)))] = {
             "schedule": schedule, "precision": precision, "mesh": mesh,
             "phase": phase, "legacy": legacy,
+            "cost": e.get("cost") if isinstance(e.get("cost"), dict)
+            else None,
         }
     return idx
 
@@ -143,19 +169,26 @@ def main(argv=None):
 
     records = load_jsonl(args.jsonl)
     rows = bucket_rows(records)
+    costs = cost_rows(records)
     midx = manifest_index(args.manifest) if args.manifest else None
 
-    all_keys = sorted(set(rows) | (set(midx) if midx else set()))
+    all_keys = sorted(set(rows) | set(costs) | (set(midx) if midx else set()))
     if not all_keys:
         print("(no serve.* bucket timers in this JSONL)")
         return 0
 
+    def _mb(cost, field):
+        v = (cost or {}).get(field)
+        return f"{v / 1e6:.2f}" if v else "-"
+
     hdr = (f"{'bucket':44} {'batch':>5} {'schedule':>9} {'precision':>9} "
            f"{'mesh':>6} {'phase':>6} {'compiles':>8} {'compile(s)':>11} "
-           f"{'runs':>6} {'mean_run(ms)':>13} {'note':>16}")
+           f"{'runs':>6} {'mean_run(ms)':>13} {'arg(MB)':>8} "
+           f"{'temp(MB)':>9} {'peak(MB)':>9} {'GF/s':>7} {'note':>16}")
     print(hdr)
     print("-" * len(hdr))
     legacy_total = 0
+    nocost_total = 0
     for key in all_keys:
         bucket, batch = key
         row = rows.get(key)
@@ -165,6 +198,9 @@ def main(argv=None):
             mesh, phase = mentry["mesh"], mentry["phase"]
         else:
             schedule, precision, mesh, phase = split_label(bucket)
+        # registry record: the JSONL cost row when this run captured
+        # one, else the manifest entry's persisted "cost" field
+        cost = costs.get(key) or (mentry or {}).get("cost")
         mesh_col = mesh or "-"  # "-" = single-device placement
         notes = []
         if midx is not None:
@@ -180,18 +216,32 @@ def main(argv=None):
                         else "+".join(mentry["legacy"])
                     )
                 )
+            if mentry is not None and cost is None:
+                # distinct from legacy: a current-format manifest
+                # written with devmon off simply carries no evidence
+                # yet — "predates the field" would be a false claim
+                nocost_total += 1
+                notes.append("no-cost")
         note = ",".join(notes)
+        cost_cols = (f"{_mb(cost, 'argument_bytes'):>8} "
+                     f"{_mb(cost, 'temp_bytes'):>9} "
+                     f"{_mb(cost, 'peak_bytes'):>9}")
         if row is None:
             print(f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
                   f"{mesh_col:>6} {phase:>6} {0:8d} {'-':>11} {0:6d} "
-                  f"{'-':>13} {note:>16}")
+                  f"{'-':>13} {cost_cols} {'-':>7} {note:>16}")
             continue
         mean_run = (row["run_s"] / row["runs"] * 1e3) if row["runs"] else 0.0
+        gfs = "-"
+        flops = (cost or {}).get("flops") or (cost or {}).get("flops_model")
+        if flops and row["runs"] and row["run_s"] > 0:
+            gfs = f"{flops * row['runs'] / row['run_s'] / 1e9:.2f}"
         print(
             f"{bucket:44} {batch:5d} {schedule:>9} {precision:>9} "
             f"{mesh_col:>6} {phase:>6} {row['compiles']:8d} "
             f"{row['compile_s']:11.2f} "
-            f"{row['runs']:6d} {mean_run:13.2f} {note:>16}"
+            f"{row['runs']:6d} {mean_run:13.2f} {cost_cols} {gfs:>7} "
+            f"{note:>16}"
         )
     total_c = sum(r["compile_s"] for r in rows.values())
     print(f"\ntotal compile wall: {total_c:.2f}s over "
@@ -203,6 +253,12 @@ def main(argv=None):
               "schedule/precision/mesh/phase fields (defaulted to "
               "auto/full/single-device/full); re-save the manifest to "
               "upgrade in place")
+    if nocost_total:
+        print(f"{nocost_total} manifest entr"
+              f"{'y' if nocost_total == 1 else 'ies'} carr"
+              f"{'ies' if nocost_total == 1 else 'y'} no cost record "
+              "(built with devmon off, or a pre-PR11 writer); rebuild "
+              "once with SLATE_TPU_DEVMON=1 to bake the evidence in")
     return 0
 
 
