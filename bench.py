@@ -678,6 +678,99 @@ def main(argv=None):
 
     run_entry("factor_solve_many", entry_factor_solve_many)
 
+    # -- multi-tenant fairness: the SAME burst trace (one abusive
+    # flood, then a well-behaved tenant's small stream) through a
+    # static config vs the admission plane (tenant quotas + WFQ +
+    # adaptive window).  The headline is the well-behaved tenant's p99
+    # under each config plus the abuser's shed/rejected counts — on
+    # CPU the queueing deltas are modest (one worker, fast solves);
+    # the curve is for real chips, the fairness direction holds
+    # everywhere ------------------------------------------------------
+    def entry_serve_multitenant():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.serve import buckets as _bk
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.service import SolverService
+        from slate_tpu.exceptions import SlateError
+
+        n_ab = 1024 if on_tpu else 192
+        n_good = 512 if on_tpu else 96
+        flood, nice = 24, 8
+        rng = np.random.default_rng(0)
+        A_a = rng.standard_normal((n_ab, n_ab)) + n_ab * np.eye(n_ab)
+        B_a = rng.standard_normal((n_ab, 4))
+        good_probs = [
+            (rng.standard_normal((n_good, n_good))
+             + n_good * np.eye(n_good),
+             rng.standard_normal((n_good, 4)))
+            for _ in range(nice)
+        ]
+        k_ab = _bk.bucket_for("gesv", n_ab, n_ab, 4, np.float64)
+        k_good = _bk.bucket_for("gesv", n_good, n_good, 4, np.float64)
+        out = {"n_abuser": n_ab, "n_good": n_good,
+               "flood": flood, "good_requests": nice}
+        for mode in ("static", "adaptive"):
+            # tenants=""/adaptive=False: explicitly OFF for the static
+            # baseline (None would re-resolve SLATE_TPU_TENANTS/
+            # SLATE_TPU_ADAPTIVE and poison the comparison — the same
+            # trap factor_cache=False guards against above)
+            kw = dict(
+                cache=ExecutableCache(manifest_path=None), batch_max=4,
+                batch_window_s=0.002, factor_cache=False,
+                tenants="", adaptive=False,
+            )
+            if mode == "adaptive":
+                kw.update(
+                    tenants=(
+                        "good:weight=4;"
+                        "abuser:rate=10,burst=4,share=0.25"
+                    ),
+                    adaptive=True, latency_budget_s=0.25,
+                )
+            svc = SolverService(**kw)
+            svc.cache.ensure_manifest(k_ab, (1, 4))
+            svc.cache.ensure_manifest(k_good, (1, 4))
+            svc.warmup()  # the burst measures queueing, not compiles
+            refused = 0
+            t0 = time.perf_counter()
+            with _m.deltas() as d:
+                futs = []
+                for _ in range(flood):
+                    try:
+                        futs.append(svc.submit(
+                            "gesv", A_a, B_a, tenant="abuser",
+                            priority="low",
+                        ))
+                    except SlateError:
+                        refused += 1  # quota/share Rejected or Shed
+                for A, B in good_probs:
+                    futs.append(svc.submit(
+                        "gesv", A, B, tenant="good", priority="high",
+                    ))
+                for f in futs:
+                    assert np.all(np.isfinite(f.result(timeout=600)))
+            dt = time.perf_counter() - t0
+            svc.stop()
+            # the victim's p99: per-tenant histogram when the plane is
+            # on, the good bucket's histogram for the static baseline
+            # (same requests — the abuser rides a different bucket)
+            h = d.hist(
+                "serve.latency.tenant.good.total" if mode == "adaptive"
+                else f"serve.latency.{k_good.label}.total"
+            )
+            out[mode] = {
+                "seconds": round(dt, 3),
+                "good_p99_ms": (
+                    round(h["p99"] * 1e3, 2) if h else None
+                ),
+                "abuser_refused": refused,
+                "shed": int(d.get("serve.shed")),
+                "rejected_quota": int(d.get("serve.rejected_quota")),
+            }
+        return out
+
+    run_entry("serve_multitenant", entry_serve_multitenant)
+
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
 

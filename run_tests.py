@@ -586,6 +586,222 @@ def factor_gate() -> int:
             pass
 
 
+# Two-leg bursty two-tenant stream for the --adaptive gate.  Same
+# phase-1 trace both legs: an abusive tenant floods 48 requests, then a
+# well-behaved tenant submits 8 on its own bucket; every dispatch pays
+# a deterministic injected 30 ms (machine-independent queueing).  The
+# STATIC leg (tenancy/adaptation off — tags accepted but inert) must
+# PROVABLY miss the well-behaved p99 budget: the flood head-of-line
+# blocks the shared FIFO.  The ADAPTIVE leg (tenant quotas + WFQ +
+# adaptive window) must hold it, then two overload phases (tight-
+# deadline abuser traffic driving the burn EWMA up) must end in typed
+# Shed refusals — every admitted future still resolves.
+_ADAPTIVE_DRIVER = """
+import sys
+import time
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import SlateError
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import Rejected, Shed, SolverService
+
+mode = sys.argv[1]  # "static" | "adaptive"
+BUDGET = 0.25
+n_good, n_abuse = 24, 12  # distinct buckets: the flood never coalesces
+                          # with the victim's traffic
+
+kw = dict(cache=ExecutableCache(manifest_path=None), batch_max=4,
+          batch_window_s=0.01, dim_floor=16, nrhs_floor=4)
+if mode == "adaptive":
+    kw.update(
+        tenants="good:weight=4;abuser:rate=10,burst=4,share=0.25",
+        adaptive=True, latency_budget_s=BUDGET,
+    )
+svc = SolverService(**kw)
+k_good = bk.bucket_for("gesv", n_good, n_good, 2, np.float64, floor=16,
+                       nrhs_floor=4)
+k_abuse = bk.bucket_for("gesv", n_abuse, n_abuse, 2, np.float64, floor=16,
+                        nrhs_floor=4)
+svc.cache.ensure_manifest(k_good, (1, 4))
+svc.cache.ensure_manifest(k_abuse, (1, 4))
+svc.warmup()  # the burst measures queueing, not compiles
+faults.configure("latency:every=1,ms=30")  # armed POST-warmup
+faults.on()
+
+def prob(n, seed):
+    r = np.random.default_rng(seed)
+    return (r.standard_normal((n, n)) + n * np.eye(n),
+            r.standard_normal((n, 2)))
+
+A_a, B_a = prob(n_abuse, 1)
+futs, shed, rejected = [], 0, 0
+
+def sub(**skw):
+    global shed, rejected
+    try:
+        futs.append(svc.submit("gesv", A_a, B_a, tenant="abuser",
+                               priority="low", **skw))
+    except Shed:
+        shed += 1
+    except Rejected:
+        rejected += 1
+
+for _ in range(48):  # phase 1: the flood...
+    sub()
+for i in range(8):  # ...then the victim
+    A, B = prob(n_good, 100 + i)
+    futs.append(svc.submit("gesv", A, B, tenant="good", priority="high",
+                           deadline=10.0))
+if mode == "adaptive":
+    # phase 2: tight-deadline abuser traffic melts its own SLO — the
+    # burn EWMA climbs; phase 3: the controller must be shedding
+    time.sleep(0.4)  # tokens refill (~4), phase-1 queue drains
+    for _ in range(8):
+        sub(deadline=0.02)
+    deadline = time.monotonic() + 10.0
+    while shed == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+        sub(deadline=0.02)
+ok = typed = 0
+for f in futs:
+    try:
+        assert np.all(np.isfinite(f.result(timeout=300)))
+        ok += 1
+    except SlateError:
+        typed += 1
+assert ok + typed == len(futs), "a future hung"
+faults.reset()
+h = svc.health()
+svc.stop()
+p99_good_bucket = metrics.percentile(
+    f"serve.latency.{k_good.label}.total", 99)
+if mode == "static":
+    assert p99_good_bucket is not None and p99_good_bucket > BUDGET, (
+        "static config should have missed the %.0f ms budget, got %s"
+        % (BUDGET * 1e3, p99_good_bucket))
+    print(f"adaptive driver [static]: victim p99 "
+          f"{p99_good_bucket * 1e3:.0f} ms MISSES the "
+          f"{BUDGET * 1e3:.0f} ms budget (as designed), "
+          f"{ok} delivered / {typed} typed")
+else:
+    p99_good = metrics.percentile("serve.latency.tenant.good.total", 99)
+    assert p99_good is not None and p99_good <= BUDGET, (
+        "adaptive config missed the victim budget: %s" % p99_good)
+    assert shed > 0, "overload never shed the abuser"
+    assert rejected > 0, "the abuser quota never rejected"
+    assert h["tenants"]["abuser"]["shed"] == shed
+    assert h["admission"]["overload_level"] >= 1, h["admission"]
+    assert any(k_abuse.label in k or k_good.label in k
+               for k in h["admission"]["windows"]), h["admission"]
+    print(f"adaptive driver [adaptive]: victim p99 "
+          f"{p99_good * 1e3:.0f} ms holds the {BUDGET * 1e3:.0f} ms "
+          f"budget; abuser shed={shed} quota-rejected={rejected}; "
+          f"{ok} delivered / {typed} typed, 0 hangs")
+"""
+
+# tenant_flood chaos leg: the site is armed via env (the production
+# activation path), one real submit triggers a synthetic 24-request
+# low-priority burst from tenant "flood", whose tight quota refuses
+# most of it — chaos_report then joins faults.injected.tenant_flood
+# against the serve.shed/serve.rejected* recovery family.
+_FLOOD_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+svc = SolverService(cache=ExecutableCache(manifest_path=None), batch_max=4,
+                    dim_floor=16, nrhs_floor=4)
+assert svc._admission is not None, "SLATE_TPU_TENANTS must arm the plane"
+rng = np.random.default_rng(0)
+n = 12
+A = rng.standard_normal((n, n)) + n * np.eye(n)
+B = rng.standard_normal((n, 2))
+X = svc.submit("gesv", A, B, tenant="good").result(timeout=300)
+assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+c = metrics.counters()
+assert c.get("faults.injected.tenant_flood", 0) >= 1, c
+refused = c.get("serve.rejected", 0) + c.get("serve.shed", 0)
+assert refused >= 1, "the flood burst was never refused"
+svc.stop()
+print(f"flood driver: 1 real request delivered, synthetic burst "
+      f"refused {int(refused)}x")
+"""
+
+
+def adaptive_gate() -> int:
+    """Admission/fairness gate, three legs: (1) the admission suite
+    (fake-clock controller units + the fairness invariant); (2) the
+    two-leg bursty two-tenant stream — the static config must
+    provably MISS the well-behaved tenant's p99 budget while the
+    adaptive config holds it, sheds the abuser, and resolves every
+    future typed — with tools/tenant_report.py rendering the
+    per-tenant verdict from the adaptive leg's JSONL; (3) a
+    tenant_flood chaos leg joined by tools/chaos_report.py."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_admission.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_adaptive_") as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for var in ("SLATE_TPU_FAULTS", "SLATE_TPU_TENANTS",
+                    "SLATE_TPU_ADAPTIVE", "SLATE_TPU_FACTOR_CACHE"):
+            env.pop(var, None)
+        # leg 2a: static config — the driver asserts the budget MISS
+        rc = subprocess.call(
+            [sys.executable, "-c", _ADAPTIVE_DRIVER, "static"],
+            env=dict(env, SLATE_TPU_METRICS=os.path.join(td, "static.jsonl")),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        # leg 2b: adaptive config — holds the budget, sheds the abuser
+        jsonl = os.path.join(td, "adaptive.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _ADAPTIVE_DRIVER, "adaptive"],
+            env=dict(env, SLATE_TPU_METRICS=jsonl), cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "tenant_report.py"),
+             jsonl, "--p99-budget", "0.25", "--well-behaved", "good",
+             "--abusive", "abuser"],
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        # leg 3: tenant_flood chaos attribution
+        flood = os.path.join(td, "flood.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _FLOOD_DRIVER],
+            env=dict(
+                env, SLATE_TPU_METRICS=flood,
+                SLATE_TPU_TENANTS="flood:rate=1,burst=2,share=0.1",
+                SLATE_TPU_FAULTS="tenant_flood:once,burst=24",
+            ),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "chaos_report.py"),
+             flood],
+            cwd=here,
+        )
+
+
 # Restart-drill drivers for the --coldstart gate.  Each runs in its OWN
 # subprocess so the restore leg is a true fresh interpreter: nothing
 # carries over but the artifact dir + manifest on disk.
@@ -958,6 +1174,12 @@ def main() -> int:
                          "env-activated repeated-A stream gated by "
                          "tools/factor_report.py (zero hits on a "
                          "repeated-A stream fails)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="run the admission suite + the bursty "
+                         "two-tenant stream (static config misses the "
+                         "victim's p99 budget, adaptive holds it and "
+                         "sheds the abuser; tenant_report verdict) + "
+                         "the tenant_flood chaos join")
     ap.add_argument("--perf", action="store_true",
                     help="run the devmon suite + the bench_diff "
                          "regression sentinel (true pair passes, "
@@ -989,6 +1211,8 @@ def main() -> int:
         return latency_gate()
     if args.factor:
         return factor_gate()
+    if args.adaptive:
+        return adaptive_gate()
     if args.perf:
         return perf_gate()
 

@@ -40,6 +40,16 @@ Sites (:data:`SITES`) and where they are checked:
                        hit path's residual validation must catch it,
                        bump ``serve.factor_cache.stale``, and re-solve
                        direct (``serve.service`` solve-phase dispatch)
+    ``tenant_flood``   a synthetic burst of ``burst=`` low-priority
+                       requests from tenant ``"flood"`` cloning the
+                       triggering request's operands is injected at
+                       admission (``serve.service.SolverService._submit``
+                       on a tenancy-enabled service) — the fairness
+                       machinery must absorb it (token-bucket quota
+                       rejections / overload shedding) without the
+                       well-behaved tenants' SLO melting; joined by
+                       tools/chaos_report.py against ``serve.shed`` /
+                       ``serve.rejected``
 
 Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
 site, so the fire pattern is a pure function of ``seed`` and the call
@@ -69,7 +79,7 @@ Spec grammar (``SLATE_TPU_FAULTS`` / :func:`configure`)::
     site_spec := site ':' item (',' item)*
     item      := 'p=<float>' | 'every=<int>' | 'once'
                | 'after=<int>' | 'seed=<int>' | 'ms=<float>'
-               | 'info=<int>'
+               | 'info=<int>' | 'burst=<int>'
 
 Every injection increments ``faults.injected.<site>`` in the metrics
 registry and the site's local stats (:func:`stats`), so
@@ -102,6 +112,7 @@ SITES = (
     "artifact_stale",
     "artifact_load_fail",
     "factor_stale",
+    "tenant_flood",
 )
 
 
@@ -127,6 +138,7 @@ class _Site:
     seed: int = 0
     ms: float = 1.0  # latency-site sleep duration
     info: int = 1  # info_nonzero-site injected value
+    burst: int = 8  # tenant_flood-site synthetic request count
     calls: int = 0
     fired: int = 0
     rng: random.Random = field(default_factory=random.Random)
@@ -174,6 +186,7 @@ def arm(
     seed: int = 0,
     ms: float = 1.0,
     info: int = 1,
+    burst: int = 8,
 ) -> None:
     """Arm one site with exactly one trigger (p / every / once).  Does
     NOT enable injection — call :func:`on` (or let the env spec do it)."""
@@ -187,6 +200,7 @@ def arm(
     s = _Site(
         name=site, p=float(p), every=int(every), once=bool(once),
         after=int(after), seed=int(seed), ms=float(ms), info=int(info),
+        burst=int(burst),
     )
     # per-site stream: the same seed arms several sites independently
     s.rng = random.Random(f"{s.seed}:{site}")
@@ -222,7 +236,7 @@ def configure(spec: str) -> None:
                 raise ValueError(f"fault spec item {item!r} in {part!r}")
             if k in ("p", "ms"):
                 kw[k] = float(v)
-            elif k in ("every", "after", "seed", "info"):
+            elif k in ("every", "after", "seed", "info", "burst"):
                 kw[k] = int(v)
             else:
                 raise ValueError(

@@ -173,6 +173,45 @@ def observe(name: str, seconds: float) -> None:
             t[3] = max(t[3], seconds)
 
 
+# -- bounded-cardinality key families ---------------------------------------
+
+
+class CappedKeys:
+    """Cardinality cap for metric-name families keyed by an UNBOUNDED
+    id (matrix fingerprints, tenant ids): the registry is a plain dict,
+    so a churning id stream would otherwise leak one key per distinct
+    id forever.  The first ``cap`` distinct ids are tracked —
+    :meth:`track` returns True and the caller emits its per-id metrics
+    — later ids return False and the caller routes the event into one
+    overflow counter instead.  Thread-safe; one instance per family
+    (serve.factor_cache.fp.*, serve.tenant.*)."""
+
+    __slots__ = ("cap", "_seen", "_lock")
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def track(self, key: str) -> bool:
+        """True when ``key`` may emit per-key metrics (already tracked,
+        or tracked now because the family is under its cap)."""
+        with self._lock:
+            if key in self._seen:
+                return True
+            if len(self._seen) < self.cap:
+                self._seen.add(key)
+                return True
+            return False
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seen.clear()
+
+
 # -- histograms (fixed log-spaced buckets; the tail-latency primitive) ------
 
 #: bucket lattice: 10 buckets per decade from 1 µs to 1000 s.  FIXED for

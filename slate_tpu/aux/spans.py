@@ -38,13 +38,18 @@ Activation::
 
 Span taxonomy the serve tier emits (service.py / cache.py):
 ``request`` (root: admit -> deliver, attrs ``routine``/``bucket``/
-``outcome``), ``admit``, ``queued`` (ends at dispatch; attrs
+``outcome`` — plus ``tenant``/``priority`` on a tenancy-enabled
+service), ``admit``, ``queued`` (ends at dispatch; attrs
 ``replica``), ``coalesce``, ``execute`` (the padded-batch dispatch;
 attrs ``batch``), ``direct`` (fallback / keyless path), ``backoff``
 (the planned retry delay; attrs ``backoff_s``/``retries_left``),
 ``build`` (cold executable build; attrs ``origin``), ``restore``
 (artifact-restore entries; attrs ``outcome``/``origin``), and instant
-events ``breaker_open``/``breaker_half_open``/``breaker_closed``.
+events ``breaker_open``/``breaker_half_open``/``breaker_closed`` plus
+the admission plane's ``shed`` (attrs ``tenant``/``priority``/
+``level``), ``overload_enter``/``overload_exit`` (attrs ``level``/
+``sheds``), and ``adaptive_window`` (attrs ``bucket``/``window_s``/
+``direction`` — the AIMD trajectory, one instant per decision).
 Driver phases (``@metrics.instrumented``) and ``trace.Block`` mirror
 onto the same ring when both layers are on.
 """

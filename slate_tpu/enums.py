@@ -356,6 +356,9 @@ class Option(enum.Enum):
     ServeFactorCache = "serve_factor_cache"  # enable the factorization cache
     ServeFactorCacheEntries = "serve_factor_cache_entries"  # LRU entry cap
     ServeFactorCacheBytes = "serve_factor_cache_bytes"  # LRU byte budget
+    ServeTenantQuota = "serve_tenant_quota"  # tenant spec (admission grammar)
+    ServeAdaptiveWindow = "serve_adaptive_window"  # AIMD batch-window control
+    ServeLatencyBudget = "serve_latency_budget"  # p99 budget, s (0 = off)
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

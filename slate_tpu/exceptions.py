@@ -15,7 +15,8 @@ class SlateError(Exception):
 
     Structured context: layers that resolve request futures (serve/) or
     dispatch drivers attach ``routine``, ``bucket`` (BucketKey label),
-    and ``attempt`` via :meth:`with_context` wherever an exception is
+    ``attempt``, and — on a tenancy-enabled service — ``tenant`` /
+    ``priority`` via :meth:`with_context` wherever an exception is
     set, so operators can triage a failure from the exception object
     alone instead of scraping logs.  The fields render in ``str(e)``
     and stay machine-readable on the instance (:meth:`context`).
@@ -24,12 +25,16 @@ class SlateError(Exception):
     routine: Optional[str] = None
     bucket: Optional[str] = None
     attempt: Optional[int] = None
+    tenant: Optional[str] = None
+    priority: Optional[str] = None  # class name: high | normal | low
 
     def with_context(
         self,
         routine: Optional[str] = None,
         bucket: Optional[str] = None,
         attempt: Optional[int] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> "SlateError":
         """Attach structured context; returns ``self`` for chaining
         (``raise InvalidInput(msg).with_context(routine="gesv")``)."""
@@ -39,6 +44,10 @@ class SlateError(Exception):
             self.bucket = bucket
         if attempt is not None:
             self.attempt = int(attempt)
+        if tenant is not None:
+            self.tenant = tenant
+        if priority is not None:
+            self.priority = str(priority)
         return self
 
     def context(self) -> dict:
@@ -49,6 +58,8 @@ class SlateError(Exception):
                 ("routine", self.routine),
                 ("bucket", self.bucket),
                 ("attempt", self.attempt),
+                ("tenant", self.tenant),
+                ("priority", self.priority),
             )
             if v is not None
         }

@@ -70,6 +70,13 @@ _DEFAULTS = {
     Option.ServeFactorCache: False,
     Option.ServeFactorCacheEntries: 32,  # LRU entry cap
     Option.ServeFactorCacheBytes: 1 << 30,  # LRU byte budget (1 GiB)
+    # admission control (serve/admission.py): all three default
+    # degenerate — no tenant spec, static batch window, no latency
+    # budget — which keeps the service byte-identical to the
+    # pre-admission tier (one `is None` branch per submit)
+    Option.ServeTenantQuota: "",  # tenant spec ("" = tenancy off)
+    Option.ServeAdaptiveWindow: False,  # AIMD window controller off
+    Option.ServeLatencyBudget: 0.0,  # service-wide p99 budget (s; 0 = off)
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

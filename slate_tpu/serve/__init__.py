@@ -8,7 +8,9 @@ tier — replica scale-out + spmd submesh routing (`placement`,
 ``Option.ServeReplicas/ServeMesh/ServeShardThreshold``) — a
 factor-once/solve-many factorization cache dispatching trsm-only
 executables on repeated-A traffic (`factor_cache`,
-``SLATE_TPU_FACTOR_CACHE``) — a
+``SLATE_TPU_FACTOR_CACHE``) — an overload-resilient admission plane:
+tenant fairness/quotas, priority shedding, and an AIMD-adaptive batch
+window (`admission`, ``SLATE_TPU_TENANTS``/``SLATE_TPU_ADAPTIVE``) — a
 deadline-aware batching service with a cold/restoring/ready readiness
 phase (`service`), and thin sync wrappers (`api`):
 ``serve.gesv/posv/gels``, ``serve.submit``, ``serve.warmup``,
@@ -33,7 +35,8 @@ _API = (
     "invalidate_all", "update_factor",
 )
 _SERVICE = (
-    "SolverService", "Rejected", "DeadlineExceeded", "decorrelated_backoff",
+    "SolverService", "Rejected", "DeadlineExceeded", "Shed",
+    "decorrelated_backoff",
     "PHASE_COLD", "PHASE_RESTORING", "PHASE_READY",
 )
 _CACHE = ("ExecutableCache", "direct_call", "WARMUP_ENV")
@@ -43,17 +46,23 @@ _BUCKETS = (
 )
 _ARTIFACTS = ("ArtifactStore", "ARTIFACTS_ENV", "store_from_env")
 _PLACEMENT = ("PlacementPolicy",)
+_ADMISSION = (
+    "AdmissionControl", "TenantConfig", "parse_tenants", "FairQueue",
+    "AdaptiveWindow", "OverloadController", "TokenBucket", "TENANTS_ENV",
+    "ADAPTIVE_ENV",
+)
 _FACTOR = (
     "FactorCache", "FactorEntry", "matrix_fingerprint",
     "FACTOR_CACHE_ENV",
 )
 _SUBMODULES = (
     "api", "buckets", "cache", "service", "artifacts", "placement",
-    "factor_cache",
+    "factor_cache", "admission",
 )
 
 __all__ = list(
     _API + _SERVICE + _CACHE + _BUCKETS + _ARTIFACTS + _PLACEMENT + _FACTOR
+    + _ADMISSION
 ) + list(_SUBMODULES)
 
 
@@ -73,6 +82,10 @@ def __getattr__(name: str):
     if name in _PLACEMENT:
         return getattr(
             importlib.import_module(".placement", __name__), name
+        )
+    if name in _ADMISSION:
+        return getattr(
+            importlib.import_module(".admission", __name__), name
         )
     if name in _FACTOR:
         return getattr(

@@ -48,7 +48,12 @@ from ..enums import Option
 from ..exceptions import InvalidInput  # noqa: F401  (re-export: taxonomy)
 from ..options import Options, get_option
 from .cache import ExecutableCache
-from .service import DeadlineExceeded, Rejected, SolverService  # noqa: F401
+from .service import (  # noqa: F401  (re-export: taxonomy)
+    DeadlineExceeded,
+    Rejected,
+    Shed,
+    SolverService,
+)
 
 _lock = threading.Lock()
 _service: Optional[SolverService] = None
@@ -78,6 +83,20 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         schedule=get_option(opts, Option.Schedule),
         precision=str(get_option(opts, Option.ServePrecision) or "full"),
         faults_spec=str(get_option(opts, Option.Faults) or ""),
+    )
+    # admission-plane options pass through only when EXPLICITLY set:
+    # collapsing an explicit off value ("", False, 0.0) to None would
+    # let AdmissionControl.from_options re-resolve the env, so a
+    # baseline/AB caller could never disable env-armed tenancy from
+    # here (the env-override trap factor_cache=False exists for)
+    _unset = object()
+    tq = get_option(opts, Option.ServeTenantQuota, _unset)
+    aw = get_option(opts, Option.ServeAdaptiveWindow, _unset)
+    lb = get_option(opts, Option.ServeLatencyBudget, _unset)
+    cfg.update(
+        tenants=None if tq is _unset else tq,
+        adaptive=None if aw is _unset else bool(aw),
+        latency_budget_s=None if lb is _unset else float(lb),
     )
     cfg.update(kw)
     if cfg.get("factor_cache") is None:
@@ -159,23 +178,31 @@ def submit(
     retries: int = 0,
     precision: Optional[str] = None,
     sharded: Optional[bool] = None,
+    tenant: Optional[str] = None,
+    priority=None,
 ) -> Future:
     """Async entry: enqueue and return the Future (see
     :meth:`SolverService.submit`).  ``precision`` ("full"|"mixed")
     overrides the service-wide solve path for this request;
     ``sharded`` overrides the placement policy (True forces the spmd
-    submesh, False the replicated tier, None routes by size)."""
+    submesh, False the replicated tier, None routes by size).
+    ``tenant``/``priority`` ("high"|"normal"|"low") tag the request
+    for the admission plane (``SLATE_TPU_TENANTS`` /
+    ``Option.ServeTenantQuota``): per-tenant fair queueing and quotas,
+    priority-ordered overload shedding (typed :class:`Shed`)."""
     return get_service().submit(
         routine, A, B, deadline=deadline, retries=retries,
-        precision=precision, sharded=sharded,
+        precision=precision, sharded=sharded, tenant=tenant,
+        priority=priority,
     )
 
 
 def _sync(routine, A, B, deadline, retries, precision=None,
-          sharded=None) -> np.ndarray:
+          sharded=None, tenant=None, priority=None) -> np.ndarray:
     fut = submit(
         routine, A, B, deadline=deadline, retries=retries,
-        precision=precision, sharded=sharded,
+        precision=precision, sharded=sharded, tenant=tenant,
+        priority=priority,
     )
     # no result-timeout: the worker resolves every admitted future
     # (deadline expiry included), so blocking here cannot hang
@@ -184,27 +211,34 @@ def _sync(routine, A, B, deadline, retries, precision=None,
 
 def gesv(A, B, deadline: Optional[float] = None, retries: int = 0,
          precision: Optional[str] = None,
-         sharded: Optional[bool] = None) -> np.ndarray:
+         sharded: Optional[bool] = None,
+         tenant: Optional[str] = None, priority=None) -> np.ndarray:
     """Solve A X = B (square, LU with partial pivoting) through the
     service; returns X (n x nrhs).  ``precision="mixed"`` routes the
     request through a mixed-precision bucket (low-precision factor +
     iterative refinement; non-converged solves are transparently
     re-solved on the full-precision direct path).  ``sharded=True``
     forces the spmd submesh (Option.ServeMesh) — large-n requests
-    route there automatically past Option.ServeShardThreshold."""
-    return _sync("gesv", A, B, deadline, retries, precision, sharded)
+    route there automatically past Option.ServeShardThreshold.
+    ``tenant``/``priority`` tag the request for the admission plane."""
+    return _sync("gesv", A, B, deadline, retries, precision, sharded,
+                 tenant, priority)
 
 
 def posv(A, B, deadline: Optional[float] = None, retries: int = 0,
          precision: Optional[str] = None,
-         sharded: Optional[bool] = None) -> np.ndarray:
+         sharded: Optional[bool] = None,
+         tenant: Optional[str] = None, priority=None) -> np.ndarray:
     """Solve SPD A X = B (Cholesky, lower triangle referenced)."""
-    return _sync("posv", A, B, deadline, retries, precision, sharded)
+    return _sync("posv", A, B, deadline, retries, precision, sharded,
+                 tenant, priority)
 
 
-def gels(A, B, deadline: Optional[float] = None, retries: int = 0) -> np.ndarray:
+def gels(A, B, deadline: Optional[float] = None, retries: int = 0,
+         tenant: Optional[str] = None, priority=None) -> np.ndarray:
     """Least-squares solve min ||A X - B|| (m >= n batched; m < n direct)."""
-    return _sync("gels", A, B, deadline, retries)
+    return _sync("gels", A, B, deadline, retries, tenant=tenant,
+                 priority=priority)
 
 
 def health() -> dict:

@@ -60,6 +60,41 @@ PRECISIONS = ("full", "mixed")
 #: posv: two trsm sweeps) — O(n^2 nrhs) against the full phase's O(n^3)
 PHASES = ("full", "solve")
 
+#: request priority classes at admission (serve/admission.py), highest
+#: first: under sustained SLO burn the overload controller sheds
+#: lowest-priority-first — "low" is shed at level 1, "normal" joins it
+#: at level 2, "high" is never shed (only bounded-queue / quota
+#: Rejected can refuse it).  Defined HERE (the import-pure serving
+#: module) so the admission plane, the service and the error context
+#: share one ordering.
+PRIORITIES = ("high", "normal", "low")
+PRIO_HIGH, PRIO_NORMAL, PRIO_LOW = 0, 1, 2
+
+#: tenant id of requests submitted without one — the anonymous pool
+DEFAULT_TENANT = "default"
+
+
+def check_priority(priority) -> int:
+    """Normalize a priority ("high"|"normal"|"low", or its index) to
+    the integer class; raises on anything else."""
+    if isinstance(priority, str):
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r} ({'|'.join(PRIORITIES)})"
+            )
+        return PRIORITIES.index(priority)
+    p = int(priority)
+    if not 0 <= p < len(PRIORITIES):
+        raise ValueError(
+            f"priority index out of range: {p} (0..{len(PRIORITIES) - 1})"
+        )
+    return p
+
+
+def priority_name(level: int) -> str:
+    """The class name of a priority index (error context / reports)."""
+    return PRIORITIES[check_priority(level)]
+
 
 def check_precision(precision: str) -> str:
     """Validate a serving-precision string; returns it unchanged."""

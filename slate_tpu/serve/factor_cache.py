@@ -109,9 +109,10 @@ def matrix_fingerprint(
 #: grow with DISTINCT matrices — a churning-A service would otherwise
 #: leak one registry key per request, forever.  Past the cap, events
 #: still count globally and per bucket; the overflow itself is counted.
+#: (``metrics.CappedKeys`` — the same guard the admission plane puts on
+#: its ``serve.tenant.<id>.*`` families.)
 FP_METRIC_CAP = 256
-_fp_seen: set = set()
-_fp_lock = threading.Lock()
+_fp_keys = metrics.CappedKeys(FP_METRIC_CAP)
 
 
 def record(event: str, fp: Optional[str] = None,
@@ -125,12 +126,7 @@ def record(event: str, fp: Optional[str] = None,
         metrics.inc(f"serve.factor_cache.{label}.{event}", n)
     if fp:
         fp12 = fp[:12]
-        with _fp_lock:
-            tracked = fp12 in _fp_seen
-            if not tracked and len(_fp_seen) < FP_METRIC_CAP:
-                _fp_seen.add(fp12)
-                tracked = True
-        if tracked:
+        if _fp_keys.track(fp12):
             metrics.inc(f"serve.factor_cache.fp.{fp12}.{event}", n)
         else:
             metrics.inc("serve.factor_cache.fp_overflow", n)
@@ -139,12 +135,7 @@ def record(event: str, fp: Optional[str] = None,
 def _fp_gauge(fp: str, value: float) -> None:
     """Per-fingerprint bytes gauge, under the same cardinality cap."""
     fp12 = fp[:12]
-    with _fp_lock:
-        tracked = fp12 in _fp_seen
-        if not tracked and len(_fp_seen) < FP_METRIC_CAP:
-            _fp_seen.add(fp12)
-            tracked = True
-    if tracked:
+    if _fp_keys.track(fp12):
         metrics.gauge(f"serve.factor_cache.fp.{fp12}.bytes", value)
 
 

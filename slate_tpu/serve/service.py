@@ -63,6 +63,19 @@ sharded lane — the multi-device serving tier ROADMAP item 1 asks for):
   only (no retry: the failure is deterministic); a non-finite solution
   for finite inputs (the ``result_corrupt`` fault site) re-solves that
   item on the direct driver instead of delivering garbage.
+* **Admission plane** (`serve/admission.py`, optional): with a tenant
+  spec (``SLATE_TPU_TENANTS`` / ``Option.ServeTenantQuota``) each
+  request carries ``tenant``/``priority``; lane FIFOs become
+  per-tenant weighted-fair queues, token-bucket quotas and queue-share
+  caps make :class:`Rejected` per-tenant (a hot tenant sheds its own
+  load first), and under sustained deadline-budget burn the overload
+  controller refuses lowest-priority-first with a typed :class:`Shed`
+  (breaker-style hysteresis — never flaps).  With adaptation on
+  (``SLATE_TPU_ADAPTIVE`` / ``Option.ServeAdaptiveWindow``), each
+  bucket's coalesce window is AIMD-tuned against the p99 budget with
+  ``batch_window_s`` as the ceiling (Clipper's shape), every decision
+  recorded.  Unconfigured, the plane is None and every path is
+  byte-identical to the pre-admission tier.
 * :meth:`SolverService.health` returns a liveness/readiness snapshot
   (total + per-replica queue depth, per-replica worker liveness /
   restarts / dispatch counts / breaker states, recent failure rate)
@@ -94,7 +107,15 @@ counters, ``serve.replica.<i>.dispatched``, ``serve.batched``,
 ``serve.degraded`` alias for open transitions),
 ``serve.numerical_errors``, ``serve.corrupt_result``; per-bucket
 compile/run split via the cache's instrumented executables;
-``faults.injected.<site>`` from aux/faults when chaos is on.
+``faults.injected.<site>`` from aux/faults when chaos is on.  The
+admission plane adds ``serve.shed``, ``serve.rejected_quota`` /
+``serve.rejected_share``, capped per-tenant families
+``serve.tenant.<id>.{admitted,shed,rejected,slo_burn.*}`` +
+``serve.latency.tenant.<id>.total`` histograms
+(``serve.tenant_overflow`` past the cap), ``serve.overload.level``
+gauge + ``.enter``/``.exit`` counters, and per-bucket
+``serve.adaptive.<label>.window_s`` gauges with ``.widen``/``.shrink``
+change counters (``serve.adaptive.changes`` total).
 
 Latency observability (this file is where the split is measured):
 ``serve.latency.<bucket>.queued`` / ``.execute`` / ``.total``
@@ -127,6 +148,7 @@ import numpy as np
 
 from ..aux import devmon, faults, metrics, spans
 from ..exceptions import InvalidInput, NumericalError, SlateError
+from . import admission as _adm
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
 from .factor_cache import (
@@ -143,11 +165,24 @@ from .placement import PlacementPolicy
 
 
 class Rejected(SlateError):
-    """Queue-full backpressure: the request was never admitted."""
+    """Queue-full backpressure: the request was never admitted.  On a
+    tenancy-enabled service this is PER-TENANT — a token-bucket quota
+    or queue-share violation rejects the hot tenant's request while
+    its neighbors keep being admitted."""
 
 
 class DeadlineExceeded(SlateError):
     """The request's deadline passed before execution started."""
+
+
+class Shed(SlateError):
+    """Load shed under sustained overload: the service's burn EWMA
+    crossed a shed tier and this request's priority class is being
+    refused at admission (lowest-priority-first;
+    ``serve/admission.OverloadController``).  Distinct from
+    :class:`Rejected` — the queue may have room, but accepting more
+    work at this priority would melt the SLO of what is already
+    queued.  Back off and retry, or resubmit at a higher priority."""
 
 
 #: ceiling for one decorrelated-jitter backoff step, seconds
@@ -175,8 +210,12 @@ def decorrelated_backoff(
     return min(cap_s, rng.uniform(base_s, hi))
 
 
-@dataclass
+@dataclass(eq=False)
 class _Request:
+    # eq=False: requests are identities, not values — the queues'
+    # remove()-based sweep/coalesce must match THIS request (the
+    # dataclass-generated __eq__ would compare the ndarray operands,
+    # which raises on truthiness and could alias equal requests)
     routine: str
     key: Optional[_bk.BucketKey]  # None => direct-only (e.g. gels m < n)
     A: np.ndarray
@@ -191,6 +230,12 @@ class _Request:
     backoff_s: float = 0.0  # last backoff delay (decorrelated jitter state)
     not_before: float = 0.0  # monotonic eligibility time after a retry
     t_submit: float = field(default_factory=time.monotonic)
+    # admission-plane identity (defaults when the plane is off; tenanted
+    # marks a request admitted THROUGH the plane, so error context and
+    # control-loop accounting only engage where tenancy is real)
+    tenant: str = _bk.DEFAULT_TENANT
+    priority: int = _bk.PRIO_NORMAL
+    tenanted: bool = False
     # factor-cache state (both None/False when the cache is off):
     # the matrix fingerprint of A, and whether admission missed (the
     # request factors via _factor_direct instead of the batched path)
@@ -306,6 +351,29 @@ class SolverService:
         a factor that no longer matches A (the ``factor_stale`` chaos
         site) is dropped and the request re-solved direct, never a
         wrong X.
+    tenants: admission-plane tenant spec — a grammar string
+        (``serve/admission.py``: ``"gold:weight=4;free:rate=20,
+        share=0.25"``) or a parsed ``{name: TenantConfig}`` dict.
+        None resolves ``Option.ServeTenantQuota`` then the
+        ``SLATE_TPU_TENANTS`` env.  Configuring ANY tenant turns the
+        plane on: per-lane queues become weighted-fair across tenants,
+        token-bucket quotas and queue-share caps reject a hot
+        tenant's overflow (per-tenant :class:`Rejected`), and the
+        overload controller sheds lowest-priority-first with a typed
+        :class:`Shed` under sustained burn.  Unconfigured (the
+        default) the plane is OFF — one ``is None`` branch per
+        submit, byte-identical behavior.
+    adaptive: AIMD batch-window controller
+        (``Option.ServeAdaptiveWindow`` / ``SLATE_TPU_ADAPTIVE`` when
+        None): per bucket, the coalesce window is tuned from observed
+        delivered latency vs. the p99 budget — additive increase
+        toward ``batch_window_s`` (the ceiling) while under budget,
+        multiplicative decrease when over (Clipper's shape) — with
+        every decision recorded (``serve.adaptive.*``).
+    latency_budget_s: the service-wide p99 budget the controllers
+        compare against (``Option.ServeLatencyBudget`` when None);
+        per-request deadlines override it per request.  0 disables
+        burn-driven control (the plane still does tenancy).
     faults_spec: aux/faults grammar string; arms + enables injection
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
@@ -339,6 +407,9 @@ class SolverService:
         placement: Optional[PlacementPolicy] = None,
         replicas: Optional[int] = None,
         factor_cache: Union[FactorCache, bool, None] = None,
+        tenants=None,
+        adaptive: Optional[bool] = None,
+        latency_budget_s: Optional[float] = None,
         faults_spec: Optional[str] = None,
         restore_on_start: Optional[bool] = None,
         start: bool = True,
@@ -454,6 +525,17 @@ class SolverService:
         self._shard_rep: Optional[_Replica] = (
             _Replica("sharded") if self.placement.mesh else None
         )
+        # the admission plane (tenancy + priority shedding + adaptive
+        # batch window): None unless configured — the zero-overhead
+        # contract is one `is None` branch per submit, plain deque
+        # lanes, and byte-identical behavior
+        self._admission = _adm.AdmissionControl.from_options(
+            tenants=tenants, adaptive=adaptive,
+            budget_s=latency_budget_s, ceiling_s=self.batch_window_s,
+        )
+        if self._admission is not None:
+            for rep in self._lanes:
+                rep.q = self._admission.new_queue()
         self._restarts = 0
         self._recent_fail: Deque[float] = deque(maxlen=256)
         # latency-histogram labels this service has dispatched (the SLO
@@ -665,6 +747,8 @@ class SolverService:
         retries: int = 0,
         precision: Optional[str] = None,
         sharded: Optional[bool] = None,
+        tenant: Optional[str] = None,
+        priority=None,
     ) -> Future:
         """Enqueue one solve; returns a Future resolving to the cropped
         solution X (n x nrhs ndarray).
@@ -677,26 +761,36 @@ class SolverService:
         placement policy: True forces the spmd submesh (raises
         ValueError when none is configured or the routine has no
         sharded path), False forces the replicated tier, None routes
-        by size (``shard_threshold``).  Raises :class:`Rejected` when
-        the queue is full and :class:`InvalidInput` on non-finite
-        operands (before any queue/compile cost; disable with
-        ``validate=False``).
+        by size (``shard_threshold``).  ``tenant``/``priority`` tag
+        the request for the admission plane (``tenants=`` /
+        ``SLATE_TPU_TENANTS``): tenant defaults to the anonymous
+        ``"default"`` pool, priority ("high"|"normal"|"low", default
+        "normal") orders overload shedding — both are no-ops on a
+        service without the plane configured.  Raises
+        :class:`Rejected` when the queue (or, tenancy on, this
+        tenant's quota/queue share) is full, :class:`Shed` when the
+        overload controller is refusing this priority class, and
+        :class:`InvalidInput` on non-finite operands (before any
+        queue/compile cost; disable with ``validate=False``).
 
         With ``aux/spans`` on (``SLATE_TPU_TRACE_RING``), the request
         gets a trace id and a root ``request`` span spanning admit ->
         deliver, with ``admit``/``queued``/``coalesce``/``execute`` |
         ``direct``/``backoff`` children and breaker instants — one
-        complete chain per delivered request in the Chrome export."""
+        complete chain per delivered request in the Chrome export.
+        On a tenancy-enabled service the root span carries
+        ``tenant``/``priority`` attrs."""
         if not spans.is_on():
             return self._submit(routine, A, B, deadline, retries,
-                                precision, sharded)
+                                precision, sharded, tenant, priority)
         tr = spans.new_trace()
         root = spans.start("request", trace=tr, lane="client",
                            routine=routine)
         admit = spans.start("admit", trace=tr, parent=root, lane="client")
         try:
             fut = self._submit(routine, A, B, deadline, retries,
-                               precision, sharded, _trace=tr, _root=root)
+                               precision, sharded, tenant, priority,
+                               _trace=tr, _root=root)
         except BaseException as e:
             # admission rejected this request (Rejected/InvalidInput/
             # shape errors): the chain closes here, outcome on both
@@ -715,9 +809,17 @@ class SolverService:
         retries: int = 0,
         precision: Optional[str] = None,
         sharded: Optional[bool] = None,
+        tenant: Optional[str] = None,
+        priority=None,
         _trace: Optional[str] = None,
         _root: Optional[spans.Span] = None,
+        _synthetic: bool = False,
     ) -> Future:
+        adm = self._admission
+        # one normalizer for both plane states: a tag the plane would
+        # reject must fail identically with the plane off, or enabling
+        # tenancy breaks previously-working client calls
+        tname, prio = _adm.resolve_identity(tenant, priority)
         A = np.asarray(A)
         B = np.asarray(B)
         if B.ndim == 1:
@@ -726,6 +828,48 @@ class SolverService:
             raise ValueError(
                 f"{routine}: bad shapes A{A.shape} B{B.shape}"
             )
+        if adm is not None:
+            # -- the admission plane (ONE branch when off) -------------
+            # BEFORE the O(n^2) finiteness scan below: the whole point
+            # of shedding is to refuse load without paying per-request
+            # cost, so under overload a refused submit must cost O(1)
+            if not _synthetic and adm.tenancy and faults.is_on():
+                # tenant_flood: a synthetic burst of low-priority
+                # requests from tenant "flood" cloning this request's
+                # operands — the fairness machinery must absorb it.
+                # Tenancy-gated (not just plane-gated): on an
+                # adaptive-only plane tenant "flood" would inherit an
+                # unlimited default quota and the burst would admit
+                # wholesale, degrading the very traffic the drill is
+                # meant to prove protected
+                s = faults.fire("tenant_flood")
+                if s is not None:
+                    self._flood_burst(routine, A, B, s.burst)
+            now = time.monotonic()
+            # anti-latch: let an idle EWMA decay and de-escalate BEFORE
+            # the shed decision — at shed level the refused requests
+            # never execute, so without this no observation would ever
+            # arrive to recover a service whose flood already stopped
+            adm.tick(now)
+            if adm.sheds(prio):
+                # overload: refuse lowest-priority-first, typed — the
+                # queue may have room, but admitting would melt the
+                # SLO of what is already queued
+                adm.tenant_event(tname, "shed")
+                metrics.inc("serve.shed")
+                spans.event(
+                    "shed", trace=_trace, lane="client", tenant=tname,
+                    priority=_bk.priority_name(prio),
+                    level=adm.overload.level,
+                )
+                raise Shed(
+                    f"{routine}: overload level {adm.overload.level} "
+                    f"is shedding {_bk.priority_name(prio)}-priority "
+                    "traffic; back off or raise priority"
+                ).with_context(
+                    routine=routine, tenant=tname,
+                    priority=_bk.priority_name(prio),
+                )
         if self.validate:
             bad = (
                 "A" if not np.all(np.isfinite(A))
@@ -802,6 +946,7 @@ class SolverService:
                 time.monotonic() + deadline if deadline is not None else None
             ),
             retries=int(retries),
+            tenant=tname, priority=prio, tenanted=adm is not None,
             factor_fp=fp, factor_miss=bool(fp is not None and hit is None),
             trace=_trace, span=_root,
         )
@@ -811,6 +956,11 @@ class SolverService:
                 bucket=key.label if key is not None else None,
                 sharded=bool(key is not None and key.mesh),
             )
+            if adm is not None:
+                spans.annotate(
+                    _root, tenant=tname,
+                    priority=_bk.priority_name(prio),
+                )
         with self._cond:
             if self._stopped:
                 # a stopped service has no worker to ever resolve the
@@ -822,9 +972,58 @@ class SolverService:
                 ).with_context(routine=routine)
             if sum(len(rep.q) for rep in self._lanes) >= self.max_queue:
                 metrics.inc("serve.rejected")
+                if adm is not None:
+                    adm.tenant_event(tname, "rejected")
                 raise Rejected(
                     f"queue full ({self.max_queue}); retry with backoff"
-                ).with_context(routine=routine)
+                ).with_context(
+                    routine=routine,
+                    tenant=tname if adm is not None else None,
+                    priority=(
+                        _bk.priority_name(prio) if adm is not None else None
+                    ),
+                )
+            if adm is not None and adm.config_for(tname).share < 1.0:
+                # per-tenant queue-share cap: a bursty tenant fills ITS
+                # slice of the bounded queue and gets rejected there,
+                # leaving the rest of the queue for its neighbors
+                limit = adm.share_limit(tname, self.max_queue)
+                depth_t = sum(
+                    rep.q.depth(tname) for rep in self._lanes
+                )
+                if depth_t >= limit:
+                    metrics.inc("serve.rejected")
+                    metrics.inc("serve.rejected_share")
+                    adm.tenant_event(tname, "rejected")
+                    raise Rejected(
+                        f"tenant {tname!r} queue share full "
+                        f"({limit} of {self.max_queue}); retry with "
+                        "backoff"
+                    ).with_context(
+                        routine=routine, tenant=tname,
+                        priority=_bk.priority_name(prio),
+                    )
+            if adm is not None and not adm.quota_take(
+                tname, time.monotonic()
+            ):
+                # the token bucket is the LAST admission check: a token
+                # must only be consumed by a request that is actually
+                # admitted — checking earlier would let rejections
+                # caused by OTHERS (a full shared queue, a shape typo)
+                # drain this tenant's quota, charging the victim for
+                # its neighbor's flood.  The hot tenant still sheds its
+                # OWN load first: quota rejection is per-tenant
+                adm.tenant_event(tname, "rejected")
+                metrics.inc("serve.rejected")
+                metrics.inc("serve.rejected_quota")
+                raise Rejected(
+                    f"tenant {tname!r} token-bucket quota exhausted "
+                    f"({adm.config_for(tname).rate:g}/s); retry with "
+                    "backoff"
+                ).with_context(
+                    routine=routine, tenant=tname,
+                    priority=_bk.priority_name(prio),
+                )
             if key is not None and key.mesh:
                 rep = self._shard_rep
             else:
@@ -868,7 +1067,26 @@ class SolverService:
         elif key is not None:
             metrics.inc("serve.replicated_dispatch")
         metrics.inc("serve.requests")
+        if adm is not None:
+            adm.tenant_event(tname, "admitted")
         return req.future
+
+    def _flood_burst(self, routine: str, A, B, count: int) -> None:
+        """The ``tenant_flood`` fault site: inject ``count`` synthetic
+        low-priority requests from tenant ``"flood"`` cloning the
+        triggering request's operands.  Each rides the normal admission
+        path (minus a recursive flood check), so the burst is exactly
+        the abuse the fairness machinery exists for — quota rejections
+        and overload sheds are counted where they happen, and admitted
+        flood requests resolve like any others (nobody waits on them)."""
+        for _ in range(max(int(count), 0)):
+            try:
+                self._submit(
+                    routine, A, B, retries=0, tenant="flood",
+                    priority="low", _synthetic=True,
+                )
+            except SlateError:
+                pass  # shed/rejected — the point; counted at the raise
 
     def _pick_replica_locked(self, key: Optional[_bk.BucketKey]) -> _Replica:
         """Admission-side replica selection: least-loaded/round-robin
@@ -964,6 +1182,14 @@ class SolverService:
                 dict(self._restore_result) if self._restore_result else None
             )
             seen_labels = sorted(self._seen_labels)
+            tenant_depths: Optional[Dict[str, int]] = None
+            if self._admission is not None:
+                # merge the lanes' per-tenant depth maps (FairQueue
+                # maintains them; no per-request scan under the lock)
+                tenant_depths = {}
+                for rep in self._lanes:
+                    for t, d in rep.q.depths().items():
+                        tenant_depths[t] = tenant_depths.get(t, 0) + d
         shard_lane = lanes.pop() if self._shard_rep is not None else None
         if shard_lane is not None:
             shard_lane["mesh"] = self.placement.mesh
@@ -1031,6 +1257,18 @@ class SolverService:
                 self.factor_cache.stats()
                 if self.factor_cache is not None else None
             ),
+            # the admission plane (both None when unconfigured):
+            # per-tenant depth/quota/burn/shed/rejected, and the
+            # controller state (overload level, shed classes, per-bucket
+            # adaptive windows)
+            "tenants": (
+                self._admission.tenants_health(tenant_depths, now=now)
+                if self._admission is not None else None
+            ),
+            "admission": (
+                self._admission.snapshot()
+                if self._admission is not None else None
+            ),
             "failures_60s": len(recent),
             "failure_rate_60s": len(recent) / window_s,
             "uptime_s": now - self._t_started,
@@ -1096,7 +1334,12 @@ class SolverService:
     def _pop_eligible_locked(
         self, rep: _Replica, now: float
     ) -> Optional[_Request]:
-        """Oldest request whose retry backoff (not_before) has elapsed."""
+        """Oldest request whose retry backoff (not_before) has elapsed
+        — or, with the admission plane on, the weighted-fair choice
+        across tenants (FairQueue's virtual-time schedule; FIFO within
+        a tenant, and exactly FIFO with a single tenant)."""
+        if self._admission is not None:
+            return rep.q.pop_eligible(now)
         for i, r in enumerate(rep.q):
             if r.not_before <= now:
                 del rep.q[i]
@@ -1117,10 +1360,14 @@ class SolverService:
                 # future) must still be queued-cancelled the moment its
                 # deadline passes, not after its backoff elapses
                 if rep.q:
-                    live: Deque[_Request] = deque()
-                    for r in rep.q:
-                        (expired if r.expired() else live).append(r)
-                    rep.q = live
+                    # remove-based (not rebuild): rep.q may be a plain
+                    # deque or the admission plane's FairQueue — both
+                    # support remove(), and the queue object (with its
+                    # tenant bookkeeping) must survive the sweep
+                    dead = [r for r in rep.q if r.expired()]
+                    for r in dead:
+                        rep.q.remove(r)
+                    expired.extend(dead)
                 if expired:
                     break  # cancel outside the lock, then come back
                 first = self._pop_eligible_locked(rep, now)
@@ -1156,7 +1403,15 @@ class SolverService:
             return [first]
         csp = spans.start("coalesce", trace=first.trace, parent=first.span,
                           lane=rep.lane) if first.trace is not None else None
-        if self.batch_max > 1 and self.batch_window_s > 0:
+        # the coalesce window: static configuration, or — admission
+        # plane on — the bucket's AIMD window (ceiling batch_window_s)
+        # times the overload shrink factor, so under pressure the lane
+        # stops lingering for company
+        win = (
+            self.batch_window_s if self._admission is None
+            else self._admission.window_for(first.key.label)
+        )
+        if self.batch_max > 1 and win > 0:
             with self._cond:
                 now = time.monotonic()
                 if not any(
@@ -1164,28 +1419,26 @@ class SolverService:
                     and r.not_before <= now
                     for r in rep.q
                 ):
-                    self._cond.wait(self.batch_window_s)
+                    self._cond.wait(win)
         batch = [first]
         with self._cond:
-            keep: Deque[_Request] = deque()
             now = time.monotonic()
-            while rep.q and len(batch) < self.batch_max:
-                r = rep.q.popleft()
-                # factor-cache requests additionally match on the
-                # matrix fingerprint: a solve-phase batch shares ONE
-                # factor operand, and a miss batch must not mix
-                # different A's (factor_fp is None for everything else
-                # — plain traffic coalesces exactly as before)
-                if (
-                    r.key == first.key
-                    and r.factor_fp == first.factor_fp
-                    and r.not_before <= now
-                ):
-                    batch.append(r)
-                else:
-                    keep.append(r)
-            keep.extend(rep.q)
-            rep.q = keep
+            # take-based (not popleft-rebuild): front-to-back scan, so
+            # the take set and order match the old loop on a deque —
+            # and the queue object (FairQueue bookkeeping included)
+            # survives.  Factor-cache requests additionally match on
+            # the matrix fingerprint: a solve-phase batch shares ONE
+            # factor operand, and a miss batch must not mix different
+            # A's (factor_fp is None for everything else — plain
+            # traffic coalesces exactly as before)
+            take = [
+                r for r in rep.q
+                if r.key == first.key and r.factor_fp == first.factor_fp
+                and r.not_before <= now
+            ][: self.batch_max - 1]
+            for r in take:
+                rep.q.remove(r)
+            batch.extend(take)
             self._gauge_queues_locked()
         spans.end(csp, coalesced=len(batch))
         live = []
@@ -1200,6 +1453,20 @@ class SolverService:
         """Deadline passed while still queued: cancel, never start."""
         metrics.inc("serve.deadline_miss")
         metrics.inc("serve.deadline_miss_queued")
+        if self._admission is not None:
+            # a queued cancel IS an SLO exhaustion: feed the overload
+            # controller its actual overrun (without this, a service
+            # drowning in cancels would never see the burn and never
+            # shed — deliveries are not the only melt signal)
+            now = time.monotonic()
+            self._admission.observe_finish(
+                self._lat_label(req), req.tenant, req.priority,
+                now - req.t_submit,
+                req.deadline - req.t_submit
+                if req.deadline is not None else None,
+                now, trace=req.trace,
+                windowed=req.key is not None and not req.key.mesh,
+            )
         _resolve_exc(
             req.future,
             DeadlineExceeded(
@@ -1418,8 +1685,7 @@ class SolverService:
             if info != 0:
                 if late:
                     self._miss_late()
-                if mon:
-                    self._observe_total(rep, key.label, r, now)
+                self._observe_total(rep, key.label, r, now)
                 metrics.inc("serve.numerical_errors")
                 deliver.append(functools.partial(
                     _resolve_exc, r.future,
@@ -1457,8 +1723,7 @@ class SolverService:
                 continue
             if late:
                 self._miss_late()  # finished late; still delivered
-            if mon:
-                self._observe_total(rep, key.label, r, now)
+            self._observe_total(rep, key.label, r, now)
             deliver.append(functools.partial(_resolve, r.future, X, r))
         if len(batch) > 1:
             metrics.inc("serve.batched")
@@ -1580,8 +1845,7 @@ class SolverService:
                 spans.annotate(r.span, factor_hit=True)
             if late:
                 self._miss_late()
-            if mon:
-                self._observe_total(rep, key.label, r, now)
+            self._observe_total(rep, key.label, r, now)
             deliver.append(functools.partial(_resolve, r.future, X, r))
         if stale and fc is not None:
             fc.invalidate(entry.fp)
@@ -1664,16 +1928,16 @@ class SolverService:
         now = time.monotonic()
         if req.deadline is not None and now > req.deadline:
             self._miss_late()
+        # observe total under the DISPATCH key's label (req.key:
+        # the full label for misses, the .solve label for items
+        # demoted off a solve batch) so it pairs with the queued
+        # observation _execute made under the same label — the
+        # subtraction premise of tools/latency_report.py
+        lbl = self._lat_label(req)
         if metrics.is_on():
-            # observe total under the DISPATCH key's label (req.key:
-            # the full label for misses, the .solve label for items
-            # demoted off a solve batch) so it pairs with the queued
-            # observation _execute made under the same label — the
-            # subtraction premise of tools/latency_report.py
-            lbl = self._lat_label(req)
             with self._cond:
                 self._seen_labels.add(lbl)
-            self._observe_total(rep, lbl, req, now)
+        self._observe_total(rep, lbl, req, now)
         _resolve(req.future, X, req)
 
     @staticmethod
@@ -1689,26 +1953,44 @@ class SolverService:
                        req: _Request, now: float) -> None:
         """Total (admit -> deliver) latency into the per-bucket and
         per-replica histograms, plus the deadline-budget burn counters
-        (``serve.slo_burn.*``).  Callers gate on ``metrics.is_on()``."""
+        (``serve.slo_burn.*``) — and, admission plane on, the control
+        loop (overload EWMA + the bucket's AIMD window).  Called on
+        every delivery; metrics are gated here, the control loop runs
+        with or without them."""
         total = now - req.t_submit
-        metrics.observe_hist(f"serve.latency.{label}.total", total)
-        if rep is not None:
-            metrics.observe_hist(rep.lat_hist, total)
-        if req.deadline is not None:
-            budget = req.deadline - req.t_submit
-            if budget > 0:
-                # each delivered deadline request lands in exactly one
-                # burn tier: <=50% is healthy headroom, the rest is the
-                # SLO melting in slow motion (exhausted == delivered
-                # late, the deadline_miss_late companion)
-                burn = total / budget
-                metrics.inc("serve.slo_burn.requests")
-                if burn > 1.0:
-                    metrics.inc("serve.slo_burn.exhausted")
-                elif burn > 0.8:
-                    metrics.inc("serve.slo_burn.over_80")
-                elif burn > 0.5:
-                    metrics.inc("serve.slo_burn.over_50")
+        if metrics.is_on():
+            metrics.observe_hist(f"serve.latency.{label}.total", total)
+            if rep is not None:
+                metrics.observe_hist(rep.lat_hist, total)
+            if req.deadline is not None:
+                budget = req.deadline - req.t_submit
+                if budget > 0:
+                    # each delivered deadline request lands in exactly
+                    # one burn tier: <=50% is healthy headroom, the
+                    # rest is the SLO melting in slow motion
+                    # (exhausted == delivered late, the
+                    # deadline_miss_late companion)
+                    burn = total / budget
+                    metrics.inc("serve.slo_burn.requests")
+                    if burn > 1.0:
+                        metrics.inc("serve.slo_burn.exhausted")
+                    elif burn > 0.8:
+                        metrics.inc("serve.slo_burn.over_80")
+                    elif burn > 0.5:
+                        metrics.inc("serve.slo_burn.over_50")
+        if self._admission is not None:
+            # close the control loop: per-tenant burn/latency, the
+            # overload EWMA, and the bucket's AIMD window decision —
+            # the window only for coalescible buckets (keyless/direct
+            # and sharded requests never read one)
+            self._admission.observe_finish(
+                label, req.tenant, req.priority, total,
+                req.deadline - req.t_submit
+                if req.deadline is not None else None,
+                now, trace=req.trace,
+                lane=rep.lane if rep is not None else None,
+                windowed=req.key is not None and not req.key.mesh,
+            )
 
     def _direct(self, req: _Request, batched_error: Optional[Exception] = None) -> None:
         if req.key is not None:
@@ -1738,11 +2020,11 @@ class SolverService:
         now = time.monotonic()
         if req.deadline is not None and now > req.deadline:
             self._miss_late()
+        lbl = self._lat_label(req)
         if metrics.is_on():
-            lbl = self._lat_label(req)
             with self._cond:
                 self._seen_labels.add(lbl)
-            self._observe_total(None, lbl, req, now)
+        self._observe_total(None, lbl, req, now)
         _resolve(req.future, X, req)
 
 
@@ -1771,6 +2053,13 @@ def _resolve_exc(
             routine=req.routine,
             bucket=req.key.label if req.key is not None else None,
             attempt=req.attempt,
+            # tenant identity only where tenancy is real (a request
+            # admitted through the plane): default-path error strings
+            # stay exactly as before
+            tenant=req.tenant if req.tenanted else None,
+            priority=(
+                _bk.priority_name(req.priority) if req.tenanted else None
+            ),
         )
     if not fut.done():
         fut.set_exception(exc)

@@ -1,0 +1,835 @@
+"""Admission-plane tests: tenant grammar, token-bucket quotas,
+weighted-fair queues, the AIMD batch-window controller, the overload
+shed controller, and the service integration.
+
+Controller units run on fake clocks / replayed latency sequences
+(deterministic: AIMD convergence, hysteresis no-flap, quota refill).
+Integration covers the ISSUE acceptance pieces: the fairness invariant
+(an abusive tenant's shed count > 0 while the well-behaved tenant's
+p99 holds, under deterministic injected latency), per-tenant typed
+``Rejected``/``Shed`` with tenant/priority context, the ``tenants``
+health section, the ``tenant_flood`` fault site, the capped
+``serve.tenant.*`` metric family, and the zero-overhead contract: a
+default service has NO admission plane — plain deque lanes, no new
+metrics, byte-identical results (PR2's steady-state compile-free test
+rides on this unchanged).
+"""
+
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import SlateError
+from slate_tpu.serve import admission as adm
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.admission import (
+    AdaptiveWindow,
+    AdmissionControl,
+    FairQueue,
+    OverloadController,
+    TenantConfig,
+    TokenBucket,
+    parse_tenants,
+)
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import Rejected, Shed, SolverService
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Metrics are part of the contract under test; faults must never
+    leak across tests."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    faults.reset()
+    yield
+    faults.reset()
+    metrics.off()
+    metrics.reset()
+
+
+def _gesv_problem(n, nrhs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+def _service(**kw):
+    kw.setdefault("cache", ExecutableCache(manifest_path=None))
+    kw.setdefault("batch_max", 4)
+    kw.setdefault("dim_floor", FLOOR)
+    kw.setdefault("nrhs_floor", NRHS_FLOOR)
+    return SolverService(**kw)
+
+
+# ---------------------------------------------------------------------------
+# grammar + config
+# ---------------------------------------------------------------------------
+
+
+def test_parse_tenants_grammar():
+    cfgs = parse_tenants(
+        "gold:weight=4;free:weight=1,rate=20,burst=4,share=0.25;bare"
+    )
+    assert cfgs["gold"].weight == 4.0
+    assert cfgs["gold"].rate == 0.0  # unlimited
+    assert cfgs["free"].rate == 20.0
+    assert cfgs["free"].burst == 4
+    assert cfgs["free"].share == 0.25
+    assert cfgs["bare"] == TenantConfig(name="bare")
+
+
+def test_parse_tenants_default_is_template():
+    a = AdmissionControl(
+        tenants=parse_tenants("default:weight=2,rate=5;gold:weight=8")
+    )
+    assert a.config_for("gold").weight == 8.0
+    # unnamed tenants inherit the default entry's knobs
+    anon = a.config_for("someone-new")
+    assert anon.weight == 2.0 and anon.rate == 5.0
+
+
+def test_parse_tenants_errors():
+    with pytest.raises(ValueError, match="unknown tenant spec key"):
+        parse_tenants("t:wieght=2")
+    with pytest.raises(ValueError, match="empty tenant name"):
+        parse_tenants(":weight=2")
+    with pytest.raises(ValueError, match="tenant spec item"):
+        parse_tenants("t:weight")
+    with pytest.raises(ValueError, match="weight must be > 0"):
+        parse_tenants("t:weight=0")
+    with pytest.raises(ValueError, match="share must be in"):
+        parse_tenants("t:share=1.5")
+    # a burst with no refill would be silently inert — refuse to start
+    # rather than ignore a quota the operator believes is active
+    with pytest.raises(ValueError, match="burst= requires rate="):
+        parse_tenants("t:burst=10")
+
+
+def test_check_priority():
+    assert bk.check_priority("high") == bk.PRIO_HIGH
+    assert bk.check_priority("normal") == bk.PRIO_NORMAL
+    assert bk.check_priority("low") == bk.PRIO_LOW
+    assert bk.check_priority(2) == 2
+    assert bk.priority_name(0) == "high"
+    with pytest.raises(ValueError):
+        bk.check_priority("urgent")
+    with pytest.raises(ValueError):
+        bk.check_priority(3)
+
+
+# ---------------------------------------------------------------------------
+# token bucket (fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_refill_deterministic():
+    tb = TokenBucket(rate=10.0, capacity=2, now=0.0)
+    assert tb.take(0.0) and tb.take(0.0)  # burst of 2
+    assert not tb.take(0.0)  # dry
+    assert not tb.take(0.05)  # 0.5 tokens refilled: still < 1
+    assert tb.take(0.1)  # 1 token at t=0.1 (0.5 + 0.5)
+    assert not tb.take(0.1)
+
+
+def test_token_bucket_caps_at_capacity():
+    tb = TokenBucket(rate=100.0, capacity=3, now=0.0)
+    for _ in range(3):
+        assert tb.take(10.0)  # long idle refills to capacity, not 1000
+    assert not tb.take(10.0)
+    assert tb.remaining(10.0) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair queue
+# ---------------------------------------------------------------------------
+
+
+class _R:
+    """Request stub: the fields FairQueue schedules on."""
+
+    def __init__(self, tenant, t_submit, not_before=0.0):
+        self.tenant = tenant
+        self.t_submit = t_submit
+        self.not_before = not_before
+
+    def __repr__(self):
+        return f"R({self.tenant}@{self.t_submit})"
+
+
+def _fq(spec="") -> FairQueue:
+    return AdmissionControl(
+        tenants=parse_tenants(spec) if spec else {"default": TenantConfig("default")}
+    ).new_queue()
+
+
+def test_fairqueue_single_tenant_is_fifo():
+    q = _fq()
+    reqs = [_R("default", i) for i in range(6)]
+    for r in reqs:
+        q.append(r)
+    popped = [q.pop_eligible(100.0) for _ in range(6)]
+    assert popped == reqs  # exactly the old FIFO
+    assert q.pop_eligible(100.0) is None
+
+
+def test_fairqueue_weighted_interleave():
+    q = _fq("a:weight=3;b:weight=1")
+    for i in range(8):
+        q.append(_R("a", i))
+    for i in range(8):
+        q.append(_R("b", 10 + i))
+    first8 = [q.pop_eligible(100.0).tenant for _ in range(8)]
+    # 3:1 drain over any window (±1 for the tie at v=0)
+    assert first8.count("a") == 6 and first8.count("b") == 2
+
+
+def test_fairqueue_no_head_of_line_blocking():
+    q = _fq("a:weight=1;b:weight=1")
+    for i in range(6):
+        q.append(_R("a", i))  # the flood
+    q.append(_R("b", 6))  # the victim, last in arrival order
+    pops = [q.pop_eligible(100.0).tenant for _ in range(3)]
+    # b is served 2nd, not 7th: equal weights alternate
+    assert pops[1] == "b"
+
+
+def test_fairqueue_idle_tenant_no_catchup_monopoly():
+    q = _fq("a:weight=1;b:weight=1")
+    for i in range(5):
+        q.append(_R("a", i))
+    for _ in range(5):
+        q.pop_eligible(100.0)  # a's vtime runs ahead alone
+    # b arrives late: clamped to virtual now, it alternates instead of
+    # monopolizing the lane to catch up
+    for i in range(3):
+        q.append(_R("a", 10 + i))
+    for i in range(3):
+        q.append(_R("b", 20 + i))
+    first4 = [q.pop_eligible(100.0).tenant for _ in range(4)]
+    assert first4.count("b") == 2, first4
+
+
+def test_fairqueue_closed_loop_tenant_cannot_starve_backlog():
+    """A 1-deep closed-loop tenant (resubmits after every pop, so its
+    queue empties each time) must still be charged virtual time: it
+    drains in weight proportion against a backlogged heavy tenant
+    instead of re-entering in the past and head-of-line-blocking it."""
+    q = _fq("gold:weight=4;free:weight=1")
+    for i in range(40):
+        q.append(_R("gold", i))
+    q.append(_R("free", 100))
+    pops = []
+    t = 200
+    for _ in range(30):
+        r = q.pop_eligible(1000.0)
+        pops.append(r.tenant)
+        if r.tenant == "free":
+            q.append(_R("free", t))  # closed loop: one in flight
+            t += 1
+    # ~4:1 by weight; the uncharged-finish bug gave free 29 of 30
+    assert pops.count("gold") >= 20, pops
+
+
+def test_fairqueue_vnow_monotone_after_stale_backoff_pop():
+    """A request popped late off a stale small vtime (it sat in retry
+    backoff while the lane advanced) must not drag the virtual now
+    backwards — a regressed vnow would hand the next arriving tenant a
+    catch-up monopoly."""
+    q = _fq()
+    slow = _R("c", 0, not_before=50.0)  # backs off while a is served
+    q.append(slow)
+    for i in range(6):
+        q.append(_R("a", 1 + i))
+    for _ in range(4):
+        assert q.pop_eligible(10.0).tenant == "a"  # vnow advances to 3
+    assert q.pop_eligible(60.0) is slow  # stale vtime 0, popped late
+    for i in range(3):
+        q.append(_R("d", 20 + i))  # new tenant: clamps to vnow
+    pops = [q.pop_eligible(100.0).tenant for _ in range(4)]
+    # with a regressed vnow, d would win 3 straight catch-up pops
+    assert pops.count("d") == 2, pops
+
+
+def test_fairqueue_backoff_eligibility():
+    q = _fq()
+    a = _R("default", 0, not_before=50.0)  # backing off
+    b = _R("default", 1)
+    q.append(a)
+    q.append(b)
+    assert q.pop_eligible(10.0) is b  # a ineligible at t=10
+    assert q.pop_eligible(10.0) is None
+    assert q.pop_eligible(60.0) is a
+
+
+def test_fairqueue_deque_surface_and_depth():
+    q = _fq("a:weight=1;b:weight=1")
+    r1, r2, r3 = _R("a", 0), _R("b", 1), _R("a", 2)
+    q.append(r1)
+    q.append(r2)
+    q.append(r3)
+    assert len(q) == 3
+    assert list(q) == [r1, r2, r3]  # arrival order
+    assert q.depth("a") == 2 and q.depth("b") == 1
+    q.remove(r3)
+    assert q.depth("a") == 1
+    retry = _R("a", 3)
+    q.appendleft(retry)
+    assert list(q)[0] is retry  # retry goes to the head
+    q.clear()
+    assert len(q) == 0 and q.depth("a") == 0
+
+
+# ---------------------------------------------------------------------------
+# AIMD adaptive window
+# ---------------------------------------------------------------------------
+
+
+def test_aimd_shrinks_under_pressure():
+    w = AdaptiveWindow(ceiling_s=0.01, decide_every=4)
+    assert w.window_s == 0.01  # starts static
+    for _ in range(16):
+        w.observe(0.5, budget_s=0.25)  # 2x over budget
+    assert w.window_s < 0.01 / 4  # multiplicative decrease converges
+    assert w.shrinks == 4 and w.widens == 0
+
+
+def test_aimd_widens_on_recovery_bounded_by_ceiling():
+    w = AdaptiveWindow(ceiling_s=0.01, decide_every=4)
+    for _ in range(16):
+        w.observe(0.5, budget_s=0.25)
+    low = w.window_s
+    for _ in range(200):
+        w.observe(0.01, budget_s=0.25)  # way under budget
+    assert w.window_s == pytest.approx(0.01)  # additive climb, capped
+    assert w.window_s > low and w.widens >= 1
+
+
+def test_aimd_hysteresis_band_holds():
+    w = AdaptiveWindow(ceiling_s=0.01, decide_every=4)
+    for _ in range(40):
+        # between 0.5x and 1.0x budget: the hold band — no flapping
+        assert w.observe(0.2, budget_s=0.25) is None
+    assert w.window_s == 0.01 and w.shrinks == 0 and w.widens == 0
+
+
+def test_aimd_judges_each_request_against_its_own_budget():
+    """Mixed deadlines in one bucket: the decision is the worst burn
+    RATIO, so a healthy 2 s solve inside a 5 s budget never shrinks
+    the window just because a 50 ms-budget request completed it."""
+    w = AdaptiveWindow(ceiling_s=0.01, decide_every=4)
+    for _ in range(16):
+        w.observe(2.0, budget_s=5.0)  # ratio 0.4: healthy
+        w.observe(0.04, budget_s=0.05)  # ratio 0.8: hold band
+    assert w.window_s == 0.01 and w.shrinks == 0 and w.widens == 0
+    for _ in range(4):
+        w.observe(0.2, budget_s=0.05)  # ratio 4: a real melt
+    assert w.shrinks >= 1
+
+
+def test_aimd_no_budget_no_decisions():
+    w = AdaptiveWindow(ceiling_s=0.01, decide_every=2)
+    for _ in range(10):
+        assert w.observe(99.0, budget_s=0.0) is None
+    assert w.window_s == 0.01
+
+
+# ---------------------------------------------------------------------------
+# overload controller (fake clock)
+# ---------------------------------------------------------------------------
+
+
+def test_overload_escalates_immediately_and_sheds_low_first():
+    oc = OverloadController(alpha=0.5, dwell_s=1.0)
+    assert not oc.sheds(bk.PRIO_LOW)
+    tr = []
+    for i in range(6):
+        t = oc.observe(2.0, now=0.01 * i)  # sustained heavy burn
+        if t:
+            tr.append(t)
+    assert (0, 1) in tr and (1, 2) in tr  # escalation needs no dwell
+    assert oc.level == 2
+    assert oc.sheds(bk.PRIO_LOW) and oc.sheds(bk.PRIO_NORMAL)
+    assert not oc.sheds(bk.PRIO_HIGH)  # high is never shed
+
+
+def test_overload_deescalation_requires_dwell():
+    oc = OverloadController(alpha=1.0, dwell_s=10.0)
+    assert oc.observe(2.0, now=0.0) == (0, 2)
+    # burn collapses, but the dwell has not elapsed: hold the level
+    assert oc.observe(0.0, now=1.0) is None
+    assert oc.level == 2
+    # past the dwell: recover one decision at a time
+    assert oc.observe(0.0, now=11.0) == (2, 0)
+    assert oc.level == 0
+
+
+def test_overload_no_flap_near_threshold():
+    oc = OverloadController(alpha=0.3, dwell_s=0.5)
+    transitions = 0
+    t = 0.0
+    for i in range(200):
+        t += 0.001  # all 200 observations inside one dwell window
+        burn = 1.1 if i % 2 else 0.7  # oscillating around enter_low
+        if oc.observe(burn, now=t):
+            transitions += 1
+    # the EWMA smooths the oscillation and the dwell blocks rapid
+    # de-escalation: one level change at most, never a flap storm
+    assert transitions <= 1
+
+
+def test_overload_tick_recovers_a_latched_level():
+    """Anti-latch: at shed level, refused requests never execute, so
+    no burn sample would ever arrive — tick() must decay the idle EWMA
+    and de-escalate on its own once the flood stops."""
+    oc = OverloadController(alpha=1.0, dwell_s=0.5)
+    assert oc.observe(3.0, now=0.0) == (0, 2)
+    # silence shorter than a dwell: nothing decays, level holds
+    assert oc.tick(0.4) is None and oc.level == 2
+    # a few idle dwell windows halve the EWMA down through both exit
+    # thresholds; tick alone (no traffic at all) recovers the service
+    moved = [oc.tick(0.5 * k) for k in range(1, 12)]
+    assert oc.level == 0, (oc.level, oc.ewma)
+    assert any(m is not None for m in moved)
+    # and tick can never escalate (the EWMA only shrinks)
+    assert all(m is None or m[1] < m[0] for m in moved)
+
+
+def test_overload_window_factor():
+    oc = OverloadController(shrink=0.25)
+    assert oc.window_factor() == 1.0
+    oc.level = 1
+    assert oc.window_factor() == 0.25
+    oc.level = 2
+    assert oc.window_factor() == 0.0625
+
+
+def test_overload_hysteresis_validation():
+    with pytest.raises(ValueError, match="hysteresis"):
+        OverloadController(enter=(0.5, 1.0), exit=(0.6, 1.1))
+
+
+# ---------------------------------------------------------------------------
+# AdmissionControl resolution + metrics cap
+# ---------------------------------------------------------------------------
+
+
+def test_from_options_default_is_none(monkeypatch):
+    monkeypatch.delenv(adm.TENANTS_ENV, raising=False)
+    monkeypatch.delenv(adm.ADAPTIVE_ENV, raising=False)
+    assert AdmissionControl.from_options() is None
+
+
+def test_from_options_env_activation(monkeypatch):
+    monkeypatch.setenv(adm.TENANTS_ENV, "gold:weight=2")
+    monkeypatch.setenv(adm.ADAPTIVE_ENV, "0.25")
+    a = AdmissionControl.from_options(ceiling_s=0.005)
+    assert a is not None and a.tenancy and a.adaptive
+    assert a.budget_s == 0.25
+    assert a.config_for("gold").weight == 2.0
+
+
+def test_from_options_env_malformed_raises(monkeypatch):
+    monkeypatch.delenv(adm.TENANTS_ENV, raising=False)
+    monkeypatch.setenv(adm.ADAPTIVE_ENV, "fast")
+    with pytest.raises(ValueError, match=adm.ADAPTIVE_ENV):
+        AdmissionControl.from_options()
+
+
+def test_from_options_env_zero_budget_is_off(monkeypatch):
+    """"0.0" means off like "0" — a plane armed with a budget no
+    controller can use would be pure overhead."""
+    monkeypatch.delenv(adm.TENANTS_ENV, raising=False)
+    for off in ("0", "0.0", "0.00", "false", "off", ""):
+        monkeypatch.setenv(adm.ADAPTIVE_ENV, off)
+        assert AdmissionControl.from_options() is None, off
+
+
+def test_api_explicit_off_overrides_env(monkeypatch):
+    """A baseline/AB service built through the api layer with an
+    EXPLICIT off value must win over an env-armed plane (the
+    env-override trap factor_cache=False exists for)."""
+    from slate_tpu.enums import Option
+    from slate_tpu.serve import api as serve_api
+
+    monkeypatch.setenv(adm.TENANTS_ENV, "gold:weight=2")
+    svc = serve_api._make_service(
+        {Option.ServeTenantQuota: ""}, start=False
+    )
+    try:
+        assert svc._admission is None
+    finally:
+        svc.stop()
+    # and with the option unset, the env still arms the plane
+    svc2 = serve_api._make_service(None, start=False)
+    try:
+        assert svc2._admission is not None and svc2._admission.tenancy
+    finally:
+        svc2.stop()
+
+
+def test_tenant_flood_requires_tenancy():
+    """The flood site is tenancy-gated: on an adaptive-only plane the
+    synthetic burst would inherit an unlimited default quota and admit
+    wholesale — so it must not fire there at all."""
+    A, B = _gesv_problem(12)
+    svc = _service(adaptive=True, latency_budget_s=1.0)
+    try:
+        assert svc._admission is not None and not svc._admission.tenancy
+        faults.arm("tenant_flood", once=True, burst=10)
+        faults.on()
+        assert np.all(np.isfinite(
+            svc.submit("gesv", A, B).result(timeout=120)
+        ))
+        c = metrics.counters()
+        assert c.get("faults.injected.tenant_flood", 0) == 0
+        assert c.get("serve.tenant.flood.admitted", 0) == 0
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_quota_take_with_fake_clock():
+    clock = [0.0]
+    a = AdmissionControl(
+        tenants=parse_tenants("t:rate=2,burst=2"),
+        clock=lambda: clock[0],
+    )
+    assert a.quota_take("t", 0.0) and a.quota_take("t", 0.0)
+    assert not a.quota_take("t", 0.0)
+    assert a.quota_take("t", 0.5)  # one token back after 0.5 s at 2/s
+    assert a.quota_remaining("t", 0.5) < 1.0
+    # unlimited tenants never block and report no quota
+    assert a.quota_take("other", 0.0)
+    assert a.quota_remaining("other", 0.0) is None
+
+
+def test_tenant_metric_family_is_capped():
+    a = AdmissionControl(tenants=parse_tenants("default:weight=1"))
+    for i in range(adm.TENANT_METRIC_CAP + 20):
+        a.tenant_event(f"tenant-{i}", "admitted")
+    c = metrics.counters()
+    per_tenant = [
+        k for k in c if k.startswith("serve.tenant.")
+        and k.endswith(".admitted")
+    ]
+    assert len(per_tenant) == adm.TENANT_METRIC_CAP
+    assert c.get("serve.tenant_overflow", 0) == 20
+    # the health ints are NOT capped at the metric cap: recent tenants
+    # stay accounted (the state cap, far larger, bounds them)
+    h = a.tenants_health({})
+    assert h[f"tenant-{adm.TENANT_METRIC_CAP + 10}"]["admitted"] == 1
+
+
+def test_tenant_state_is_capped_configured_tenants_survive():
+    """The control plane's own memory is bounded like its metrics: a
+    churning id stream evicts the oldest UNCONFIGURED state while
+    spec-named tenants keep theirs (bucket state included)."""
+    a = AdmissionControl(tenants=parse_tenants("vip:rate=5,burst=2"))
+    a.quota_take("vip", 0.0)  # vip's bucket: 1 of 2 tokens left
+    for i in range(adm.TENANT_STATE_CAP + 50):
+        a.tenant_event(f"churn-{i}", "admitted")
+    assert len(a._states) <= adm.TENANT_STATE_CAP + 1
+    assert "churn-0" not in a._states  # oldest churner evicted
+    st = a._states["vip"]  # the configured tenant survived the churn
+    assert st.bucket is not None and st.bucket.tokens == 1.0
+
+
+# ---------------------------------------------------------------------------
+# service integration
+# ---------------------------------------------------------------------------
+
+
+def test_default_service_plane_off_byte_identical():
+    """Zero-overhead contract: an unconfigured service has NO admission
+    plane (plain deque lanes, no tenant/adaptive/shed metrics), and
+    tagging requests on it changes nothing — byte-identical X."""
+    A, B = _gesv_problem(12)
+    svc = _service()
+    try:
+        assert svc._admission is None
+        assert all(isinstance(rep.q, deque) for rep in svc._lanes)
+        with metrics.deltas():
+            X1 = svc.submit("gesv", A, B).result(timeout=120)
+            X2 = svc.submit(
+                "gesv", A, B, tenant="anyone", priority="low"
+            ).result(timeout=120)
+        assert X1.tobytes() == X2.tobytes()
+        h = svc.health()
+        assert h["tenants"] is None and h["admission"] is None
+        leaked = [
+            k for k in metrics.counters()
+            if k.startswith(("serve.tenant", "serve.adaptive",
+                             "serve.shed", "serve.overload",
+                             "serve.rejected_quota",
+                             "serve.rejected_share"))
+        ]
+        assert not leaked, leaked
+        # a typo'd priority still fails loudly, plane or no plane
+        with pytest.raises(ValueError):
+            svc.submit("gesv", A, B, priority="urgent")
+    finally:
+        svc.stop()
+
+
+def test_quota_rejects_hot_tenant_only():
+    A, B = _gesv_problem(12)
+    svc = _service(tenants="free:rate=1,burst=2")
+    try:
+        ok = 0
+        rejected = []
+        for _ in range(5):
+            try:
+                svc.submit("gesv", A, B, tenant="free").result(timeout=120)
+                ok += 1
+            except Rejected as e:
+                rejected.append(e)
+        assert ok == 2 and len(rejected) == 3
+        e = rejected[0]
+        assert e.tenant == "free" and e.priority == "normal"
+        assert "tenant" in str(e)
+        # the neighbor (unlimited) is untouched by free's dry bucket
+        X = svc.submit("gesv", A, B, tenant="gold").result(timeout=120)
+        assert np.all(np.isfinite(X))
+        c = metrics.counters()
+        assert c.get("serve.rejected_quota") == 3
+        assert c.get("serve.tenant.free.rejected") == 3
+        assert c.get("serve.tenant.gold.admitted") == 1
+    finally:
+        svc.stop()
+
+
+def test_queue_full_rejection_does_not_drain_quota():
+    """Fairness of the quota itself: a rejection caused by OTHERS (the
+    shared queue is full) must not consume the victim's token — the
+    bucket is charged only for requests actually admitted."""
+    A, B = _gesv_problem(12)
+    svc = _service(
+        tenants="scarce:rate=0.1,burst=1", max_queue=2, start=False
+    )
+    try:
+        f1 = svc.submit("gesv", A, B, tenant="big")
+        f2 = svc.submit("gesv", A, B, tenant="big")
+        with pytest.raises(Rejected, match="queue full"):
+            svc.submit("gesv", A, B, tenant="scarce")
+        svc.start()
+        for f in (f1, f2):
+            assert np.all(np.isfinite(f.result(timeout=120)))
+        # the queue-full rejection above did NOT charge scarce's only
+        # token: this admission succeeds...
+        assert np.all(np.isfinite(
+            svc.submit("gesv", A, B, tenant="scarce").result(timeout=120)
+        ))
+        # ...and only now is the bucket dry
+        with pytest.raises(Rejected, match="quota"):
+            svc.submit("gesv", A, B, tenant="scarce")
+    finally:
+        svc.stop()
+
+
+def test_share_cap_rejects_per_tenant():
+    A, B = _gesv_problem(12)
+    svc = _service(
+        tenants="hog:share=0.1", max_queue=20, start=False
+    )  # paused: the queue holds, share cap = 2 of 20
+    try:
+        svc.submit("gesv", A, B, tenant="hog")
+        svc.submit("gesv", A, B, tenant="hog")
+        with pytest.raises(Rejected, match="queue share"):
+            svc.submit("gesv", A, B, tenant="hog")
+        # a neighbor still gets in behind the hog's cap
+        f = svc.submit("gesv", A, B, tenant="polite")
+        assert metrics.counters().get("serve.rejected_share") == 1
+        svc.start()
+        assert np.all(np.isfinite(f.result(timeout=120)))
+    finally:
+        svc.stop()
+
+
+def test_shed_typed_with_context_and_priority_order():
+    A, B = _gesv_problem(12)
+    svc = _service(tenants="default:weight=1", latency_budget_s=0.1)
+    try:
+        # force sustained overload through the public observe path
+        for i in range(10):
+            svc._admission.overload.observe(3.0, now=time.monotonic())
+        assert svc._admission.overload.level == 2
+        with pytest.raises(Shed) as ei:
+            svc.submit("gesv", A, B, tenant="t", priority="low")
+        e = ei.value
+        assert e.tenant == "t" and e.priority == "low"
+        assert "overload" in str(e)
+        with pytest.raises(Shed):
+            svc.submit("gesv", A, B, priority="normal")
+        # high priority is never shed
+        X = svc.submit("gesv", A, B, priority="high").result(timeout=120)
+        assert np.all(np.isfinite(X))
+        c = metrics.counters()
+        assert c.get("serve.shed") == 2
+        h = svc.health()
+        assert h["admission"]["overload_level"] == 2
+        assert h["admission"]["shedding"] == ["normal", "low"]
+    finally:
+        svc.stop()
+
+
+def test_health_tenants_section():
+    A, B = _gesv_problem(12)
+    svc = _service(tenants="gold:weight=4;free:rate=5,burst=1")
+    try:
+        svc.submit("gesv", A, B, tenant="gold").result(timeout=120)
+        with pytest.raises(Rejected):
+            for _ in range(3):
+                svc.submit("gesv", A, B, tenant="free")
+        h = svc.health()["tenants"]
+        assert h["gold"]["admitted"] == 1 and h["gold"]["weight"] == 4.0
+        assert h["gold"]["quota_remaining"] is None  # unlimited
+        assert h["free"]["rejected"] >= 1
+        assert h["free"]["quota_remaining"] is not None
+        assert set(h["gold"]["burn"]) == {
+            "requests", "over_50", "over_80", "exhausted"
+        }
+    finally:
+        svc.stop()
+
+
+def test_tenant_flood_fault_site():
+    """The chaos satellite: one armed tenant_flood injection bursts 10
+    synthetic low-priority requests from tenant "flood"; the tight
+    quota refuses most, every future still resolves."""
+    A, B = _gesv_problem(12)
+    svc = _service(tenants="flood:rate=1,burst=2,share=0.2")
+    try:
+        faults.arm("tenant_flood", once=True, burst=10)
+        faults.on()
+        X = svc.submit("gesv", A, B, tenant="real").result(timeout=120)
+        assert np.all(np.isfinite(X))
+        c = metrics.counters()
+        assert c.get("faults.injected.tenant_flood") == 1
+        assert c.get("serve.tenant.flood.rejected", 0) >= 8
+        assert c.get("serve.tenant.flood.admitted", 0) <= 2
+        assert c.get("serve.tenant.real.admitted") == 1
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_adaptive_window_shrinks_and_records():
+    """Over-budget deliveries move the bucket's AIMD window down from
+    the static ceiling, with the trajectory in metrics."""
+    A, B = _gesv_problem(12)
+    svc = _service(
+        tenants="default:weight=1", adaptive=True,
+        latency_budget_s=1e-4,  # everything is over budget on purpose
+        batch_window_s=0.005,
+    )
+    try:
+        label = bk.bucket_for(
+            "gesv", 12, 12, 2, np.float64, floor=FLOOR,
+            nrhs_floor=NRHS_FLOOR,
+        ).label
+        futs = [
+            svc.submit("gesv", A, B, priority="high")  # high: never shed
+            for _ in range(20)
+        ]
+        for f in futs:
+            assert np.all(np.isfinite(f.result(timeout=120)))
+        win = svc._admission.window_for(label)
+        assert win < 0.005  # shrunk below the ceiling
+        c = metrics.counters()
+        assert c.get(f"serve.adaptive.{label}.shrink", 0) >= 1
+        assert c.get("serve.adaptive.changes", 0) >= 1
+        g = metrics.gauges()
+        assert g.get(f"serve.adaptive.{label}.window_s") < 0.005
+        assert label in svc.health()["admission"]["windows"]
+    finally:
+        svc.stop()
+
+
+def test_fairness_invariant_abuser_shed_victim_p99_holds():
+    """The ISSUE acceptance, scaled down: under deterministic injected
+    latency an abusive flood is quota-capped and eventually SHED
+    (typed, counted) while the well-behaved tenant's p99 stays within
+    budget."""
+    BUDGET = 0.25
+    A_a, B_a = _gesv_problem(12, seed=1)
+    good_probs = [_gesv_problem(24, seed=100 + i) for i in range(6)]
+    svc = _service(
+        tenants="good:weight=4;abuser:rate=10,burst=4,share=0.25",
+        adaptive=True, latency_budget_s=BUDGET,
+        batch_window_s=0.005,
+    )
+    try:
+        # warm both buckets so the stream measures queueing
+        for k in (
+            bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                          nrhs_floor=NRHS_FLOOR),
+            bk.bucket_for("gesv", 24, 24, 2, np.float64, floor=FLOOR,
+                          nrhs_floor=NRHS_FLOOR),
+        ):
+            svc.cache.ensure_manifest(k, (1, 4))
+        svc.warmup()
+        faults.arm("latency", every=1, ms=20.0)  # 20 ms per dispatch
+        faults.on()
+        futs = []
+        shed = rejected = 0
+
+        def abuse(**kw):
+            nonlocal shed, rejected
+            try:
+                futs.append(svc.submit(
+                    "gesv", A_a, B_a, tenant="abuser", priority="low",
+                    **kw,
+                ))
+            except Shed:
+                shed += 1
+            except Rejected:
+                rejected += 1
+
+        for _ in range(24):  # the flood
+            abuse()
+        for A, B in good_probs:  # the victim
+            futs.append(svc.submit(
+                "gesv", A, B, tenant="good", priority="high",
+                deadline=10.0,
+            ))
+        time.sleep(0.4)  # phase-1 drains, abuser tokens refill
+        for _ in range(8):  # tight deadlines melt the abuser's SLO
+            abuse(deadline=0.015)
+        deadline = time.monotonic() + 10.0
+        while shed == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+            abuse(deadline=0.015)
+        for f in futs:
+            try:
+                assert np.all(np.isfinite(f.result(timeout=120)))
+            except SlateError:
+                pass  # typed (DeadlineExceeded): resolved, not hung
+        assert shed > 0, "the abuser was never shed"
+        assert rejected > 0, "the abuser quota never engaged"
+        p99_good = metrics.percentile(
+            "serve.latency.tenant.good.total", 99
+        )
+        assert p99_good is not None and p99_good <= BUDGET, p99_good
+        h = svc.health()
+        assert h["tenants"]["abuser"]["shed"] == shed
+        assert h["admission"]["overload_level"] >= 1
+    finally:
+        faults.reset()
+        svc.stop()
+
+
+def test_serve_exports_shed_and_admission():
+    import slate_tpu.serve as serve
+
+    assert serve.Shed is Shed
+    assert serve.TenantConfig is TenantConfig
+    assert serve.admission is adm

@@ -16,6 +16,8 @@ counters that should have absorbed it:
     artifact_stale     -> serve.artifact_stale
     artifact_load_fail -> serve.artifact_load_fail
     factor_stale       -> serve.factor_cache.stale
+    tenant_flood       -> serve.shed, serve.rejected_quota,
+                          serve.rejected_share, serve.rejected
 
 For the artifact sites the detection counter IS the containment
 signal: an injected corruption that the verification ladder counted
@@ -71,6 +73,15 @@ RECOVERY = {
     # counted stale means the residual validation caught the mismatched
     # factor and the item was re-solved direct, never delivered wrong
     "factor_stale": ("serve.factor_cache.stale",),
+    # a synthetic tenant burst is absorbed when the admission plane
+    # refused (some of) it: overload shedding, token-bucket/queue-share
+    # quota rejections, or plain bounded-queue backpressure — a flood
+    # with NO refusal signal means fairness never engaged and the
+    # burst rode straight into the shared queue
+    "tenant_flood": (
+        "serve.shed", "serve.rejected_quota", "serve.rejected_share",
+        "serve.rejected",
+    ),
 }
 
 #: sites whose zero-recovery outcome is legitimate (see module doc)

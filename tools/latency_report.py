@@ -100,8 +100,16 @@ def main(argv=None):
 
     hists, counters, gauges, peaks = load_records(args.jsonl)
     rows = latency_rows(hists)
-    buckets = {s: r for s, r in rows.items() if not s.startswith("replica.")}
+    buckets = {
+        s: r for s, r in rows.items()
+        if not s.startswith(("replica.", "tenant."))
+    }
     replicas = {s: r for s, r in rows.items() if s.startswith("replica.")}
+    # per-tenant scopes (admission plane) are rendered separately and
+    # NOT judged by the bucket p99 budget: tenant fairness has its own
+    # verdict tool (tenant_report.py) — mixing them here would fail a
+    # bucket SLO gate on a tenant whose mix concentrates the slow tail
+    tenants = {s: r for s, r in rows.items() if s.startswith("tenant.")}
 
     if not rows:
         print("(no serve.latency.* histograms in this JSONL — did the "
@@ -132,6 +140,21 @@ def main(argv=None):
                 and total["p99"] > args.p99_budget):
             over.append((scope, total["p99"]))
 
+    if tenants:
+        print()
+        hdr = (f"{'tenant':>16} {'count':>6} {'total p50':>10} "
+               f"{'p95':>8} {'p99(ms)':>8}")
+        print(hdr)
+        print("-" * len(hdr))
+        for scope in sorted(tenants):
+            t = tenants[scope].get("total")
+            print(
+                f"{scope.split('.', 1)[1]:>16} "
+                f"{(t or {}).get('count', 0):6d} "
+                f"{_ms(t, 'p50'):>10} {_ms(t, 'p95'):>8} "
+                f"{_ms(t, 'p99'):>8}"
+            )
+
     if replicas:
         print()
         hdr = (f"{'replica':>10} {'count':>6} {'total p50':>10} "
@@ -148,6 +171,27 @@ def main(argv=None):
                 f"{_ms(t, 'p99'):>8} "
                 f"{oldest if oldest is not None else '-':>16}"
             )
+
+    # the adaptive-window trajectory (admission plane, SLATE_TPU_ADAPTIVE):
+    # final window per bucket + how many AIMD decisions moved it — the
+    # controller's footprint on the percentiles above
+    adaptive = {
+        name[len("serve.adaptive."):-len(".window_s")]: v
+        for name, v in gauges.items()
+        if name.startswith("serve.adaptive.") and name.endswith(".window_s")
+    }
+    if adaptive:
+        chg = {}
+        for name, v in counters.items():
+            if name.startswith("serve.adaptive.") and (
+                name.endswith(".widen") or name.endswith(".shrink")
+            ):
+                b = name[len("serve.adaptive."):].rsplit(".", 1)[0]
+                chg[b] = chg.get(b, 0) + int(v)
+        print("\nadaptive window per bucket:")
+        for b in sorted(adaptive):
+            print(f"  {b:40} {adaptive[b] * 1e3:8.3f} ms "
+                  f"({chg.get(b, 0)} changes)")
 
     burn = {k.rsplit(".", 1)[1]: int(v) for k, v in counters.items()
             if k.startswith("serve.slo_burn.")}

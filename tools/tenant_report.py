@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Per-tenant admission/fairness report from a metrics JSONL.
+
+    python tools/tenant_report.py out.jsonl \\
+        [--p99-budget 0.25 --well-behaved gold] [--abusive flood]
+
+Rows come from the admission plane's capped per-tenant families
+(``slate_tpu/serve/admission.py``): ``serve.tenant.<id>.{admitted,
+shed,rejected}`` counters, the per-tenant burn tiers
+(``serve.tenant.<id>.slo_burn.*``), and the
+``serve.latency.tenant.<id>.total`` histograms (p50/p99 per tenant —
+the fairness verdict's metric).  Underneath: the service-wide shed /
+quota-rejection totals, the overload controller's enter/exit counts,
+and the per-bucket adaptive-window trajectory
+(``serve.adaptive.<bucket>.window_s`` + widen/shrink counts).
+
+Exit status is the **fairness verdict** (what the ``run_tests.py
+--adaptive`` gate fails on):
+
+* ``--p99-budget S --well-behaved T`` — tenant T's total p99 must be
+  within S seconds (a budget over a tenant with no latency data fails:
+  it verifies nothing);
+* ``--abusive T`` — tenant T must have been refused at least once
+  (``shed + rejected > 0``): an "overload" run where the abuser was
+  never shed proves the controller didn't engage.
+
+Without gate flags the report is informational (exit 0 unless the
+JSONL has no per-tenant data at all and a gate was requested).
+
+Produce the JSONL with ``SLATE_TPU_METRICS=out.jsonl`` around any
+tenancy-enabled serving workload (``SLATE_TPU_TENANTS=...``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict
+
+_EVENTS = ("admitted", "shed", "rejected")
+_EVT_RE = re.compile(
+    r"^serve\.tenant\.(?P<tenant>.+)\.(?P<event>admitted|shed|rejected)$"
+)
+_BURN_RE = re.compile(
+    r"^serve\.tenant\.(?P<tenant>.+)\.slo_burn\.(?P<tier>requests|"
+    r"over_50|over_80|exhausted)$"
+)
+_LAT_RE = re.compile(r"^serve\.latency\.tenant\.(?P<tenant>.+)\.total$")
+_WIN_RE = re.compile(r"^serve\.adaptive\.(?P<bucket>.+)\.window_s$")
+_CHG_RE = re.compile(r"^serve\.adaptive\.(?P<bucket>.+)\.(widen|shrink)$")
+
+
+def load_records(path):
+    """Last-value-wins snapshot semantics (the sibling reports' rule:
+    summing re-dumped cumulative JSONLs inflates)."""
+    counters, gauges, hists = {}, {}, {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if r.get("type") == "counter":
+                counters[r["name"]] = float(r.get("value", 0))
+            elif r.get("type") == "gauge":
+                gauges[r["name"]] = float(r.get("value", 0))
+            elif r.get("type") == "hist":
+                hists[r["name"]] = r
+    return counters, gauges, hists
+
+
+def tenant_rows(counters, hists) -> Dict[str, dict]:
+    rows: Dict[str, dict] = {}
+
+    def row(t):
+        return rows.setdefault(
+            t, {e: 0 for e in _EVENTS} | {"burn": {}, "latency": None}
+        )
+
+    for name, v in counters.items():
+        m = _EVT_RE.match(name)
+        if m:
+            row(m.group("tenant"))[m.group("event")] = int(v)
+            continue
+        m = _BURN_RE.match(name)
+        if m:
+            row(m.group("tenant"))["burn"][m.group("tier")] = int(v)
+    for name, rec in hists.items():
+        m = _LAT_RE.match(name)
+        if m:
+            row(m.group("tenant"))["latency"] = rec
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tenant_report")
+    ap.add_argument("jsonl", help="metrics JSONL (SLATE_TPU_METRICS output)")
+    ap.add_argument("--p99-budget", type=float, default=None,
+                    help="fairness verdict: the well-behaved tenant's "
+                         "total p99 must be within this many seconds")
+    ap.add_argument("--well-behaved", default=None, metavar="TENANT",
+                    help="tenant the p99 budget applies to")
+    ap.add_argument("--abusive", default=None, metavar="TENANT",
+                    help="tenant that must show shed+rejected > 0")
+    args = ap.parse_args(argv)
+    if (args.p99_budget is None) != (args.well_behaved is None):
+        # half a gate verifies nothing, silently — refuse loudly
+        ap.error("--p99-budget and --well-behaved must be given together")
+
+    counters, gauges, hists = load_records(args.jsonl)
+    rows = tenant_rows(counters, hists)
+    gating = args.abusive is not None or (
+        args.p99_budget is not None and args.well_behaved is not None
+    )
+
+    if not rows:
+        print("(no serve.tenant.* metrics in this JSONL — did the "
+              "stream go through a tenancy-enabled SolverService with "
+              "metrics on?)")
+        return 1 if gating else 0
+
+    hdr = (f"{'tenant':16} {'admitted':>9} {'shed':>6} {'rejected':>9} "
+           f"{'p50(ms)':>8} {'p99(ms)':>8} {'burn>80%':>9} {'exhausted':>10}")
+    print(hdr)
+    print("-" * len(hdr))
+    failures = []
+    for t in sorted(rows):
+        r = rows[t]
+        lat = r["latency"]
+        p50 = f"{lat['p50'] * 1e3:.1f}" if lat else "-"
+        p99 = f"{lat['p99'] * 1e3:.1f}" if lat else "-"
+        burn = r["burn"]
+        print(f"{t:16} {r['admitted']:9d} {r['shed']:6d} "
+              f"{r['rejected']:9d} {p50:>8} {p99:>8} "
+              f"{burn.get('over_80', 0):9d} {burn.get('exhausted', 0):10d}")
+
+    shed = int(counters.get("serve.shed", 0))
+    quota = int(counters.get("serve.rejected_quota", 0))
+    share = int(counters.get("serve.rejected_share", 0))
+    overflow = int(counters.get("serve.tenant_overflow", 0))
+    print(f"\nservice: shed={shed} rejected_quota={quota} "
+          f"rejected_share={share}"
+          + (f" tenant_overflow={overflow}" if overflow else ""))
+    enters = int(counters.get("serve.overload.enter", 0))
+    exits = int(counters.get("serve.overload.exit", 0))
+    if enters or exits:
+        lvl = gauges.get("serve.overload.level")
+        print(f"overload: {enters} escalations, {exits} recoveries"
+              + (f", final level {int(lvl)}" if lvl is not None else ""))
+
+    windows = {m.group("bucket"): v for name, v in gauges.items()
+               if (m := _WIN_RE.match(name))}
+    if windows:
+        changes: Dict[str, int] = {}
+        for name, v in counters.items():
+            m = _CHG_RE.match(name)
+            if m:
+                changes[m.group("bucket")] = (
+                    changes.get(m.group("bucket"), 0) + int(v)
+                )
+        print("adaptive windows:")
+        for b in sorted(windows):
+            print(f"  {b:40} {windows[b] * 1e3:8.3f} ms "
+                  f"({changes.get(b, 0)} changes)")
+
+    if args.p99_budget is not None and args.well_behaved is not None:
+        lat = rows.get(args.well_behaved, {}).get("latency")
+        if lat is None:
+            failures.append(
+                f"well-behaved tenant {args.well_behaved!r} has no "
+                "latency data — the budget verified nothing"
+            )
+        elif lat["p99"] > args.p99_budget:
+            failures.append(
+                f"well-behaved tenant {args.well_behaved!r} p99 "
+                f"{lat['p99'] * 1e3:.1f} ms exceeds the "
+                f"{args.p99_budget * 1e3:.1f} ms budget"
+            )
+    if args.abusive is not None:
+        r = rows.get(args.abusive)
+        refused = (r["shed"] + r["rejected"]) if r else 0
+        if refused <= 0:
+            failures.append(
+                f"abusive tenant {args.abusive!r} was never refused "
+                "(shed + rejected == 0): the controller did not engage"
+            )
+
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    if gating:
+        print("\nfairness verdict ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
