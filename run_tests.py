@@ -1142,6 +1142,54 @@ def perf_gate() -> int:
         )
 
 
+# the full-tree slate-lint run must stay cheap enough to gate every PR
+# on the 2-core CI box; blowing this budget is itself a gate failure
+LINT_BUDGET_S = 15.0
+
+
+def lint_gate() -> int:
+    """Static-analysis gate (slate_tpu/analysis + tools/slate_lint.py):
+
+    1. the lint test suite — per-rule fixture positives/negatives,
+       suppression + baseline semantics, JSON schema, and a self-run
+       asserting the shipped tree is clean;
+    2. a full-tree slate-lint run against the checked-in baseline —
+       nonzero on any NEW finding, and nonzero if the run blows the
+       :data:`LINT_BUDGET_S` runtime budget.
+    """
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_lint.py", "-q",
+         "-p", "no:cacheprovider"],
+        env=env, cwd=here,
+    )
+    if rc != 0:
+        print("lint: fixture/self-run suite failed")
+        return rc
+    # the CLI, not an in-process import: tools/slate_lint.py loads the
+    # analysis package without executing slate_tpu/__init__, so this
+    # gate keeps reporting parse errors as findings even when the tree
+    # is import-broken.  Wall clock (interpreter startup included) is
+    # what the budget means on the CI box.
+    t0 = time.monotonic()
+    rc = subprocess.call(
+        [sys.executable, os.path.join("tools", "slate_lint.py")],
+        env=env, cwd=here,
+    )
+    wall = time.monotonic() - t0
+    if wall > LINT_BUDGET_S:
+        print(f"lint: full-tree run took {wall:.1f}s, over the "
+              f"{LINT_BUDGET_S:.0f}s per-PR budget")
+        return 1
+    if rc != 0:
+        print("lint: new findings (fix them, suppress with a "
+              "justification, or --write-baseline for accepted legacy)")
+        return rc
+    print(f"lint: tree clean ({wall:.1f}s)")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier1", action="store_true",
@@ -1187,6 +1235,11 @@ def main() -> int:
                          "serve stream classified by roofline_report "
                          "+ a quick bench floored against "
                          "BENCH_FLOOR_CPU.json")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the slate-lint suite + a budgeted "
+                         "full-tree static-analysis pass (nonzero on "
+                         "any new finding; see README 'Static "
+                         "analysis')")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -1215,6 +1268,8 @@ def main() -> int:
         return adaptive_gate()
     if args.perf:
         return perf_gate()
+    if args.lint:
+        return lint_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

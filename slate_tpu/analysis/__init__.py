@@ -1,0 +1,62 @@
+"""slate-lint: AST-based invariant checking for the contracts every
+review pass has been policing by hand.
+
+Eight rules, each mechanizing a recurring bug class from CHANGES.md
+(see each rule's ``bug`` attribute and the README "Static analysis"
+section):
+
+======================  =====================================================
+rule                    invariant
+======================  =====================================================
+``metric-drift``        report-joined / README-listed metric names have
+                        emitters under slate_tpu/
+``fault-site``          chaos call sites are declared in the aux/faults.py
+                        SITE_SPECS registry with real recovery counters
+``hot-path-gating``     serve-path observability calls with costly
+                        arguments sit behind the armed-flag gate
+``trace-safety``        no host control flow / coercions / np.* on traced
+                        values inside staged functions
+``pytree-safety``       no enum-keyed dicts into jax; array dataclasses
+                        carry eq=False
+``lock-discipline``     ``# guarded by: <lock>`` fields only touched under
+                        the lock
+``env-drift``           SLATE_TPU_* knobs and README env tables agree
+``exception-context``   serve-path SlateError raises attach with_context()
+======================  =====================================================
+
+Usage::
+
+    from slate_tpu import analysis
+    result = analysis.run("/path/to/repo")
+    print(result.render());  assert result.ok
+
+or from the CLI / CI gate: ``python tools/slate_lint.py`` and
+``python run_tests.py --lint``.  Suppress a deliberate violation with
+``# slate-lint: disable=<rule>`` on the flagged line; accept legacy
+findings via the checked-in ``.slate-lint-baseline.json``
+(``tools/slate_lint.py --write-baseline``).  The framework is
+stdlib-only and never imports the code it checks.
+"""
+
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    Finding,
+    LintResult,
+    RULES,
+    Rule,
+    load_baseline,
+    run,
+    write_baseline,
+)
+
+# importing the rule modules populates the registry
+from . import rules_metrics  # noqa: F401,E402
+from . import rules_faults  # noqa: F401,E402
+from . import rules_trace  # noqa: F401,E402
+from . import rules_concurrency  # noqa: F401,E402
+from . import rules_env  # noqa: F401,E402
+
+__all__ = [
+    "BASELINE_NAME", "Finding", "LintResult", "RULES", "Rule",
+    "load_baseline", "run", "write_baseline",
+]

@@ -1,0 +1,441 @@
+"""slate-lint core: the AST engine, rule registry, suppressions, and
+baseline semantics.
+
+Every rule mechanizes an invariant this repo has had to re-police by
+hand across PRs (see ``CHANGES.md``): ungated hot-path instrumentation,
+metric-name drift between emitters and the ``tools/*_report.py`` joins,
+traced-value misuse inside jitted code, enum/ndarray pytree hazards,
+lock discipline in the threaded serve pool, env-var documentation
+drift, and exception-context hygiene.  The framework is stdlib-only
+(``ast`` + ``re``); rules never import the code under analysis, so a
+lint run cannot be broken by (or mask) an import-time failure in the
+tree it checks.
+
+Vocabulary:
+
+* **Finding** — one violation: rule name, repo-relative path, line/col,
+  message.  Stable ``fingerprint()`` (rule + path + stripped source
+  line, line-number free) keys the baseline so findings survive
+  unrelated edits above them.
+* **Suppression** — ``# slate-lint: disable=<rule>[,<rule>...]`` on the
+  flagged line silences those rules there (``disable=all`` silences
+  everything).  Suppressions are for *deliberate* violations (e.g. a
+  documented lock-free racy read); each should carry a justification
+  comment.
+* **Baseline** — a checked-in JSON file of accepted legacy
+  fingerprints (:data:`BASELINE_NAME`).  ``run()`` reports baselined
+  findings separately and only *new* findings fail the gate.  The
+  shipped tree carries an empty baseline: every true positive found by
+  the first full-tree run was fixed, not grandfathered.
+
+Rules register with :func:`rule`; they implement ``check_file`` (one
+parsed file at a time) and/or ``check_project`` (cross-file joins:
+metric drift, env drift, fault-site registry).  ``Project`` carries
+every parsed file plus README text and a shared per-run cache so rules
+can reuse expensive collections (e.g. the emitted-metric-name set).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+#: checked-in baseline of accepted legacy findings (repo root)
+BASELINE_NAME = ".slate-lint-baseline.json"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*slate-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self, line_text: str = "", occurrence: int = 0) -> str:
+        """Line-number-free identity for baseline matching: the rule,
+        the file, the stripped source text of the flagged line, and the
+        occurrence ordinal among identical lines — stable under edits
+        elsewhere in the file, while a SECOND identical violation in
+        the same file still reads as new (baselining one copy-paste
+        instance must not grandfather every future clone)."""
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{line_text.strip()}|{occurrence}"
+            .encode()
+        )
+        return h.hexdigest()[:16]
+
+    def as_dict(self, fingerprint: str) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": fingerprint,
+        }
+
+
+class FileInfo:
+    """One parsed source file: AST (parent-linked), raw lines, and the
+    per-line suppression map."""
+
+    __slots__ = ("path", "rel", "source", "lines", "tree", "suppress")
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        link_parents(self.tree)
+        self.suppress = scan_suppressions(self.lines)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Project:
+    """Everything one lint run sees: parsed files, README, repo root,
+    and a cross-rule cache for shared collections."""
+
+    def __init__(self, root: str, files: List[FileInfo],
+                 readme_rel: str = "README.md",
+                 readme_text: Optional[str] = None):
+        self.root = root
+        self.files = files
+        self.by_rel: Dict[str, FileInfo] = {f.rel: f for f in files}
+        self.readme_rel = readme_rel
+        self.readme_text = readme_text
+        self.cache: Dict[str, object] = {}
+
+    def readme_lines(self) -> List[str]:
+        return (self.readme_text or "").splitlines()
+
+
+class Rule:
+    """Base rule.  Subclasses set ``name`` (kebab-case id used in
+    suppressions and reports), ``summary`` (one line for ``--list``
+    and the README table), and ``bug`` (the CHANGES.md bug class the
+    rule mechanizes)."""
+
+    name = ""
+    summary = ""
+    bug = ""
+
+    def check_file(self, f: FileInfo, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+#: the registry: rule name -> instance (populated by the @rule decorator)
+RULES: Dict[str, Rule] = {}
+
+
+def rule(cls):
+    """Class decorator: instantiate and register one rule."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if inst.name in RULES:
+        raise ValueError(f"duplicate rule name {inst.name!r}")
+    RULES[inst.name] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+
+def link_parents(tree: ast.AST) -> None:
+    """Attach ``.slate_parent`` to every node (rules walk ancestors for
+    gating/with-block/except-handler context)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.slate_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "slate_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "slate_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in parents(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return anc
+    return None
+
+
+def in_except_handler(node: ast.AST) -> bool:
+    return any(isinstance(a, ast.ExceptHandler) for a in parents(node))
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last dotted component of a Name/Attribute chain
+    (``lax.while_loop`` -> ``while_loop``), else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """The first dotted component (``np.linalg.norm`` -> ``np``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> Optional[str]:
+    """Leading constant text of an f-string (None for plain nodes);
+    empty string when the f-string starts with a formatted value."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    out = []
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            out.append(part.value)
+        else:
+            break
+    return "".join(out)
+
+
+def scan_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """Per-line ``# slate-lint: disable=`` rule sets (1-based lines)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {part.strip() for part in m.group(1).split(",")}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# file discovery
+# ---------------------------------------------------------------------------
+
+#: directories whose .py files a full run lints
+LINT_DIRS = ("slate_tpu", "tools")
+
+_SKIP_PARTS = {"__pycache__", ".git"}
+
+
+def discover(root: str) -> List[str]:
+    """Repo-relative paths of every lintable .py file under
+    :data:`LINT_DIRS` (sorted, deterministic)."""
+    out: List[str] = []
+    for top in LINT_DIRS:
+        base = os.path.join(root, top)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_PARTS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    out.append(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+def load_project(root: str,
+                 rels: Optional[Sequence[str]] = None) -> "LoadResult":
+    """Parse the tree into a :class:`Project`; syntax errors become
+    ``parse-error`` findings instead of aborting the run."""
+    if rels is None:
+        rels = discover(root)
+    files: List[FileInfo] = []
+    errors: List[Finding] = []
+    for rel in rels:
+        path = os.path.join(root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as e:
+            errors.append(Finding("parse-error", rel, 1, 0, f"unreadable: {e}"))
+            continue
+        try:
+            files.append(FileInfo(path, rel, src))
+        except SyntaxError as e:
+            errors.append(Finding(
+                "parse-error", rel, int(e.lineno or 1), int(e.offset or 0),
+                f"syntax error: {e.msg}",
+            ))
+    readme_text = None
+    readme_path = os.path.join(root, "README.md")
+    if os.path.isfile(readme_path):
+        with open(readme_path, encoding="utf-8") as fh:
+            readme_text = fh.read()
+    return LoadResult(Project(root, files, readme_text=readme_text), errors)
+
+
+@dataclass
+class LoadResult:
+    project: Project
+    errors: List[Finding]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Fingerprint set from a baseline file (empty when absent)."""
+    if not os.path.isfile(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    # fingerprints is a {fp: human-locator} map; iteration yields keys
+    return set(data.get("fingerprints", {}))
+
+
+def write_baseline(path: str, result: "LintResult") -> None:
+    """Accept the run's current findings as the new baseline (the
+    fingerprint maps to a human-readable locator so reviews of the
+    baseline file mean something)."""
+    fps = {}
+    for fnd, fp in result.all_with_fingerprints:
+        fps[fp] = f"{fnd.rule} {fnd.path}:{fnd.line}"
+    payload = {"version": 1, "fingerprints": dict(sorted(fps.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]  # new (unsuppressed, unbaselined)
+    baselined: int
+    suppressed: int
+    files: int
+    duration_s: float
+    all_with_fingerprints: List  # [(Finding, fingerprint)] incl. baselined
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        fp_of = dict((id(f), fp) for f, fp in self.all_with_fingerprints)
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "files": self.files,
+            "duration_s": round(self.duration_s, 3),
+            "counts": {
+                "new": len(self.findings),
+                "baselined": self.baselined,
+                "suppressed": self.suppressed,
+            },
+            "findings": [
+                f.as_dict(fp_of.get(id(f), "")) for f in self.findings
+            ],
+        }
+
+    def render(self) -> str:
+        out = []
+        for f in self.findings:
+            out.append(f"{f.path}:{f.line}:{f.col}: {f.rule}: {f.message}")
+        tally = (
+            f"slate-lint: {len(self.findings)} finding(s), "
+            f"{self.baselined} baselined, {self.suppressed} suppressed, "
+            f"{self.files} files in {self.duration_s:.2f}s"
+        )
+        out.append(tally)
+        return "\n".join(out)
+
+
+def run(root: str,
+        rules: Optional[Sequence[str]] = None,
+        baseline: Optional[Set[str]] = None,
+        rels: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint the tree under ``root`` with the named rules (default all),
+    applying inline suppressions and the baseline fingerprint set."""
+    t0 = time.perf_counter()
+    unknown = sorted(set(rules or ()) - set(RULES))
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {', '.join(unknown)}; "
+            f"known: {', '.join(sorted(RULES))}"
+        )
+    loaded = load_project(root, rels=rels)
+    project = loaded.project
+    active = [RULES[n] for n in (rules or sorted(RULES))]
+    raw: List[Finding] = list(loaded.errors)
+    for r in active:
+        for f in project.files:
+            raw.extend(r.check_file(f, project))
+        raw.extend(r.check_project(project))
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule, f.message))
+
+    baseline = baseline or set()
+    new: List[Finding] = []
+    with_fp: List = []
+    suppressed = 0
+    baselined = 0
+    occurrences: Dict[tuple, int] = {}
+    for fnd in raw:
+        fi = project.by_rel.get(fnd.path)
+        if fi is not None:
+            line_text = fi.line_text(fnd.line)
+        elif fnd.path == project.readme_rel:
+            lines = project.readme_lines()
+            line_text = lines[fnd.line - 1] if 0 < fnd.line <= len(lines) else ""
+        else:
+            line_text = ""
+        # the ordinal advances for EVERY finding, suppressed included:
+        # adding a disable-comment on one of several identical lines
+        # must not shift its baselined twins' fingerprints
+        okey = (fnd.rule, fnd.path, line_text.strip())
+        k = occurrences.get(okey, 0)
+        occurrences[okey] = k + 1
+        if fi is not None:
+            sup = fi.suppress.get(fnd.line, ())
+            if "all" in sup or fnd.rule in sup:
+                suppressed += 1
+                continue
+        fp = fnd.fingerprint(line_text, k)
+        with_fp.append((fnd, fp))
+        if fp in baseline:
+            baselined += 1
+            continue
+        new.append(fnd)
+    return LintResult(
+        findings=new, baselined=baselined, suppressed=suppressed,
+        files=len(project.files), duration_s=time.perf_counter() - t0,
+        all_with_fingerprints=with_fp,
+    )

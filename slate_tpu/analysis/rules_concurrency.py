@@ -1,0 +1,234 @@
+"""Concurrency and failure-semantics rules: lock discipline over
+annotated shared fields, and exception-context hygiene on serve-path
+raises.
+
+Bug classes mechanized (CHANGES.md):
+
+* PR4's inline-resolution flake and later review passes: shared mutable
+  state of the threaded serve pool touched outside the owning lock.
+  Fields annotated ``# guarded by: <lock>`` become machine-checked —
+  every access in the file must sit inside ``with *.<lock>:``, in a
+  function whose name ends with ``_locked`` (the repo's
+  caller-holds-the-lock convention), or in ``__init__`` (construction
+  precedes sharing).  The ``(external)`` variant documents state whose
+  synchronization lives in a *caller's* lock (FairQueue under the
+  service condition): accesses inside the declaring class are the
+  documented contract and only outside access is checked.
+* Serve-path raises of :class:`SlateError` subclasses without
+  ``with_context()`` strip the routine/bucket/tenant triage fields the
+  exception hierarchy exists to carry — every review pass has had to
+  re-add them by hand.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, NamedTuple, Optional, Set
+
+from .core import (
+    FileInfo,
+    Finding,
+    Project,
+    Rule,
+    enclosing_function,
+    parents,
+    rule,
+    terminal_name,
+)
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded by:\s*([A-Za-z_][A-Za-z0-9_]*)\s*(\(external\))?"
+)
+
+
+class _Guard(NamedTuple):
+    attr: str
+    lock: str
+    external: bool
+    klass: ast.ClassDef
+    line: int
+
+
+def _guards(f: FileInfo) -> List[_Guard]:
+    """``# guarded by:`` annotations on attribute definitions, per
+    class: ``self.q = ...  # guarded by: _cond`` in a method body, or
+    an annotated class-level field."""
+    out: List[_Guard] = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in ast.walk(node):
+            attr = None
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                tgt = (
+                    sub.targets[0] if isinstance(sub, ast.Assign)
+                    else sub.target
+                )
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    attr = tgt.attr
+                elif (
+                    isinstance(tgt, ast.Name)
+                    and enclosing_function(sub) is None
+                ):
+                    # class-level field (dataclass style): a bare-Name
+                    # assignment directly in the class body, NOT a
+                    # local variable inside a method (which must never
+                    # register a guard for that name file-wide)
+                    attr = tgt.id
+            if attr is None:
+                continue
+            m = _GUARD_RE.search(f.line_text(sub.lineno))
+            if m:
+                out.append(_Guard(
+                    attr, m.group(1), bool(m.group(2)), node, sub.lineno
+                ))
+    return out
+
+
+def _under_lock(node: ast.AST, lock: str) -> bool:
+    for anc in parents(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if terminal_name(item.context_expr) == lock:
+                    return True
+    return False
+
+
+@rule
+class LockDiscipline(Rule):
+    """Accesses to ``# guarded by: <lock>``-annotated attributes must
+    hold the lock (intraprocedural; ``_locked``-suffix functions and
+    ``__init__`` are the documented exemptions)."""
+
+    name = "lock-discipline"
+    summary = (
+        "attributes annotated '# guarded by: <lock>' are only touched "
+        "under `with *.<lock>:` (or in *_locked/__init__ functions)"
+    )
+    bug = "lock-discipline races in the threaded serve pool"
+
+    def check_file(self, f: FileInfo, project: Project):
+        guards = _guards(f)
+        if not guards:
+            return
+        # matching is by attribute NAME (intraprocedural — no type
+        # inference), so one name may carry several guards from
+        # different classes: an access is clean when it satisfies ANY
+        # of them, and flagged only when it satisfies none
+        by_attr: Dict[str, List[_Guard]] = {}
+        for g in guards:
+            by_attr.setdefault(g.attr, []).append(g)
+        ann_lines = {g.line for g in guards}
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            gs = by_attr.get(node.attr)
+            if gs is None or node.lineno in ann_lines:
+                continue
+            encl = enclosing_function(node)
+            fname = getattr(encl, "name", "")
+            if fname == "__init__" or fname.endswith("_locked"):
+                continue
+            ok = False
+            for g in gs:
+                if g.external and any(
+                    anc is g.klass for anc in parents(node)
+                ):
+                    ok = True  # the class's methods ARE the documented API
+                    break
+                if _under_lock(node, g.lock):
+                    ok = True
+                    break
+            if ok:
+                continue
+            locks = "/".join(sorted({g.lock for g in gs}))
+            lines = ", ".join(str(g.line) for g in gs)
+            yield Finding(
+                self.name, f.rel, node.lineno, node.col_offset,
+                f"access to {node.attr!r} (guarded by {locks!r}, "
+                f"declared at line {lines}) outside `with "
+                f"*.{locks}:` — take the lock, move the access into a "
+                "*_locked helper, or suppress with a justification if "
+                "the race is deliberate",
+            )
+
+
+# ---------------------------------------------------------------------------
+# exception taxonomy
+# ---------------------------------------------------------------------------
+
+
+def slate_error_names(project: Project) -> Set[str]:
+    """Class names transitively inheriting SlateError across the linted
+    tree (exceptions.py plus serve-local subclasses like Rejected)."""
+    cached = project.cache.get("slate_errors")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    known: Set[str] = {"SlateError"}
+    classes: List[ast.ClassDef] = [
+        node
+        for f in project.files
+        for node in ast.walk(f.tree)
+        if isinstance(node, ast.ClassDef)
+    ]
+    changed = True
+    while changed:
+        changed = False
+        for node in classes:
+            if node.name in known:
+                continue
+            if any((terminal_name(b) or "") in known for b in node.bases):
+                known.add(node.name)
+                changed = True
+    project.cache["slate_errors"] = known
+    return known
+
+
+@rule
+class ExceptionContext(Rule):
+    """Serve-path ``raise SlateErrorSubclass(...)`` must chain
+    ``.with_context(...)`` so the future's exception carries
+    routine/bucket/tenant triage fields."""
+
+    name = "exception-context"
+    summary = (
+        "serve-path raises of SlateError subclasses attach "
+        ".with_context(...)"
+    )
+    bug = "context-less serve exceptions forcing log-scrape triage"
+
+    scope_prefix = "slate_tpu/serve/"
+
+    def check_file(self, f: FileInfo, project: Project):
+        if not f.rel.startswith(self.scope_prefix):
+            return
+        errors = slate_error_names(project)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            if not isinstance(exc, ast.Call):
+                continue  # bare re-raise / `raise e` keep their context
+            if (
+                isinstance(exc.func, ast.Attribute)
+                and exc.func.attr == "with_context"
+            ):
+                continue
+            cls = terminal_name(exc.func)
+            if cls not in errors:
+                continue
+            encl = enclosing_function(node)
+            if getattr(encl, "name", "") == "__init__" or encl is None:
+                # construction-time config errors carry no request
+                continue
+            yield Finding(
+                self.name, f.rel, node.lineno, node.col_offset,
+                f"raise {cls}(...) without .with_context(...) — attach "
+                "routine/bucket/tenant so operators triage from the "
+                "exception object, not the logs",
+            )

@@ -1,0 +1,73 @@
+"""Environment-variable documentation drift: every ``SLATE_TPU_*``
+knob the library reads appears in README's env tables, and every
+documented knob still exists in code.
+
+Bug class mechanized (CHANGES.md): multiple PRs shipped a new
+``SLATE_TPU_*`` env var (or renamed one) and the README table was
+reconciled only in a later review pass — an operator reading the docs
+either misses a real knob or sets one that no longer does anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Set, Tuple
+
+from .core import Finding, Project, Rule, const_str, rule
+
+_ENV_RE = re.compile(r"^SLATE_TPU_[A-Z0-9_]+$")
+_README_ENV_RE = re.compile(r"SLATE_TPU_[A-Z0-9_]+")
+
+
+def _code_vars(project: Project) -> Dict[str, Tuple[str, int]]:
+    """env var -> first (path, line) where a string literal names it."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            s = const_str(node)
+            if s is not None and _ENV_RE.match(s) and s not in out:
+                out[s] = (f.rel, node.lineno)
+    return out
+
+
+@rule
+class EnvDrift(Rule):
+    """``SLATE_TPU_*`` reads vs. the README env tables, both ways."""
+
+    name = "env-drift"
+    summary = (
+        "SLATE_TPU_* vars read under slate_tpu/ are documented in "
+        "README, and documented vars still exist in code"
+    )
+    bug = "undocumented (or zombie-documented) SLATE_TPU_* knobs"
+
+    def check_project(self, project: Project):
+        if project.readme_text is None:
+            return  # no README in this tree (fixtures opt in by adding one)
+        code = _code_vars(project)
+        documented: Set[str] = set(
+            _README_ENV_RE.findall(project.readme_text)
+        )
+        for var, (rel, line) in sorted(code.items()):
+            if not rel.startswith("slate_tpu/"):
+                continue  # tools may reference vars docs cover elsewhere
+            if var not in documented:
+                yield Finding(
+                    self.name, rel, line, 0,
+                    f"{var} is read here but absent from README's env "
+                    "tables — document the knob (or delete it)",
+                )
+        readme_lines = project.readme_lines()
+        seen: Set[str] = set()
+        for lineno, text in enumerate(readme_lines, 1):
+            for m in _README_ENV_RE.finditer(text):
+                var = m.group(0)
+                if var in code or var in seen:
+                    continue
+                seen.add(var)
+                yield Finding(
+                    self.name, project.readme_rel, lineno, m.start(),
+                    f"README documents {var} but no code reads it — "
+                    "stale knob (renamed or removed)",
+                )

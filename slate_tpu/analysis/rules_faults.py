@@ -1,0 +1,158 @@
+"""Fault-site registry rule: every chaos call site is declared, every
+declared site is recoverable (or explicitly informational), and every
+recovery counter really exists.
+
+Bug class mechanized (CHANGES.md): the chaos layer's site list, the
+call sites threaded through serve/, and ``tools/chaos_report.py``'s
+site -> recovery-counter join were three hand-kept copies of the same
+map — a site added to one but not the others either never injects,
+or injects and can never show recovery (a permanent CI flag), or joins
+counters nothing emits (recovery silently reads zero).  The registry
+in ``aux/faults.py`` (``SITE_SPECS``) is now the single source of
+truth — ``chaos_report`` derives its map from it at runtime, and this
+rule checks the remaining drift directions statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from .core import (
+    FileInfo,
+    Finding,
+    Project,
+    Rule,
+    const_str,
+    root_name,
+    rule,
+    terminal_name,
+)
+from .rules_metrics import _matches, emitted_metrics
+
+_FAULTS_REL = "slate_tpu/aux/faults.py"
+
+#: faults entry points whose first argument names a site
+_SITE_FNS = ("check", "fire", "sleep", "corrupt", "perturb", "poison_info")
+
+
+class SiteSpec(NamedTuple):
+    name: str
+    recovery: Tuple[str, ...]
+    informational: bool
+    line: int
+
+
+def parse_site_specs(tree: ast.AST) -> Dict[str, SiteSpec]:
+    """Extract every ``SiteSpec("<name>", recovery=(...),
+    informational=...)`` literal from a parsed faults.py — the ONE
+    registry extractor, shared by the lint rule (via
+    :func:`site_registry`) and ``tools/chaos_report.py`` (which loads
+    this module by file path to stay independent of the library's
+    importability)."""
+    out: Dict[str, SiteSpec] = {}
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and terminal_name(node.func) == "SiteSpec"
+            and node.args
+        ):
+            continue
+        name = const_str(node.args[0])
+        if name is None:
+            continue
+        recovery: Tuple[str, ...] = ()
+        informational = False
+        for kw in node.keywords:
+            if kw.arg == "recovery" and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                recovery = tuple(
+                    s for s in (const_str(e) for e in kw.value.elts)
+                    if s is not None
+                )
+            elif kw.arg == "informational" and isinstance(
+                kw.value, ast.Constant
+            ):
+                informational = bool(kw.value.value)
+        out[name] = SiteSpec(name, recovery, informational, node.lineno)
+    return out
+
+
+def site_registry(project: Project) -> Optional[Dict[str, SiteSpec]]:
+    """The parsed SITE_SPECS registry of this project's aux/faults.py;
+    None when the file (or the registry) is absent — fixture trees."""
+    cached = project.cache.get("site_registry")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    f = project.by_rel.get(_FAULTS_REL)
+    if f is None:
+        return None
+    out = parse_site_specs(f.tree)
+    if not out:
+        return None
+    project.cache["site_registry"] = out
+    return out
+
+
+@rule
+class FaultSiteRegistry(Rule):
+    """Chaos call sites vs. the aux/faults.py SITE_SPECS registry (the
+    single source chaos_report derives its recovery join from)."""
+
+    name = "fault-site"
+    summary = (
+        "faults.check/fire/... sites are declared in SITE_SPECS with a "
+        "recovery family (or informational) whose counters are emitted"
+    )
+    bug = "hand-kept site/recovery maps drifting across three files"
+
+    def check_project(self, project: Project):
+        registry = site_registry(project)
+        if registry is None:
+            return  # no registry in this tree (fixtures)
+        # direction 1: every call site names a declared site
+        for f in project.files:
+            if not f.rel.startswith("slate_tpu/") or f.rel == _FAULTS_REL:
+                continue
+            for node in ast.walk(f.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and root_name(node.func.value) == "faults"
+                    and node.func.attr in _SITE_FNS
+                    and node.args
+                ):
+                    continue
+                site = const_str(node.args[0])
+                if site is None:
+                    continue  # dynamic site names are out of scope
+                if site not in registry:
+                    yield Finding(
+                        self.name, f.rel, node.lineno, node.col_offset,
+                        f"fault site {site!r} is not declared in "
+                        "aux/faults.py SITE_SPECS — it can be armed but "
+                        "chaos_report has no recovery family for it",
+                    )
+        # direction 2: every declared site is recoverable or
+        # explicitly informational, and its counters are real (exact
+        # or specific-prefix emitters only: a recovery family joined
+        # on a computed-base suffix would be unverifiable)
+        exact, prefixes, _suffixes = emitted_metrics(project)
+        for spec in registry.values():
+            if not spec.recovery and not spec.informational:
+                yield Finding(
+                    self.name, _FAULTS_REL, spec.line, 0,
+                    f"site {spec.name!r} declares no recovery counters "
+                    "and is not informational — an injection here can "
+                    "never show containment in chaos_report",
+                )
+            for counter in spec.recovery:
+                if not _matches(counter, False, exact, prefixes):
+                    yield Finding(
+                        self.name, _FAULTS_REL, spec.line, 0,
+                        f"site {spec.name!r} joins recovery counter "
+                        f"{counter!r} but nothing under slate_tpu/ "
+                        "emits it (the chaos report would flag the "
+                        "site forever)",
+                    )

@@ -1,0 +1,408 @@
+"""Metric-plane rules: name drift (emitters vs. report joins vs.
+README) and the zero-overhead hot-path gating contract.
+
+Bug classes mechanized (CHANGES.md):
+
+* A report tool joining a metric name nothing emits renders the column
+  silently as zero — the chaos/latency/tenant reports have each needed
+  a review pass to catch a renamed counter.
+* ``aux/metrics`` / ``aux/spans`` / ``aux/devmon`` are internally
+  gated (one bool per call), but **argument construction is not**: an
+  f-string metric name or a helper call in the argument list runs even
+  with the subsystem off, which is exactly the "zero overhead when
+  disabled" contract the serve hot path documents.  Several PRs have
+  had review passes move such calls behind ``is_on()``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    FileInfo,
+    Finding,
+    Project,
+    Rule,
+    const_str,
+    enclosing_function,
+    fstring_prefix,
+    in_except_handler,
+    parents,
+    root_name,
+    rule,
+    terminal_name,
+)
+
+#: metric-registry entry points whose first argument is a metric name
+METRIC_FNS = ("inc", "gauge", "observe", "observe_hist", "record_cost")
+
+#: name families the drift rule reasons about — a string is treated as
+#: a metric name only under one of these roots, so ordinary literals
+#: never enter the join
+METRIC_ROOTS = (
+    "serve.", "faults.", "jit.", "precision.", "fallbacks.",
+    "refine.", "transfer.", "stedc.", "devmon.",
+)
+
+#: files whose string literals must never feed the emitted set (the
+#: linter's own rule tables mention metric roots)
+_ANALYSIS_PREFIX = "slate_tpu/analysis/"
+
+#: README metric tokens ("devmon." is excluded: the README references
+#: devmon *functions* far more than its one metric).  The lookbehind
+#: keeps dotted import paths (slate_tpu.serve.placement) from matching
+#: at their inner segments.
+_README_TOKEN_RE = re.compile(
+    r"(?<![.\w])(?:serve|faults|jit|precision|fallbacks|refine|transfer|"
+    r"stedc)\.[A-Za-z0-9_.{}<>,*]+"
+)
+
+
+def _is_metric(name: str) -> bool:
+    return name.startswith(METRIC_ROOTS)
+
+
+def _fstring_suffix(node: ast.AST) -> Optional[str]:
+    """Trailing constant text of an f-string that STARTS with a
+    formatted value (``f"{name}.calls"`` -> ``".calls"``)."""
+    if not isinstance(node, ast.JoinedStr) or not node.values:
+        return None
+    if not isinstance(node.values[0], ast.FormattedValue):
+        return None
+    out = []
+    for part in reversed(node.values):
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            out.append(part.value)
+        else:
+            break
+    return "".join(reversed(out)) or None
+
+
+def emitted_metrics(project: Project) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(exact, prefix, suffix) metric-name sets emitted under
+    ``slate_tpu/``.
+
+    Exact names come from string constants, prefixes from f-strings'
+    leading constant run, suffixes from f-strings built over a computed
+    base (``f"{name}.calls"`` with ``name = f"refine.{routine}"``).
+    Collection covers *all* literals under the metric roots, not just
+    direct ``metrics.*`` call sites, because emitters legitimately
+    precompute names (``self.q_gauge = f"serve.replica.{n}.queue_depth"``).
+    A BARE root prefix (``f"serve.{label}..."``) is excluded — it would
+    make every serve.* name match and the whole rule vacuous.  Cached
+    per run (rule 2 reuses it for recovery-counter validation)."""
+    cached = project.cache.get("emitted_metrics")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    exact: Set[str] = set()
+    prefix: Set[str] = set()
+    suffix: Set[str] = set()
+    for f in project.files:
+        if not f.rel.startswith("slate_tpu/"):
+            continue
+        if f.rel.startswith(_ANALYSIS_PREFIX):
+            continue
+        for node in ast.walk(f.tree):
+            s = const_str(node)
+            if s is not None and _is_metric(s):
+                # a recovery counter named inside the fault-site
+                # registry is a CONSUMER, not an emitter — counting it
+                # here would make rule 2's ghost-counter check vacuous
+                if any(
+                    isinstance(a, ast.Call)
+                    and terminal_name(a.func) == "SiteSpec"
+                    for a in parents(node)
+                ):
+                    continue
+                exact.add(s)
+                continue
+            p = fstring_prefix(node)
+            if p and _is_metric(p) and p not in METRIC_ROOTS:
+                prefix.add(p)
+            suf = _fstring_suffix(node)
+            if suf and suf.startswith("."):
+                suffix.add(suf)
+    out = (exact, prefix, suffix)
+    project.cache["emitted_metrics"] = out
+    return out
+
+
+def _matches(name: str, is_prefix: bool, exact: Set[str],
+             prefixes: Set[str], suffixes: Set[str] = frozenset()) -> bool:
+    if is_prefix:
+        return (
+            any(e.startswith(name) for e in exact)
+            or any(p.startswith(name) or name.startswith(p)
+                   for p in prefixes)
+        )
+    return (
+        name in exact
+        or any(name.startswith(p) for p in prefixes)
+        or any(name.endswith(s) for s in suffixes)
+    )
+
+
+@rule
+class MetricDrift(Rule):
+    """Every metric name a report tool joins (and every name the README
+    documents) must be emitted somewhere under ``slate_tpu/``."""
+
+    name = "metric-drift"
+    summary = (
+        "metric names consumed by tools/*_report.py or listed in README "
+        "must have an emitter under slate_tpu/"
+    )
+    bug = "stale counter names silently rendering as zero in report joins"
+
+    def check_project(self, project: Project):
+        exact, prefixes, suffixes = emitted_metrics(project)
+        if not exact and not prefixes:
+            return  # nothing emits: a fixture tree without emitters
+        for f in project.files:
+            if not (f.rel.startswith("tools/")
+                    and f.rel.endswith("_report.py")):
+                continue
+            for node in ast.walk(f.tree):
+                s = const_str(node)
+                is_prefix = False
+                if s is None:
+                    s = fstring_prefix(node)
+                    if not s:
+                        continue
+                    is_prefix = True
+                if not _is_metric(s):
+                    continue
+                if s.endswith((".py", ".md", ".json", ".jsonl")):
+                    continue  # a file path, not a metric name
+                # a literal ending in "." is a prefix probe by
+                # construction (the tools use them with startswith)
+                if s.endswith("."):
+                    is_prefix = True
+                if not _matches(s, is_prefix, exact, prefixes, suffixes):
+                    yield Finding(
+                        self.name, f.rel, node.lineno, node.col_offset,
+                        f"metric {s!r} is joined here but nothing under "
+                        "slate_tpu/ emits it (renamed or misspelled? "
+                        "the report column reads as zero)",
+                    )
+        # README direction: documented names must be emitted
+        for lineno, line in enumerate(project.readme_lines(), 1):
+            for m in _README_TOKEN_RE.finditer(line):
+                tok = m.group(0).rstrip(".,")
+                if tok.endswith((".py", ".md", ".json", ".jsonl")):
+                    continue  # a file path, not a metric name
+                if line[m.end():m.end() + 1] == "(":
+                    continue  # a function reference, not a metric name
+                if tok.lower() != tok:
+                    continue  # class reference (serve.Rejected): metric
+                    # names in this tree are all lowercase
+                # placeholder segments (<i>, {h2d,d2h}, *) make the
+                # token a family: match it as a prefix up to the first
+                # placeholder
+                cut = len(tok)
+                for ch in "<{*":
+                    i = tok.find(ch)
+                    if i != -1:
+                        cut = min(cut, i)
+                is_prefix = cut < len(tok)
+                name = tok[:cut]
+                if not _is_metric(name):
+                    continue
+                if not _matches(name, is_prefix, exact, prefixes, suffixes):
+                    yield Finding(
+                        self.name, project.readme_rel, lineno, m.start(),
+                        f"README documents metric {tok!r} but nothing "
+                        "under slate_tpu/ emits it",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead gating
+# ---------------------------------------------------------------------------
+
+#: observability namespaces and the helper calls the gating rule covers
+_GATED_MODS: Dict[str, Tuple[str, ...]] = {
+    "metrics": METRIC_FNS,
+    "spans": ("start", "end", "event", "record", "annotate", "span"),
+    "devmon": ("sample_devices", "capture_jitted", "roofline"),
+}
+
+#: calls considered free to evaluate as arguments (O(1) builtins)
+_CHEAP_CALLS = {
+    "len", "int", "float", "str", "bool", "min", "max", "round", "abs",
+    "sorted", "enumerate", "zip", "range", "sum", "repr", "type", "id",
+    "tuple", "list", "dict", "set", "getattr", "isinstance",
+}
+
+
+def _costly_args(call: ast.Call) -> Optional[ast.AST]:
+    """First argument subexpression that does real work at call time
+    (an f-string render or a non-builtin call), else None."""
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                return node
+            if isinstance(node, ast.Call):
+                t = terminal_name(node.func)
+                if t not in _CHEAP_CALLS:
+                    return node
+    return None
+
+
+def _gate_aliases(func: ast.AST) -> Set[str]:
+    """Names assigned from an ``is_on()``-bearing expression in this
+    function (``mon = metrics.is_on()``, ``tracked = metrics.is_on()
+    and ...``)."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(c, ast.Call) and terminal_name(c.func) == "is_on"
+            for c in ast.walk(node.value)
+        ):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+    return out
+
+
+def _spanish(name: Optional[str]) -> bool:
+    """Does the name look like a span object / trace id binding?"""
+    if not name:
+        return False
+    low = name.lower()
+    return "span" in low or "trace" in low or low in ("root", "_root", "csp")
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(d is node for d in ast.walk(tree))
+
+
+def _early_return_gated(encl: ast.AST, call: ast.Call,
+                        aliases: Set[str]) -> bool:
+    """Early-return gating: an ``if not <gate>: return`` (or continue/
+    raise) earlier in the enclosing function body covers everything
+    after it — the ``_capture_cost`` idiom.  A call INSIDE the guard's
+    own body runs exactly when the gate is off and is never covered."""
+    body = getattr(encl, "body", None)
+    if not isinstance(body, list):
+        return False
+    for stmt in body:
+        if stmt.lineno >= call.lineno:
+            break
+        if not isinstance(stmt, ast.If):
+            continue
+        test = stmt.test
+        if not (isinstance(test, ast.UnaryOp)
+                and isinstance(test.op, ast.Not)):
+            continue
+        if not _test_gates(test.operand, aliases, False):
+            continue
+        if _contains(stmt, call):
+            continue  # the call IS the gate's off-path body
+        if any(
+            isinstance(s, (ast.Return, ast.Continue, ast.Raise))
+            for s in stmt.body
+        ):
+            return True
+    return False
+
+
+def _test_gates(test: ast.AST, aliases: Set[str], allow_none: bool) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and terminal_name(node.func) == "is_on":
+            return True
+        if isinstance(node, ast.Name) and node.id in aliases:
+            return True
+        if allow_none and isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ) and _spanish(terminal_name(node.left)):
+            # span objects/trace ids are only allocated while tracing is
+            # on, so `req.span is not None` is an armed-flag proxy
+            return True
+    return False
+
+
+@rule
+class HotPathGating(Rule):
+    """On serve hot paths, observability calls whose *arguments* cost
+    something (f-string names, helper calls) must sit behind the
+    subsystem's armed-flag gate — the registry's internal bool fires
+    after the arguments were already built."""
+
+    name = "hot-path-gating"
+    summary = (
+        "serve-path metrics/spans/devmon calls with costly arguments "
+        "must be behind is_on() (or an alias / span-presence check)"
+    )
+    bug = "ungated hot-path instrumentation breaking zero-overhead-off"
+
+    scope_prefix = "slate_tpu/serve/"
+
+    def check_file(self, f: FileInfo, project: Project):
+        if not f.rel.startswith(self.scope_prefix):
+            return
+        alias_cache: Dict[int, Set[str]] = {}
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            mod = root_name(func.value)
+            fns = _GATED_MODS.get(mod or "")
+            if not fns or func.attr not in fns:
+                continue
+            costly = _costly_args(node)
+            if costly is None:
+                continue
+            encl = enclosing_function(node)
+            if encl is None:
+                continue  # import-time code is not a hot path
+            if in_except_handler(node):
+                continue  # failure paths are cold by definition
+            aliases = alias_cache.get(id(encl))
+            if aliases is None:
+                aliases = alias_cache[id(encl)] = _gate_aliases(encl)
+            allow_none = mod == "spans"
+            gated = _early_return_gated(encl, node, aliases)
+            if not gated:
+                for anc in parents(node):
+                    if anc is encl:
+                        break
+                    if not isinstance(anc, (ast.If, ast.IfExp)):
+                        continue
+                    test = anc.test
+                    # polarity + branch membership matter: the ON
+                    # branch of a positive gate is covered, the OFF
+                    # branch (else of is_on(), body of `not mon`) runs
+                    # exactly when the subsystem is disarmed
+                    negated = (
+                        isinstance(test, ast.UnaryOp)
+                        and isinstance(test.op, ast.Not)
+                    )
+                    inner = test.operand if negated else test
+                    if not _test_gates(inner, aliases, allow_none):
+                        continue
+                    body = (
+                        anc.body if isinstance(anc.body, list)
+                        else [anc.body]
+                    )
+                    in_body = any(_contains(s, node) for s in body)
+                    if in_body != negated:
+                        gated = True
+                        break
+            if not gated:
+                yield Finding(
+                    self.name, f.rel, node.lineno, node.col_offset,
+                    f"{mod}.{func.attr}(...) builds its arguments "
+                    "unconditionally (f-string or helper call at line "
+                    f"{costly.lineno}); gate it behind "
+                    f"{mod}.is_on() so the off state stays one bool",
+                )

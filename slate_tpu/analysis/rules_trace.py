@@ -1,0 +1,385 @@
+"""JAX tracing-safety rules: traced-value misuse inside staged
+functions, and pytree hazards (enum-keyed dicts, ndarray-field
+dataclasses with a generated ``__eq__``).
+
+Bug classes mechanized (CHANGES.md):
+
+* PR1's ``shard_map`` collection kill and several review passes since:
+  host-side control flow (``if``/``while``), ``bool()/int()/float()``
+  coercions, or ``np.*`` host calls on traced operands inside a
+  ``jit``/``lax.while_loop``/``lax.cond``/``shard_map`` body either
+  crash at trace time or silently constant-fold one trace's value into
+  the compiled program.
+* PR3's unorderable-enum pytree crash: a dict keyed by enum members
+  reaching a jax API makes pytree flattening sort the keys and raise.
+* PR12's ``_Request`` fix: a ``@dataclass`` with ndarray-typed fields
+  generates an ``__eq__`` that compares arrays — truthiness raises, and
+  "equal" requests could alias.  ``eq=False`` (identity semantics) is
+  the contract for array-carrying dataclasses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    FileInfo,
+    Finding,
+    Project,
+    Rule,
+    const_str,
+    parents,
+    root_name,
+    rule,
+    terminal_name,
+)
+
+#: callables that stage their function argument(s) for tracing
+_TRACE_WRAPPERS = {"jit", "gated_jit", "instrument_jit"}
+_TRACE_HOFS = {
+    "while_loop", "cond", "scan", "fori_loop", "shard_map", "checkpoint",
+    "vmap", "pmap", "switch",
+}
+
+#: numpy module aliases (host-side: a call on a traced operand forces a
+#: transfer or crashes under trace)
+_NP_ROOTS = {"np", "numpy"}
+
+
+def _is_trace_decorator(dec: ast.AST) -> bool:
+    t = terminal_name(dec)
+    if t in _TRACE_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        t = terminal_name(dec.func)
+        if t in _TRACE_WRAPPERS:
+            return True
+        if t == "partial" and dec.args and (
+            terminal_name(dec.args[0]) in _TRACE_WRAPPERS
+        ):
+            return True
+    return False
+
+
+def _static_params(dec: ast.AST, fn: ast.AST) -> Set[str]:
+    """Parameter names a jit decorator marks static
+    (``static_argnames=(...)`` / ``static_argnums=(...)``): those are
+    Python values under the trace, not traced operands."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    out: Set[str] = set()
+    pos = [p.arg for p in fn.args.posonlyargs + fn.args.args]
+    for kw in dec.keywords:
+        vals = (
+            kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        if kw.arg == "static_argnames":
+            out |= {v for v in (const_str(e) for e in vals) if v}
+        elif kw.arg == "static_argnums":
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                        and 0 <= e.value < len(pos):
+                    out.add(pos[e.value])
+    return out
+
+
+def traced_functions(
+    f: FileInfo, project: Project
+) -> List[Tuple[ast.AST, Set[str]]]:
+    """``(fn, static_param_names)`` for every FunctionDef/Lambda staged
+    for tracing in this file: bodies decorated with a jit wrapper,
+    passed to a jit call, or passed to a lax control-flow/shard_map
+    combinator (matched by name — a local ``def body(...)`` referenced
+    as ``lax.while_loop(cond, body, ...)`` is resolved through the
+    file's def table)."""
+    key = f"traced::{f.rel}"
+    cached = project.cache.get(key)
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    traced: List[Tuple[ast.AST, Set[str]]] = []
+    seen: Dict[int, int] = {}
+
+    def mark(fn: ast.AST, static: Set[str]) -> None:
+        i = seen.get(id(fn))
+        if i is None:
+            seen[id(fn)] = len(traced)
+            traced.append((fn, static))
+        else:
+            traced[i] = (fn, traced[i][1] | static)
+
+    for node in ast.walk(f.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if _is_trace_decorator(d):
+                    mark(node, _static_params(d, node))
+        if not isinstance(node, ast.Call):
+            continue
+        t = terminal_name(node.func)
+        if t not in _TRACE_WRAPPERS and t not in _TRACE_HOFS:
+            continue
+        static_names: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                vals = (
+                    kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value]
+                )
+                static_names |= {
+                    v for v in (const_str(e) for e in vals) if v
+                }
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                mark(arg, set())
+            elif isinstance(arg, ast.Name):
+                for fn in defs_by_name.get(arg.id, ()):
+                    mark(fn, set(static_names))
+    project.cache[key] = traced
+    return traced
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _bare_param_use(node: ast.AST, params: Set[str]) -> Optional[ast.Name]:
+    """A Name in ``node``'s subtree that references a traced parameter
+    *as a value* — uses under an attribute access (``A.shape``,
+    ``x.dtype``: static under tracing), as the operand of ``len()`` /
+    ``isinstance()``, or inside identity (``is``/``is not``) compares
+    are exempt."""
+    for sub in ast.walk(node):
+        if not (isinstance(sub, ast.Name) and sub.id in params):
+            continue
+        parent = getattr(sub, "slate_parent", None)
+        if isinstance(parent, ast.Attribute) and parent.value is sub:
+            continue  # A.shape / A.ndim / A.dtype are static
+        if isinstance(parent, ast.Call) and terminal_name(parent.func) in (
+            "len", "isinstance", "id", "type",
+        ):
+            continue
+        skip = False
+        for anc in parents(sub):
+            if isinstance(anc, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in anc.ops
+            ):
+                skip = True  # identity checks never read the value
+                break
+            if anc is node:
+                break
+        if skip:
+            continue
+        return sub
+    return None
+
+
+@rule
+class TraceSafety(Rule):
+    """Inside functions staged for tracing, flag host control flow on
+    traced parameters, scalar coercions of them, and ``np.*`` calls
+    over them."""
+
+    name = "trace-safety"
+    summary = (
+        "no Python if/while, bool()/int()/float(), or np.* on traced "
+        "values inside jit/while_loop/cond/scan/shard_map bodies"
+    )
+    bug = "traced-value misuse (shard_map collection kill, trace crashes)"
+
+    def check_file(self, f: FileInfo, project: Project):
+        for fn, static in traced_functions(f, project):
+            params = _param_names(fn) - static
+            if not params:
+                continue
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.If, ast.While)):
+                        use = _bare_param_use(node.test, params)
+                        if use is not None:
+                            kind = (
+                                "if" if isinstance(node, ast.If) else "while"
+                            )
+                            yield Finding(
+                                self.name, f.rel, node.lineno,
+                                node.col_offset,
+                                f"Python `{kind}` on traced value "
+                                f"{use.id!r} inside a staged function — "
+                                "use lax.cond/lax.while_loop (or hoist "
+                                "the decision out of the traced body)",
+                            )
+                    elif isinstance(node, ast.Call):
+                        t = terminal_name(node.func)
+                        if (
+                            isinstance(node.func, ast.Name)
+                            and t in ("bool", "int", "float")
+                            and node.args
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in params
+                        ):
+                            yield Finding(
+                                self.name, f.rel, node.lineno,
+                                node.col_offset,
+                                f"{t}() coerces traced value "
+                                f"{node.args[0].id!r} to a host scalar "
+                                "inside a staged function",
+                            )
+                        elif (
+                            root_name(node.func) in _NP_ROOTS
+                            and isinstance(node.func, ast.Attribute)
+                        ):
+                            use = None
+                            for arg in node.args:
+                                use = _bare_param_use(arg, params)
+                                if use is not None:
+                                    break
+                            if use is not None:
+                                yield Finding(
+                                    self.name, f.rel, node.lineno,
+                                    node.col_offset,
+                                    f"host numpy call on traced value "
+                                    f"{use.id!r} inside a staged "
+                                    "function — use jnp/lax",
+                                )
+
+
+# ---------------------------------------------------------------------------
+# pytree safety
+# ---------------------------------------------------------------------------
+
+
+def enum_class_names(project: Project) -> Set[str]:
+    """Names of classes inheriting an Enum variant anywhere in the
+    linted tree (``Option``, ``Schedule``, ... from enums.py)."""
+    cached = project.cache.get("enum_classes")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    out: Set[str] = set()
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef) and any(
+                "Enum" in (terminal_name(b) or "") for b in node.bases
+            ):
+                out.add(node.name)
+    project.cache["enum_classes"] = out
+    return out
+
+
+_JAX_ROOTS = {"jax", "jnp", "lax"}
+
+
+def _reaches_jax(node: ast.AST) -> bool:
+    """The dict literal is an argument of a jax-ish call (jit'd
+    dispatch, lax combinator, tree op)."""
+    parent = getattr(node, "slate_parent", None)
+    while isinstance(parent, (ast.keyword, ast.Starred)):
+        parent = getattr(parent, "slate_parent", None)
+    if not isinstance(parent, ast.Call):
+        return False
+    func = parent.func
+    while isinstance(func, ast.Call):
+        func = func.func  # jax.jit(f)({...}) — unwrap to the jit call
+    t = terminal_name(func)
+    return (
+        root_name(func) in _JAX_ROOTS
+        or t in _TRACE_WRAPPERS
+        or t in _TRACE_HOFS
+    )
+
+
+@rule
+class PytreeSafety(Rule):
+    """Enum-keyed dict literals reaching jax, and array-carrying
+    dataclasses whose generated ``__eq__`` compares ndarrays."""
+
+    name = "pytree-safety"
+    summary = (
+        "no enum-keyed dicts into jax APIs; @dataclass with "
+        "ndarray/Array fields needs eq=False"
+    )
+    bug = "unorderable-enum pytree crash; ndarray-__eq__ dataclass"
+
+    def check_file(self, f: FileInfo, project: Project):
+        enums = enum_class_names(project)
+        traced = traced_functions(f, project)
+        traced_ids = {id(t) for t, _static in traced}
+        if enums:
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Dict):
+                    continue
+                key = next(
+                    (
+                        k for k in node.keys
+                        if isinstance(k, ast.Attribute)
+                        and isinstance(k.value, ast.Name)
+                        and k.value.id in enums
+                    ),
+                    None,
+                )
+                if key is None:
+                    continue
+                in_traced = any(
+                    id(anc) in traced_ids for anc in parents(node)
+                )
+                if in_traced or _reaches_jax(node):
+                    yield Finding(
+                        self.name, f.rel, node.lineno, node.col_offset,
+                        f"dict keyed by enum member "
+                        f"{ast.unparse(key)} reaches a jax API — pytree "
+                        "flattening sorts dict keys and enums are "
+                        "unorderable; key by .value (or pass the dict "
+                        "outside the traced boundary)",
+                    )
+        yield from self._check_dataclasses(f)
+
+    def _check_dataclasses(self, f: FileInfo):
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            dc = None
+            pytree_registered = False
+            eq_false = False
+            for dec in node.decorator_list:
+                t = terminal_name(dec if not isinstance(dec, ast.Call)
+                                  else dec.func)
+                if t == "dataclass":
+                    dc = dec
+                    if isinstance(dec, ast.Call):
+                        eq_false = any(
+                            kw.arg == "eq"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False
+                            for kw in dec.keywords
+                        )
+                elif t == "register_pytree_node_class":
+                    pytree_registered = True
+            if dc is None or eq_false or pytree_registered:
+                # pytree-registered classes define their own flatten
+                # contract and are never compared as dataclasses
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign):
+                    continue
+                ann = ast.unparse(stmt.annotation)
+                if "ndarray" in ann or "Array" in ann:
+                    yield Finding(
+                        self.name, f.rel, node.lineno, node.col_offset,
+                        f"@dataclass {node.name} has array-typed field "
+                        f"{ast.unparse(stmt.target)!r} ({ann}) but no "
+                        "eq=False — the generated __eq__ compares "
+                        "ndarrays (truthiness raises; equal-content "
+                        "instances alias in remove()-based sweeps)",
+                    )
+                    break

@@ -84,7 +84,10 @@ Spec grammar (``SLATE_TPU_FAULTS`` / :func:`configure`)::
 Every injection increments ``faults.injected.<site>`` in the metrics
 registry and the site's local stats (:func:`stats`), so
 ``tools/chaos_report.py`` can join injected-vs-recovered counts from a
-single metrics JSONL.
+single metrics JSONL.  Each site's recovery-counter families live in
+:data:`SITE_SPECS` — the machine-readable registry the report derives
+its join from and the ``fault-site`` lint rule checks call sites
+against (one map, three consumers, zero drift).
 """
 
 from __future__ import annotations
@@ -94,26 +97,76 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from ..exceptions import SlateError
 from . import metrics
 
-SITES = (
-    "compile",
-    "execute",
-    "result_corrupt",
-    "latency",
-    "worker_death",
-    "info_nonzero",
-    "artifact_corrupt",
-    "artifact_stale",
-    "artifact_load_fail",
-    "factor_stale",
-    "tenant_flood",
+
+@dataclass(frozen=True)
+class SiteSpec:
+    """One fault site's machine-readable contract: the metric counter
+    families whose sum is its recovery signal (what should have
+    absorbed the injection), and whether a zero-recovery outcome is
+    legitimate (``informational``).  This registry is the single
+    source of truth three consumers share: :func:`arm` validates site
+    names against it, ``tools/chaos_report.py`` derives its
+    injected-vs-recovered join from it at runtime, and the
+    ``fault-site`` lint rule checks statically that every call site is
+    declared here and every recovery counter is actually emitted."""
+
+    name: str
+    recovery: Tuple[str, ...] = ()
+    informational: bool = False
+
+
+SITE_SPECS: Tuple[SiteSpec, ...] = (
+    SiteSpec("compile", recovery=("serve.fallbacks", "serve.retries")),
+    SiteSpec("execute", recovery=(
+        "serve.retries", "serve.fallbacks", "serve.breaker_open",
+    )),
+    # the per-item direct re-solve of a corrupt batch bumps
+    # serve.fallbacks, so it is part of this site's signal (and of the
+    # shared-attribution overlap with compile/execute)
+    SiteSpec("result_corrupt", recovery=(
+        "serve.corrupt_result", "serve.fallbacks",
+    )),
+    # _miss_late() bumps both the split counter and the total; summing
+    # them would double-count, so only the split counter is joined.
+    # informational: added delay violates nothing unless requests carry
+    # deadlines — a latency-only run with no deadline traffic is a
+    # legitimate zero-signal outcome
+    SiteSpec("latency", recovery=("serve.deadline_miss_late",),
+             informational=True),
+    SiteSpec("worker_death", recovery=("serve.worker_restarts",)),
+    SiteSpec("info_nonzero", recovery=("serve.numerical_errors",)),
+    # detection == containment for the artifact load ladder: a counted
+    # rung means the bad artifact was recompiled, not served
+    SiteSpec("artifact_corrupt", recovery=("serve.artifact_corrupt",)),
+    SiteSpec("artifact_stale", recovery=("serve.artifact_stale",)),
+    SiteSpec("artifact_load_fail", recovery=("serve.artifact_load_fail",)),
+    # detection == containment for the factor-cache hit path too: a
+    # counted stale means the residual validation caught the mismatched
+    # factor and the item was re-solved direct, never delivered wrong
+    SiteSpec("factor_stale", recovery=("serve.factor_cache.stale",)),
+    # a synthetic tenant burst is absorbed when the admission plane
+    # refused (some of) it: overload shedding, token-bucket/queue-share
+    # quota rejections, or plain bounded-queue backpressure — a flood
+    # with NO refusal signal means fairness never engaged and the
+    # burst rode straight into the shared queue
+    SiteSpec("tenant_flood", recovery=(
+        "serve.shed", "serve.rejected_quota", "serve.rejected_share",
+        "serve.rejected",
+    )),
 )
+
+SITE_REGISTRY: Dict[str, SiteSpec] = {s.name: s for s in SITE_SPECS}
+
+#: site names in declaration order (the legacy surface arm() validates
+#: against; derived — never hand-edit separately from SITE_SPECS)
+SITES: Tuple[str, ...] = tuple(s.name for s in SITE_SPECS)
 
 
 class FaultInjected(SlateError):

@@ -92,7 +92,6 @@ from .buckets import (
     PRIORITIES,
     PRIO_NORMAL,
     check_priority,
-    priority_name,
 )
 
 TENANTS_ENV = "SLATE_TPU_TENANTS"
@@ -217,8 +216,10 @@ class TokenBucket:
     def __init__(self, rate: float, capacity: int, now: float = 0.0):
         self.rate = float(rate)
         self.capacity = float(capacity)
-        self.tokens = float(capacity)
-        self.t_last = float(now)
+        # refill state is mutated by take()/remaining(), always called
+        # under the admission plane's lock
+        self.tokens = float(capacity)  # guarded by: _lock (external)
+        self.t_last = float(now)  # guarded by: _lock (external)
 
     def _refill(self, now: float) -> None:
         dt = now - self.t_last
@@ -275,10 +276,14 @@ class FairQueue:
 
     def __init__(self, adm: "AdmissionControl"):
         self._adm = adm
-        self._items: List = []  # arrival order (appendleft = retry head)
-        self._vtime: Dict[str, float] = {}
-        self._vnow = 0.0
-        self._depth: Dict[str, int] = {}
+        # externally synchronized (see class docstring): every access
+        # happens under the owning service's condition lock, like the
+        # deque this queue replaces — the lint annotations document
+        # that contract and police any access from OUTSIDE this class
+        self._items: List = []  # guarded by: _cond (external) — arrival order
+        self._vtime: Dict[str, float] = {}  # guarded by: _cond (external)
+        self._vnow = 0.0  # guarded by: _cond (external)
+        self._depth: Dict[str, int] = {}  # guarded by: _cond (external)
 
     # -- deque-compatible surface ------------------------------------------
 
@@ -517,11 +522,15 @@ class OverloadController:
         self.alpha = float(alpha)
         self.dwell_s = float(dwell_s)
         self.shrink = float(shrink)
-        self.level = 0
-        self.ewma = 0.0
-        self.observations = 0
-        self._t_changed = -math.inf
-        self._t_observed = -math.inf
+        # controller state advances under the admission plane's lock
+        # (observe()/tick() callers hold it); `level` is additionally
+        # READ lock-free on deliberately racy fast paths — those sites
+        # carry their own justification + lint suppression
+        self.level = 0  # guarded by: _lock (external)
+        self.ewma = 0.0  # guarded by: _lock (external)
+        self.observations = 0  # guarded by: _lock (external)
+        self._t_changed = -math.inf  # guarded by: _lock (external)
+        self._t_observed = -math.inf  # guarded by: _lock (external)
 
     def _retarget(self, now: float) -> Optional[Tuple[int, int]]:
         """Re-evaluate the level against the current EWMA (escalation
@@ -575,6 +584,19 @@ class OverloadController:
         """Coalesce-window multiplier under overload (1.0 healthy)."""
         return self.shrink ** self.level if self.level else 1.0
 
+    @staticmethod
+    def shed_names(level: int) -> List[str]:
+        """Priority-class names shed at ``level`` (lowest-first,
+        ``high`` never) — the ONE spelling of the shed threshold, used
+        by :meth:`sheds`' consumers that report class lists (health
+        snapshot, overload span instants)."""
+        if level <= 0:
+            return []
+        return [
+            p for i, p in enumerate(PRIORITIES)
+            if i >= len(PRIORITIES) - level
+        ]
+
 
 # ---------------------------------------------------------------------------
 # the admission plane
@@ -625,8 +647,8 @@ class AdmissionControl:
         self.overload = overload or OverloadController()
         self.clock = clock
         self._lock = threading.Lock()
-        self._states: Dict[str, _TenantState] = {}
-        self._windows: Dict[str, AdaptiveWindow] = {}
+        self._states: Dict[str, _TenantState] = {}  # guarded by: _lock
+        self._windows: Dict[str, AdaptiveWindow] = {}  # guarded by: _lock
         self._capped = metrics.CappedKeys(TENANT_METRIC_CAP)
         # resolved-config memo for UNNAMED tenants: config_for sits in
         # the scheduler hot path (every FairQueue pop, under the
@@ -729,7 +751,8 @@ class AdmissionControl:
             self._cfg_cache[tenant] = cfg
         return cfg
 
-    def _state(self, tenant: str) -> _TenantState:
+    def _state_locked(self, tenant: str) -> _TenantState:
+        # _locked suffix: the caller holds self._lock
         st = self._states.get(tenant)
         if st is None:
             cfg = self.config_for(tenant)
@@ -757,7 +780,7 @@ class AdmissionControl:
         """Count one per-tenant admission event (health ints + the
         capped ``serve.tenant.<id>.<event>`` metric family)."""
         with self._lock:
-            st = self._state(tenant)
+            st = self._state_locked(tenant)
             st.counts[event] = st.counts.get(event, 0) + n
         if metrics.is_on():
             if self._capped.track(tenant):
@@ -769,7 +792,7 @@ class AdmissionControl:
         """One admission against the tenant's token bucket (True =
         admitted; unlimited tenants always pass)."""
         with self._lock:
-            st = self._state(tenant)
+            st = self._state_locked(tenant)
             if st.bucket is None:
                 return True
             return st.bucket.take(now)
@@ -796,10 +819,10 @@ class AdmissionControl:
         a chance to decay an idle EWMA and de-escalate even when
         shedding refuses every request that would otherwise feed it
         (``OverloadController.tick``)."""
-        if self.overload.level == 0:
-            # lock-free steady state: tick only ever LOWERS the level,
-            # so a racy read that misses a just-raised level merely
-            # defers the (no-op-at-0 anyway) decay to the next submit
+        # lock-free steady state: tick only ever LOWERS the level, so a
+        # racy read that misses a just-raised level merely defers the
+        # (no-op-at-0 anyway) decay to the next submit
+        if self.overload.level == 0:  # slate-lint: disable=lock-discipline
             return
         with self._lock:
             moved = self.overload.tick(now)
@@ -817,14 +840,12 @@ class AdmissionControl:
         metrics.inc(
             "serve.overload.enter" if new > old else "serve.overload.exit"
         )
-        spans.event(
-            "overload_enter" if new > old else "overload_exit",
-            trace=trace, lane=lane, level=new,
-            sheds=[
-                p for i, p in enumerate(PRIORITIES)
-                if i >= len(PRIORITIES) - new
-            ] if new else [],
-        )
+        if spans.is_on():
+            spans.event(
+                "overload_enter" if new > old else "overload_exit",
+                trace=trace, lane=lane, level=new,
+                sheds=OverloadController.shed_names(new),
+            )
 
     # -- the control loop ---------------------------------------------------
 
@@ -840,11 +861,12 @@ class AdmissionControl:
             win = self.ceiling_s
         return win * self.overload.window_factor()
 
-    def _window(self, label: str) -> AdaptiveWindow:
+    def _window_locked(self, label: str) -> AdaptiveWindow:
         w = self._windows.get(label)
         if w is None:
             w = self._windows[label] = AdaptiveWindow(self.ceiling_s)
-            metrics.gauge(f"serve.adaptive.{label}.window_s", w.window_s)
+            if metrics.is_on():
+                metrics.gauge(f"serve.adaptive.{label}.window_s", w.window_s)
         return w
 
     def observe_finish(
@@ -877,7 +899,7 @@ class AdmissionControl:
                 f"serve.latency.tenant.{tenant}.total", total_s
             )
         with self._lock:
-            st = self._state(tenant)
+            st = self._state_locked(tenant)
             if burn is not None:
                 # the per-tenant twin of the service-wide slo_burn
                 # tiers: each finished deadline request lands in one
@@ -904,13 +926,16 @@ class AdmissionControl:
             win = None
             if self.adaptive and windowed and label is not None \
                     and budget > 0:
-                w = self._window(label)
+                w = self._window_locked(label)
                 decision = w.observe(total_s, budget)
                 win = w.window_s
         self._emit_overload(moved, trace=trace, lane=lane)
         if decision is not None:
-            metrics.gauge(f"serve.adaptive.{label}.window_s", win)
-            metrics.inc(f"serve.adaptive.{label}.{decision}")
+            if metrics.is_on():
+                # adaptation runs with or without the registry; the
+                # per-bucket f-string names are only built when it is on
+                metrics.gauge(f"serve.adaptive.{label}.window_s", win)
+                metrics.inc(f"serve.adaptive.{label}.{decision}")
             metrics.inc("serve.adaptive.changes")
             spans.event(
                 "adaptive_window", trace=trace, lane=lane, bucket=label,
@@ -956,20 +981,21 @@ class AdmissionControl:
     def snapshot(self) -> dict:
         """Controller state for ``health()["admission"]``."""
         with self._lock:
+            # one consistent controller snapshot: level and EWMA read
+            # under the same lock that advances them (a probe racing a
+            # transition must not report level 2 beside a level-0 EWMA)
             windows = {
                 lbl: round(w.window_s, 6)
                 for lbl, w in self._windows.items()
             }
-        lvl = self.overload.level
+            lvl = self.overload.level
+            ewma = self.overload.ewma
         return {
             "tenancy": self.tenancy,
             "adaptive": self.adaptive,
             "budget_s": self.budget_s,
             "overload_level": lvl,
-            "shedding": [
-                priority_name(i) for i in range(len(PRIORITIES))
-                if self.overload.sheds(i)
-            ],
-            "burn_ewma": round(self.overload.ewma, 4),
+            "shedding": OverloadController.shed_names(lvl),
+            "burn_ewma": round(ewma, 4),
             "windows": windows,
         }

@@ -359,7 +359,8 @@ class ArtifactStore:
                     except OSError:
                         pass
             metrics.inc("serve.artifact_saved")
-            metrics.inc(f"serve.artifact_saved_{mode}")
+            if metrics.is_on():
+                metrics.inc(f"serve.artifact_saved_{mode}")
             return mode
         except Exception:  # noqa: BLE001 — persistence must never crash serving
             metrics.inc("serve.artifact_save_error")
@@ -374,8 +375,9 @@ class ArtifactStore:
             # re-save so the store self-heals
             with self._lock:
                 self._cache_seed_verified.discard((key, int(batch)))
-        metrics.inc(f"serve.artifact_{outcome}")
-        metrics.inc(f"serve.artifact.{key.label}.b{int(batch)}.{outcome}")
+        if metrics.is_on():
+            metrics.inc(f"serve.artifact_{outcome}")
+            metrics.inc(f"serve.artifact.{key.label}.b{int(batch)}.{outcome}")
 
     def load(self, key: BucketKey, batch: int) -> Optional[Callable]:
         """Load one entry; returns the deserialized callable (ready for

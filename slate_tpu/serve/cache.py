@@ -216,7 +216,9 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
         Bm = Matrix.from_global(B, nb)
         X, _LU, _piv, info = _lu.gesv(Matrix.from_global(A, nb), Bm)
         if int(info) != 0:
-            raise NumericalError(f"gesv: singular U({int(info)})", int(info))
+            raise NumericalError(
+                f"gesv: singular U({int(info)})", int(info)
+            ).with_context(routine=routine)
         return np.asarray(X.to_global())
     if routine == "posv":
         Bm = Matrix.from_global(B, nb)
@@ -224,7 +226,9 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
             HermitianMatrix.from_global(A, nb, uplo=Uplo.Lower), Bm
         )
         if int(info) != 0:
-            raise NumericalError(f"posv: not SPD at {int(info)}", int(info))
+            raise NumericalError(
+                f"posv: not SPD at {int(info)}", int(info)
+            ).with_context(routine=routine)
         return np.asarray(X.to_global())
     if routine == "gels":
         nbm = min(64, max(A.shape))

@@ -121,6 +121,8 @@ def record(event: str, fp: Optional[str] = None,
     per-bucket + per-fingerprint (12-hex prefix — the factor_report
     join key, capped at :data:`FP_METRIC_CAP` distinct fingerprints),
     mirroring the serve.artifact_* naming scheme."""
+    if not metrics.is_on():
+        return  # hit-path caller: no f-string names built while off
     metrics.inc(f"serve.factor_cache.{event}", n)
     if label:
         metrics.inc(f"serve.factor_cache.{label}.{event}", n)
@@ -134,6 +136,8 @@ def record(event: str, fp: Optional[str] = None,
 
 def _fp_gauge(fp: str, value: float) -> None:
     """Per-fingerprint bytes gauge, under the same cardinality cap."""
+    if not metrics.is_on():
+        return
     fp12 = fp[:12]
     if _fp_keys.track(fp12):
         metrics.gauge(f"serve.factor_cache.fp.{fp12}.bytes", value)
@@ -144,9 +148,13 @@ def _fp_gauge(fp: str, value: float) -> None:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(eq=False)
 class FactorEntry:
-    """One cached factorization, ready for the solve-phase executable."""
+    """One cached factorization, ready for the solve-phase executable.
+
+    ``eq=False``: entries are identities, not values — the generated
+    ``__eq__`` would compare the ndarray factor (truthiness raises),
+    the same hazard PR12 fixed on ``service._Request``."""
 
     fp: str  # matrix_fingerprint of the A it was computed from
     routine: str  # gesv | posv
@@ -192,21 +200,27 @@ def factor_only(routine: str, A: np.ndarray, schedule: str = "auto"):
     if routine == "gesv":
         LU, piv, info = _lu.getrf(Matrix.from_global(A, nb), opts)
         if int(info) != 0:
-            raise NumericalError(f"getrf: singular U({int(info)})", int(info))
+            raise NumericalError(
+                f"getrf: singular U({int(info)})", int(info)
+            ).with_context(routine=routine)
         perm = np.asarray(piv.perm)[:n].astype(np.int64)
         if perm.size and int(perm.max()) >= n:
             # cannot happen for the identity-spliced padded LU, but a
             # factor whose permutation escapes the leading block could
             # not be replayed against a bucket-padded B — refuse to
             # cache rather than risk a wrong X
-            raise NumericalError("getrf: pivot escaped the leading block")
+            raise NumericalError(
+                "getrf: pivot escaped the leading block"
+            ).with_context(routine=routine)
         return np.asarray(LU.to_global()), perm
     if routine == "posv":
         L, info = _chol.potrf(
             HermitianMatrix.from_global(A, nb, uplo=Uplo.Lower), opts
         )
         if int(info) != 0:
-            raise NumericalError(f"potrf: not SPD at {int(info)}", int(info))
+            raise NumericalError(
+                f"potrf: not SPD at {int(info)}", int(info)
+            ).with_context(routine=routine)
         return np.tril(np.asarray(L.to_global())), None
     raise ValueError(f"factor cache supports gesv/posv, not {routine!r}")
 

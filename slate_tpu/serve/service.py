@@ -263,9 +263,13 @@ class _Replica:
     def __init__(self, name: str, device=None):
         self.name = name
         self.device = device
-        self.q: Deque[_Request] = deque()
-        self.inflight: List[_Request] = []
-        self.breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}
+        # the shared mutable lane state below is owned by the SERVICE's
+        # condition lock (SolverService._cond): workers, admission, and
+        # health probes all touch it — the annotations are ground truth
+        # for the lock-discipline lint rule
+        self.q: Deque[_Request] = deque()  # guarded by: _cond
+        self.inflight: List[_Request] = []  # guarded by: _cond
+        self.breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}  # guarded by: _cond
         self.thread: Optional[threading.Thread] = None
         self.restarts = 0
         self.dispatched = 0  # requests this lane executed (incl. direct)
@@ -556,8 +560,11 @@ class SolverService:
     @property
     def _breakers(self) -> Dict[_bk.BucketKey, _bk.Breaker]:
         """Back-compat alias: the default replica's breaker table (the
-        whole table of a single-replica service)."""
-        return self._replicas[0].breakers
+        whole table of a single-replica service).  Returns the LIVE
+        dict for test introspection — taking _cond around the fetch
+        would not protect callers, who hold the alias unlocked; the
+        chaos tests poke Breaker fields through it deliberately."""
+        return self._replicas[0].breakers  # slate-lint: disable=lock-discipline
 
     def _gauge_queues_locked(self) -> int:
         total = 0
@@ -857,11 +864,14 @@ class SolverService:
                 # SLO of what is already queued
                 adm.tenant_event(tname, "shed")
                 metrics.inc("serve.shed")
-                spans.event(
-                    "shed", trace=_trace, lane="client", tenant=tname,
-                    priority=_bk.priority_name(prio),
-                    level=adm.overload.level,
-                )
+                if spans.is_on():
+                    # a shed must stay O(1): even the span attrs are
+                    # only built while tracing is armed
+                    spans.event(
+                        "shed", trace=_trace, lane="client", tenant=tname,
+                        priority=_bk.priority_name(prio),
+                        level=adm.overload.level,
+                    )
                 raise Shed(
                     f"{routine}: overload level {adm.overload.level} "
                     f"is shedding {_bk.priority_name(prio)}-priority "
@@ -1560,7 +1570,8 @@ class SolverService:
             # returned non-finite X must re-open, not close
             if br.record_failure(time.monotonic(), self.degrade_after):
                 metrics.inc("serve.breaker_open")
-                metrics.inc(f"serve.replica.{rep.name}.breaker_open")
+                if metrics.is_on():
+                    metrics.inc(f"serve.replica.{rep.name}.breaker_open")
                 metrics.inc("serve.degraded")
                 spans.event("breaker_open", trace=batch[0].trace,
                             lane=rep.lane, bucket=key.label, corrupt=True)
@@ -1572,7 +1583,8 @@ class SolverService:
             pass
         elif br.record_success():
             metrics.inc("serve.breaker_closed")  # half-open probe healed
-            metrics.inc(f"serve.replica.{rep.name}.breaker_closed")
+            if metrics.is_on():
+                metrics.inc(f"serve.replica.{rep.name}.breaker_closed")
             spans.event("breaker_closed", trace=batch[0].trace,
                         lane=rep.lane, bucket=key.label)
         # resolve futures only AFTER the breaker transition committed: a
@@ -1669,10 +1681,13 @@ class SolverService:
         deliver = []
         corrupt = 0
         for i, r in enumerate(batch):
-            metrics.inc(
-                "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
-            )
             if mon:
+                # pad_waste is real arithmetic per delivered item: only
+                # spend it while the registry is collecting
+                metrics.inc(
+                    "serve.bucket_pad_waste",
+                    _bk.pad_waste(key, r.m, r.n, r.nrhs),
+                )
                 # execute/total halves of the split, per bucket AND per
                 # replica — one observation per delivered request (a
                 # batch peer shares the batch's execute wall; requests
@@ -1806,10 +1821,13 @@ class SolverService:
         corrupt = 0
         stale = False
         for i, r in enumerate(batch):
-            metrics.inc(
-                "serve.bucket_pad_waste", _bk.pad_waste(key, r.m, r.n, r.nrhs)
-            )
             if mon:
+                # pad_waste is real arithmetic per delivered item: only
+                # spend it while the registry is collecting
+                metrics.inc(
+                    "serve.bucket_pad_waste",
+                    _bk.pad_waste(key, r.m, r.n, r.nrhs),
+                )
                 # the trsm-only half of the latency story: the solve
                 # bucket label carries the ".solve" suffix, so these
                 # land in serve.latency.<bucket>.solve.{execute,total}

@@ -1,0 +1,860 @@
+"""slate-lint (slate_tpu/analysis): per-rule fixture positives and
+clean negatives, suppression + baseline semantics, JSON schema, and
+the self-run asserting the shipped tree is clean.
+
+Fixture snippets are written into a throwaway repo skeleton (the
+engine's path scoping — serve/ for the gating and exception rules,
+tools/*_report.py for the consumer side of metric drift — is part of
+what is under test).  The linter is stdlib-only, so these tests never
+touch jax.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from slate_tpu import analysis
+from slate_tpu.analysis import core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mini_repo(tmp_path, files, readme=None):
+    """Lay out {relpath: source} under tmp_path and return its root."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    if readme is not None:
+        (tmp_path / "README.md").write_text(textwrap.dedent(readme))
+    return str(tmp_path)
+
+
+def _lint(root, rule):
+    return analysis.run(root, rules=[rule])
+
+
+def _rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_ships_the_eight_rules():
+    expected = {
+        "metric-drift", "fault-site", "hot-path-gating", "trace-safety",
+        "pytree-safety", "lock-discipline", "env-drift",
+        "exception-context",
+    }
+    assert expected <= set(analysis.RULES)
+    for name in expected:
+        r = analysis.RULES[name]
+        assert r.summary and r.bug  # documented, not just registered
+
+
+# ---------------------------------------------------------------------------
+# rule 1: metric-drift
+# ---------------------------------------------------------------------------
+
+
+def test_metric_drift_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+            metrics.inc("serve.requests")
+        """,
+        "tools/foo_report.py": """
+            def load(counters):
+                return counters.get("serve.requets_typo", 0)
+        """,
+    })
+    res = _lint(root, "metric-drift")
+    assert _rules_of(res) == ["metric-drift"]
+    assert "serve.requets_typo" in res.findings[0].message
+    assert res.findings[0].path == "tools/foo_report.py"
+
+
+def test_metric_drift_negative_exact_prefix_and_readme(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+            metrics.inc("serve.requests")
+            def g(label):
+                metrics.observe_hist(f"serve.latency.{label}.total", 0.1)
+        """,
+        "tools/foo_report.py": """
+            def load(counters):
+                a = counters.get("serve.requests", 0)
+                b = [k for k in counters if k.startswith("serve.latency.")]
+                return a, b
+        """,
+    }, readme="""
+        Metrics: `serve.requests` and per bucket
+        `serve.latency.<bucket>.total`.
+    """)
+    assert _lint(root, "metric-drift").ok
+
+
+def test_metric_drift_not_vacuous_under_bare_root_fstring(tmp_path):
+    # an emitter like f"serve.{label}.b{batch}" must NOT whitelist the
+    # whole serve.* namespace (the bare-root prefix is discarded)
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+            metrics.inc("serve.requests")
+            def g(label, batch):
+                metrics.observe(f"serve.{label}.b{batch}", 0.1)
+        """,
+        "tools/foo_report.py": """
+            def load(counters):
+                return counters.get("serve.totally_bogus_counter", 0)
+        """,
+    })
+    res = _lint(root, "metric-drift")
+    assert len(res.findings) == 1
+    assert "serve.totally_bogus_counter" in res.findings[0].message
+
+
+def test_metric_drift_suffix_matches_computed_base(tmp_path):
+    # the {base}.leaf idiom: name = f"refine.{r}" then f"{name}.calls"
+    # — consumed "refine.calls" matches via the constant suffix
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/refine/ir.py": """
+            from ..aux import metrics
+            def f(routine):
+                name = f"refine.{routine}"
+                metrics.inc(f"{name}.calls")
+        """,
+        "tools/foo_report.py": """
+            def load(counters):
+                good = counters.get("refine.calls", 0)
+                return good
+        """,
+    })
+    assert _lint(root, "metric-drift").ok
+
+
+def test_metric_drift_readme_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+            metrics.inc("serve.requests")
+        """,
+    }, readme="Docs mention `serve.ghost_counter` here.\n")
+    res = _lint(root, "metric-drift")
+    assert [f.path for f in res.findings] == ["README.md"]
+    assert "serve.ghost_counter" in res.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# rule 2: fault-site
+# ---------------------------------------------------------------------------
+
+_FAULTS_FIXTURE = """
+    class SiteSpec:
+        def __init__(self, name, recovery=(), informational=False):
+            pass
+
+    SITE_SPECS = (
+        SiteSpec("execute", recovery=("serve.retries",)),
+        SiteSpec("latency", recovery=(), informational=True),
+    )
+"""
+
+
+def test_fault_site_positive_undeclared_site(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/aux/faults.py": _FAULTS_FIXTURE,
+        "slate_tpu/serve/svc.py": """
+            from ..aux import faults, metrics
+            metrics.inc("serve.retries")
+            def f():
+                faults.check("execute")
+                faults.check("exceute_typo")
+        """,
+    })
+    res = _lint(root, "fault-site")
+    assert len(res.findings) == 1
+    assert "exceute_typo" in res.findings[0].message
+
+
+def test_fault_site_positive_unrecoverable_and_ghost_counter(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/aux/faults.py": """
+            class SiteSpec:
+                def __init__(self, name, recovery=(), informational=False):
+                    pass
+
+            SITE_SPECS = (
+                SiteSpec("orphan"),
+                SiteSpec("ghost", recovery=("serve.not_emitted",)),
+            )
+        """,
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+            metrics.inc("serve.retries")
+        """,
+    })
+    msgs = " | ".join(f.message for f in _lint(root, "fault-site").findings)
+    assert "orphan" in msgs and "no recovery" in msgs
+    assert "serve.not_emitted" in msgs
+
+
+def test_fault_site_negative(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/aux/faults.py": _FAULTS_FIXTURE,
+        "slate_tpu/serve/svc.py": """
+            from ..aux import faults, metrics
+            metrics.inc("serve.retries")
+            def f():
+                faults.check("execute")
+                faults.sleep("latency")
+        """,
+    })
+    assert _lint(root, "fault-site").ok
+
+
+def test_fault_site_registry_matches_chaos_report():
+    """The shipped chaos_report derives RECOVERY/INFORMATIONAL from the
+    shipped registry (single source of truth, satellite refactor)."""
+    import importlib.util
+
+    from slate_tpu.aux.faults import SITE_REGISTRY, SITES
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_report_lintcheck",
+        os.path.join(REPO_ROOT, "tools", "chaos_report.py"),
+    )
+    cr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cr)
+    assert set(cr.RECOVERY) == set(SITES)
+    for site, spec_ in SITE_REGISTRY.items():
+        assert cr.RECOVERY[site] == spec_.recovery
+    assert cr.INFORMATIONAL == {
+        s for s, sp in SITE_REGISTRY.items() if sp.informational
+    }
+
+
+# ---------------------------------------------------------------------------
+# rule 3: hot-path-gating
+# ---------------------------------------------------------------------------
+
+
+def test_hot_path_gating_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+
+            def deliver(label, waste):
+                metrics.inc(f"serve.latency.{label}.total")
+                metrics.inc("serve.pad", compute_waste(waste))
+        """,
+    })
+    res = _lint(root, "hot-path-gating")
+    assert len(res.findings) == 2
+
+
+def test_hot_path_gating_negative_gates(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics, spans
+
+            def deliver(label, req, waste):
+                metrics.inc("serve.requests")         # literal: free
+                if metrics.is_on():
+                    metrics.inc(f"serve.latency.{label}.total")
+                mon = metrics.is_on()
+                if mon:
+                    metrics.inc("serve.pad", compute_waste(waste))
+                if req.span is not None:
+                    spans.annotate(req.span, outcome=classify(req))
+                try:
+                    pass
+                except Exception:
+                    metrics.inc(f"serve.fail.{label}")  # cold: exempt
+
+            def capture(name):
+                if not metrics.is_on():
+                    return
+                metrics.observe(f"{name}.cost", measure(name))
+        """,
+    })
+    assert _lint(root, "hot-path-gating").ok
+
+
+def test_hot_path_gating_polarity_and_branch(tmp_path):
+    # the OFF branch of a gate is NOT gated: else of is_on(), the body
+    # of `if not mon:`, and the body of an early-return guard all run
+    # exactly when the subsystem is disarmed
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            from ..aux import metrics
+
+            def a(label):
+                if metrics.is_on():
+                    pass
+                else:
+                    metrics.inc(f"serve.{label}.off_branch")
+
+            def b(label):
+                mon = metrics.is_on()
+                if not mon:
+                    metrics.inc(f"serve.{label}.off_body")
+
+            def c(label):
+                if not metrics.is_on():
+                    metrics.inc(f"serve.{label}.guard_body")
+                    return
+                metrics.inc(f"serve.{label}.covered_after_guard")  # gated
+        """,
+    })
+    res = _lint(root, "hot-path-gating")
+    msgs = " | ".join(f.message for f in res.findings)
+    assert len(res.findings) == 3, msgs
+    # the call AFTER the early-return guard stays covered
+    lines = {f.line for f in res.findings}
+    src = (tmp_path / "slate_tpu/serve/svc.py").read_text()
+    covered_line = next(
+        i for i, ln in enumerate(src.splitlines(), 1)
+        if "covered_after_guard" in ln
+    )
+    assert covered_line not in lines
+
+
+def test_hot_path_gating_out_of_scope_negative(tmp_path):
+    # the rule polices serve hot paths; drivers/ own instrumentation
+    # conventions are out of scope
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/drivers/x.py": """
+            from ..aux import metrics
+            def f(label):
+                metrics.inc(f"refine.{label}.calls")
+        """,
+    })
+    assert _lint(root, "hot-path-gating").ok
+
+
+# ---------------------------------------------------------------------------
+# rule 4: trace-safety
+# ---------------------------------------------------------------------------
+
+
+def test_trace_safety_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/ops/k.py": """
+            import numpy as np
+            import jax
+            from jax import lax
+
+            def body(carry, x):
+                if x > 0:
+                    carry = carry + x
+                s = float(x)
+                np.linalg.norm(x)
+                return carry, s
+
+            def run(xs):
+                return lax.scan(body, 0.0, xs)
+        """,
+    })
+    res = _lint(root, "trace-safety")
+    msgs = " | ".join(f.message for f in res.findings)
+    assert len(res.findings) == 3
+    assert "`if`" in msgs and "float()" in msgs and "numpy" in msgs
+
+
+def test_trace_safety_negative(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/ops/k.py": """
+            import numpy as np
+            import jax
+            from functools import partial
+            from jax import lax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def core(A, n):
+                if n > 8:                # static_argnames: python value
+                    A = A + 1
+                if A.shape[0] > 4:       # shapes are static under trace
+                    A = A * 2
+                if A is None:            # identity check never traces
+                    return A
+                pad = np.zeros(A.shape)  # np over static shape: host-side
+                return lax.cond(A.sum() > 0, lambda a: a, lambda a: -a, A)
+
+            def host(A):
+                if A.any():              # not a traced context at all
+                    return float(A[0])
+                return 0.0
+        """,
+    })
+    assert _lint(root, "trace-safety").ok
+
+
+# ---------------------------------------------------------------------------
+# rule 5: pytree-safety
+# ---------------------------------------------------------------------------
+
+
+def test_pytree_safety_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/x.py": """
+            import enum
+            import numpy as np
+            import jax
+            from dataclasses import dataclass
+
+            class Option(enum.Enum):
+                Schedule = 1
+
+            def run(v):
+                return jax.jit(lambda t: t)({Option.Schedule: v})
+
+            @dataclass
+            class Entry:
+                factor: np.ndarray
+        """,
+    })
+    res = _lint(root, "pytree-safety")
+    msgs = " | ".join(f.message for f in res.findings)
+    assert len(res.findings) == 2
+    assert "Option.Schedule" in msgs
+    assert "eq=False" in msgs
+
+
+def test_pytree_safety_negative(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/x.py": """
+            import enum
+            import numpy as np
+            import jax
+            from dataclasses import dataclass
+
+            class Option(enum.Enum):
+                Schedule = 1
+
+            def configure(opts):
+                # enum-keyed dicts OUTSIDE jax are the options idiom
+                return {Option.Schedule: "auto", **(opts or {})}
+
+            @dataclass(eq=False)
+            class Entry:
+                factor: np.ndarray
+
+            @jax.tree_util.register_pytree_node_class
+            @dataclass
+            class Pivots:
+                perm: np.ndarray
+
+                def tree_flatten(self):
+                    return (self.perm,), None
+        """,
+    })
+    assert _lint(root, "pytree-safety").ok
+
+
+# ---------------------------------------------------------------------------
+# rule 6: lock-discipline
+# ---------------------------------------------------------------------------
+
+_LOCKED_SRC = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self.q = []  # guarded by: _cond
+
+        def good(self):
+            with self._cond:
+                return len(self.q)
+
+        def _drain_locked(self):
+            return list(self.q)   # caller holds the lock (convention)
+
+        def bad(self):
+            return len(self.q)
+"""
+
+
+def test_lock_discipline_positive_and_exemptions(tmp_path):
+    root = _mini_repo(tmp_path, {"slate_tpu/serve/svc.py": _LOCKED_SRC})
+    res = _lint(root, "lock-discipline")
+    assert len(res.findings) == 1
+    # only the unlocked access in bad() fires — with-block, __init__,
+    # and the _locked suffix are all exempt
+    assert res.findings[0].line == textwrap.dedent(
+        _LOCKED_SRC
+    ).splitlines().index("        return len(self.q)") + 1
+
+
+def test_lock_discipline_external_variant(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            import threading
+
+            class Queue:
+                def __init__(self):
+                    self._items = []  # guarded by: _lock (external)
+
+                def pop(self):
+                    return self._items.pop()  # internal: documented API
+
+            class Owner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.q = Queue()
+
+                def ok(self):
+                    with self._lock:
+                        return self.q._items
+
+                def racy(self):
+                    return self.q._items
+        """,
+    })
+    res = _lint(root, "lock-discipline")
+    assert len(res.findings) == 1
+    assert "racy" not in res.findings[0].message  # finding names the attr
+    assert "_items" in res.findings[0].message
+
+
+def test_lock_discipline_same_attr_under_two_guards(tmp_path):
+    # one attribute NAME annotated in two classes with different locks:
+    # holding either lock is clean, holding neither is one finding
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self.state = 0  # guarded by: _la
+
+            class B:
+                def __init__(self):
+                    self._lb = threading.Lock()
+                    self.state = 0  # guarded by: _lb
+
+            def ok_a(a):
+                with a._la:
+                    return a.state
+
+            def ok_b(b):
+                with b._lb:
+                    return b.state
+
+            def racy(x):
+                return x.state
+        """,
+    })
+    res = _lint(root, "lock-discipline")
+    assert len(res.findings) == 1
+    assert "_la/_lb" in res.findings[0].message
+
+
+def test_lock_discipline_local_variable_comment_registers_nothing(tmp_path):
+    # a "guarded by" comment on a method-LOCAL variable is not an
+    # attribute annotation — it must not police same-named attributes
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            class C:
+                def m(self):
+                    level = 0  # guarded by: _lock
+                    return level
+
+            def reader(x):
+                return x.level
+        """,
+    })
+    assert _lint(root, "lock-discipline").ok
+
+
+def test_lock_discipline_negative_unannotated(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            class Pool:
+                def __init__(self):
+                    self.q = []
+
+                def free(self):
+                    return len(self.q)   # nothing declared: no findings
+        """,
+    })
+    assert _lint(root, "lock-discipline").ok
+
+
+# ---------------------------------------------------------------------------
+# rule 7: env-drift
+# ---------------------------------------------------------------------------
+
+
+def test_env_drift_both_directions(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/x.py": """
+            import os
+            a = os.environ.get("SLATE_TPU_DOCUMENTED")
+            b = os.environ.get("SLATE_TPU_SECRET_KNOB")
+        """,
+    }, readme="""
+        | `SLATE_TPU_DOCUMENTED=1` | does things |
+        | `SLATE_TPU_ZOMBIE=1` | no longer exists |
+    """)
+    res = _lint(root, "env-drift")
+    msgs = {f.message.split(" ")[0] for f in res.findings}
+    assert msgs == {"SLATE_TPU_SECRET_KNOB", "README"} or len(res.findings) == 2
+    texts = " | ".join(f.message for f in res.findings)
+    assert "SLATE_TPU_SECRET_KNOB" in texts
+    assert "SLATE_TPU_ZOMBIE" in texts
+
+
+def test_env_drift_negative(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/x.py": """
+            import os
+            a = os.environ.get("SLATE_TPU_KNOB")
+        """,
+    }, readme="`SLATE_TPU_KNOB=1` documented here.\n")
+    assert _lint(root, "env-drift").ok
+
+
+# ---------------------------------------------------------------------------
+# rule 8: exception-context
+# ---------------------------------------------------------------------------
+
+_EXC_COMMON = """
+            class SlateError(Exception):
+                def with_context(self, **kw):
+                    return self
+
+            class Rejected(SlateError):
+                pass
+"""
+
+
+def test_exception_context_positive(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("queue full")
+        """,
+    })
+    res = _lint(root, "exception-context")
+    assert len(res.findings) == 1
+    assert "Rejected" in res.findings[0].message
+
+
+def test_exception_context_negative(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("queue full").with_context(routine=routine)
+
+            def passthrough(e):
+                raise e                      # re-raise keeps its context
+
+            def config_error():
+                raise ValueError("not a SlateError: out of scope")
+
+            class Svc:
+                def __init__(self, mesh):
+                    # construction-time config errors carry no request
+                    raise Rejected(f"bad mesh {mesh}")
+        """,
+    })
+    assert _lint(root, "exception-context").ok
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline semantics
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.q = []  # guarded by: _cond
+
+                def racy(self):
+                    # deliberate: depth probe tolerates a torn read
+                    return len(self.q)  # slate-lint: disable=lock-discipline
+        """,
+    })
+    res = _lint(root, "lock-discipline")
+    assert res.ok
+    assert res.suppressed == 1
+
+
+def test_suppression_is_per_rule(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self.q = []  # guarded by: _cond
+
+                def racy(self):
+                    return len(self.q)  # slate-lint: disable=env-drift
+        """,
+    })
+    res = _lint(root, "lock-discipline")
+    assert len(res.findings) == 1  # wrong rule name: not silenced
+
+
+def test_baseline_accepts_legacy_and_catches_new(tmp_path):
+    files = {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("legacy")
+        """,
+    }
+    root = _mini_repo(tmp_path, files)
+    first = _lint(root, "exception-context")
+    assert len(first.findings) == 1
+
+    bl_path = os.path.join(root, analysis.BASELINE_NAME)
+    analysis.write_baseline(bl_path, first)
+    baseline = analysis.load_baseline(bl_path)
+    again = analysis.run(root, rules=["exception-context"],
+                         baseline=baseline)
+    assert again.ok and again.baselined == 1
+
+    # a NEW violation still fails even with the old baseline loaded
+    with open(os.path.join(root, "slate_tpu/serve/svc.py"), "a") as f:
+        f.write("\n\ndef submit2(routine):\n"
+                "    raise Rejected('new one')\n")
+    newrun = analysis.run(root, rules=["exception-context"],
+                          baseline=baseline)
+    assert len(newrun.findings) == 1 and newrun.baselined == 1
+    assert "new one" in open(
+        os.path.join(root, "slate_tpu/serve/svc.py")).read()
+
+
+def test_baseline_does_not_grandfather_identical_duplicates(tmp_path):
+    # fingerprints carry an occurrence ordinal: baselining one
+    # copy-paste instance must not silently accept a second identical
+    # line added later in the same file
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("dup")
+        """,
+    })
+    first = _lint(root, "exception-context")
+    bl_path = os.path.join(root, analysis.BASELINE_NAME)
+    analysis.write_baseline(bl_path, first)
+    with open(os.path.join(root, "slate_tpu/serve/svc.py"), "a") as f:
+        f.write("\n\ndef submit2(routine):\n"
+                "    raise Rejected(\"dup\")\n")  # byte-identical line
+    again = analysis.run(root, rules=["exception-context"],
+                         baseline=analysis.load_baseline(bl_path))
+    assert again.baselined == 1
+    assert len(again.findings) == 1  # the clone is NEW, not baselined
+
+
+def test_baseline_fingerprint_survives_line_shift(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("legacy")
+        """,
+    })
+    first = _lint(root, "exception-context")
+    bl_path = os.path.join(root, analysis.BASELINE_NAME)
+    analysis.write_baseline(bl_path, first)
+    # shift the file down: the fingerprint is line-number free
+    p = os.path.join(root, "slate_tpu/serve/svc.py")
+    src = open(p).read()
+    with open(p, "w") as f:
+        f.write("# a new comment line\n# another\n" + src)
+    again = analysis.run(root, rules=["exception-context"],
+                         baseline=analysis.load_baseline(bl_path))
+    assert again.ok and again.baselined == 1
+
+
+# ---------------------------------------------------------------------------
+# output formats + engine behavior
+# ---------------------------------------------------------------------------
+
+
+def test_json_schema(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/serve/svc.py": _EXC_COMMON + """
+            def submit(routine):
+                raise Rejected("oops")
+        """,
+    })
+    res = _lint(root, "exception-context")
+    doc = res.to_json()
+    assert doc["version"] == 1 and doc["ok"] is False
+    assert doc["counts"] == {"new": 1, "baselined": 0, "suppressed": 0}
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "path", "line", "col", "message",
+                      "fingerprint"}
+    assert f["rule"] == "exception-context"
+    assert f["path"] == "slate_tpu/serve/svc.py"
+    assert isinstance(f["line"], int) and f["line"] > 0
+    assert len(f["fingerprint"]) == 16
+    json.dumps(doc)  # round-trippable
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/broken.py": "def oops(:\n",
+        "slate_tpu/fine.py": "x = 1\n",
+    })
+    res = analysis.run(root)
+    assert any(f.rule == "parse-error" for f in res.findings)
+
+
+def test_cli_list_and_clean_exit():
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "slate_lint.py"),
+         "--list"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0
+    for name in ("metric-drift", "lock-discipline", "env-drift"):
+        assert name in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# the self-run: the shipped tree is clean, fast, with an empty baseline
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_tree_is_clean():
+    baseline = analysis.load_baseline(
+        os.path.join(REPO_ROOT, analysis.BASELINE_NAME)
+    )
+    assert baseline == set(), (
+        "the shipped baseline must stay empty: fix or suppress new "
+        "findings instead of grandfathering them"
+    )
+    res = analysis.run(REPO_ROOT, baseline=baseline)
+    assert res.files > 100  # the full tree was actually discovered
+    assert res.ok, "\n" + res.render()
+
+
+def test_shipped_tree_lint_runtime_budget():
+    res = analysis.run(REPO_ROOT)
+    # the run_tests.py --lint budget is 15 s on the 2-core CI box; the
+    # suite asserts a looser bound so a slow box doesn't flake tier-1
+    assert res.duration_s < 30.0, f"lint took {res.duration_s:.1f}s"

@@ -4,20 +4,14 @@
 Reads a ``SLATE_TPU_METRICS`` dump from a chaos run (faults armed via
 ``SLATE_TPU_FAULTS`` or ``aux.faults``) and joins every
 ``faults.injected.<site>`` counter against the serve hardening
-counters that should have absorbed it:
-
-    compile        -> serve.fallbacks, serve.retries
-    execute        -> serve.retries, serve.fallbacks, serve.breaker_open
-    result_corrupt -> serve.corrupt_result, serve.fallbacks
-    latency        -> serve.deadline_miss_late
-    worker_death   -> serve.worker_restarts
-    info_nonzero   -> serve.numerical_errors
-    artifact_corrupt   -> serve.artifact_corrupt
-    artifact_stale     -> serve.artifact_stale
-    artifact_load_fail -> serve.artifact_load_fail
-    factor_stale       -> serve.factor_cache.stale
-    tenant_flood       -> serve.shed, serve.rejected_quota,
-                          serve.rejected_share, serve.rejected
+counters that should have absorbed it.  The site -> recovery-counter
+map is DERIVED from ``slate_tpu/aux/faults.py``'s ``SITE_SPECS``
+registry — the single source of truth, where each site's rationale
+comment lives (``python tools/slate_lint.py --rules fault-site``
+checks it against the emitters).  The registry file is AST-parsed,
+not imported, so this tool stays stdlib-only and keeps working when
+the library itself is broken — which is exactly when a chaos triage
+tool gets reached for.
 
 For the artifact sites the detection counter IS the containment
 signal: an injected corruption that the verification ladder counted
@@ -47,45 +41,71 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import ast
 import json
+import os
 import sys
 from typing import Dict, List
 
-#: site -> counter families whose sum is that site's recovery signal
-RECOVERY = {
-    "compile": ("serve.fallbacks", "serve.retries"),
-    "execute": ("serve.retries", "serve.fallbacks", "serve.breaker_open"),
-    # the per-item direct re-solve of a corrupt batch bumps
-    # serve.fallbacks, so it is part of this site's signal (and of the
-    # shared-attribution overlap with compile/execute)
-    "result_corrupt": ("serve.corrupt_result", "serve.fallbacks"),
-    # _miss_late() bumps both the split counter and the total; summing
-    # them would double-count, so only the split counter is joined
-    "latency": ("serve.deadline_miss_late",),
-    "worker_death": ("serve.worker_restarts",),
-    "info_nonzero": ("serve.numerical_errors",),
-    # detection == containment for the artifact load ladder: a counted
-    # rung means the bad artifact was recompiled, not served
-    "artifact_corrupt": ("serve.artifact_corrupt",),
-    "artifact_stale": ("serve.artifact_stale",),
-    "artifact_load_fail": ("serve.artifact_load_fail",),
-    # detection == containment for the factor-cache hit path too: a
-    # counted stale means the residual validation caught the mismatched
-    # factor and the item was re-solved direct, never delivered wrong
-    "factor_stale": ("serve.factor_cache.stale",),
-    # a synthetic tenant burst is absorbed when the admission plane
-    # refused (some of) it: overload shedding, token-bucket/queue-share
-    # quota rejections, or plain bounded-queue backpressure — a flood
-    # with NO refusal signal means fairness never engaged and the
-    # burst rode straight into the shared queue
-    "tenant_flood": (
-        "serve.shed", "serve.rejected_quota", "serve.rejected_share",
-        "serve.rejected",
-    ),
-}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FAULTS_PY = os.path.join(_REPO_ROOT, "slate_tpu", "aux", "faults.py")
 
-#: sites whose zero-recovery outcome is legitimate (see module doc)
-INFORMATIONAL = {"latency"}
+
+def _load_registry(path: str = _FAULTS_PY) -> Dict[str, dict]:
+    """AST-parse the ``SiteSpec(...)`` entries out of aux/faults.py
+    using the ONE shared extractor
+    (``slate_tpu/analysis/rules_faults.parse_site_specs`` — the same
+    code the ``fault-site`` lint rule runs).  The analysis package is
+    loaded by file path, never through ``slate_tpu/__init__``, so this
+    tool stays stdlib-only and library-import-free."""
+    import importlib.util
+
+    name = "slate_lint_analysis"
+    mod = sys.modules.get(name)
+    if mod is None:
+        pkg_dir = os.path.join(_REPO_ROOT, "slate_tpu", "analysis")
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(pkg_dir, "__init__.py"),
+            submodule_search_locations=[pkg_dir],
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+    specs = mod.rules_faults.parse_site_specs(tree)
+    if not specs:
+        raise RuntimeError(f"no SiteSpec registry found in {path}")
+    return {
+        s.name: {"recovery": s.recovery, "informational": s.informational}
+        for s in specs.values()
+    }
+
+
+# site -> counter families whose sum is that site's recovery signal,
+# and the sites whose zero-recovery outcome is legitimate.  Both are
+# DERIVED from aux/faults.py's SITE_SPECS registry — the single source
+# of truth shared with arm()'s site validation and the `fault-site`
+# lint rule — so a site added there is automatically joined here.
+# Loaded LAZILY (module __getattr__ / first analyze()): `--help` and a
+# bad-usage error must not depend on the registry file parsing.
+_REGISTRY_CACHE: Dict[str, dict] = {}
+
+
+def _registry() -> Dict[str, dict]:
+    if not _REGISTRY_CACHE:
+        _REGISTRY_CACHE.update(_load_registry())
+    return _REGISTRY_CACHE
+
+
+def __getattr__(name: str):
+    # PEP 562: keep RECOVERY/INFORMATIONAL as importable module attrs
+    # (tests assert parity against the library registry) without an
+    # import-time parse
+    if name == "RECOVERY":
+        return {n: s["recovery"] for n, s in _registry().items()}
+    if name == "INFORMATIONAL":
+        return {n for n, s in _registry().items() if s["informational"]}
+    raise AttributeError(name)
 
 INJECT_PREFIX = "faults.injected."
 
@@ -107,6 +127,9 @@ def analyze(path: str) -> List[dict]:
     """One row per injected site: injected count, summed recovery
     signal, the counters it came from, and the flag."""
     counters = _counters(path)
+    registry = _registry()
+    recovery = {n: s["recovery"] for n, s in registry.items()}
+    informational = {n for n, s in registry.items() if s["informational"]}
     injected_sites = {
         name[len(INJECT_PREFIX):]
         for name, v in counters.items()
@@ -115,7 +138,7 @@ def analyze(path: str) -> List[dict]:
     rows = []
     for site in sorted(injected_sites):
         injected = counters[INJECT_PREFIX + site]
-        families = RECOVERY.get(site, ())
+        families = recovery.get(site, ())
         signals = {f: counters[f] for f in families if counters.get(f, 0) > 0}
         recovered = sum(signals.values())
         # every nonzero signal also claimable by another injected site
@@ -123,7 +146,7 @@ def analyze(path: str) -> List[dict]:
         sharers = sorted(
             o for o in injected_sites
             if o != site and signals
-            and all(f in RECOVERY.get(o, ()) for f in signals)
+            and all(f in recovery.get(o, ()) for f in signals)
         )
         rows.append({
             "site": site,
@@ -131,7 +154,7 @@ def analyze(path: str) -> List[dict]:
             "recovered": int(recovered),
             "signals": signals,
             "shared_with": sharers,
-            "flagged": recovered <= 0 and site not in INFORMATIONAL,
+            "flagged": recovered <= 0 and site not in informational,
         })
     return rows
 
@@ -141,6 +164,12 @@ def main(argv=None) -> int:
     ap.add_argument("jsonl", help="metrics JSONL from a chaos run")
     args = ap.parse_args(argv)
 
+    try:
+        _registry()
+    except (OSError, SyntaxError, RuntimeError) as e:
+        print(f"chaos_report: cannot derive the site registry from "
+              f"{_FAULTS_PY}: {e}", file=sys.stderr)
+        return 2
     rows = analyze(args.jsonl)
     if not rows:
         print("no faults.injected.* counters in this JSONL (faults off?)")

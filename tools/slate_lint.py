@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""slate-lint CLI: run the AST invariant checker over the tree.
+
+    python tools/slate_lint.py                 # full tree, text report
+    python tools/slate_lint.py --json          # machine-readable
+    python tools/slate_lint.py --rules env-drift,metric-drift
+    python tools/slate_lint.py --list          # rule table
+    python tools/slate_lint.py --write-baseline  # accept current findings
+
+Exit status: 0 when no *new* findings (suppressed and baselined ones
+never fail the run), 1 otherwise.  ``run_tests.py --lint`` wraps this
+with a runtime budget for CI.
+
+The checker is ``slate_tpu/analysis/`` — stdlib ``ast`` only, no jax
+import, so it runs in milliseconds-per-file on any box.  See the
+README "Static analysis" section for the rule table, the suppression
+workflow (``# slate-lint: disable=<rule>``), and how to add a rule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Load ``slate_tpu/analysis`` WITHOUT executing ``slate_tpu``'s
+    package ``__init__`` (which imports jax and the full library): the
+    linter must keep working — and keep reporting parse errors as
+    findings — when the tree it checks is import-broken."""
+    name = "slate_lint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_ROOT, "slate_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+analysis = _load_analysis()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--root", default=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        help="repo root to lint (default: this checkout)",
+    )
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the JSON report instead of text")
+    ap.add_argument("--list", action="store_true", dest="list_rules",
+                    help="list registered rules and exit")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/"
+                         f"{analysis.BASELINE_NAME})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the run's findings as the new baseline")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(analysis.RULES):
+            r = analysis.RULES[name]
+            print(f"{name:18} {r.summary}")
+        return 0
+
+    if args.write_baseline and args.rules:
+        print("refusing --write-baseline with --rules: a partial run "
+              "would overwrite (and truncate) the other rules' accepted "
+              "fingerprints", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in analysis.RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}; "
+                  f"known: {', '.join(sorted(analysis.RULES))}",
+                  file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or os.path.join(
+        args.root, analysis.BASELINE_NAME
+    )
+    baseline = analysis.load_baseline(baseline_path)
+    result = analysis.run(args.root, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        analysis.write_baseline(baseline_path, result)
+        print(f"baseline written: {baseline_path} "
+              f"({len(result.all_with_fingerprints)} fingerprint(s))")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        print(result.render())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
