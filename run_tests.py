@@ -1142,6 +1142,273 @@ def perf_gate() -> int:
         )
 
 
+# Four-phase SDC drill for the --integrity gate.  SLATE_TPU_INTEGRITY
+# ("full,abft") is read at import — the production activation path —
+# and asserted; each phase then tunes an explicit policy (short
+# quarantine cooldowns, hedging on/off) because the drill must finish
+# in seconds.  Faults are armed POST-warmup (an sdc during warmup
+# builds would be injected into discarded dummy dispatches, inflating
+# the injected count the report joins against detections).
+_INTEGRITY_DRIVER = """
+import time
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import SlateError
+from slate_tpu.integrity import IntegrityPolicy, from_options
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import FactorCache
+from slate_tpu.serve.service import SolverService
+
+p_env = from_options(None)
+assert p_env is not None and p_env.mode == "full" and p_env.abft, (
+    "SLATE_TPU_INTEGRITY must arm the plane")
+
+n1, n2 = 12, 24
+
+def prob(rt, n, seed):
+    r = np.random.default_rng(seed)
+    A = r.standard_normal((n, n))
+    A = A @ A.T + n * np.eye(n) if rt == "posv" else A + n * np.eye(n)
+    return rt, A, r.standard_normal((n, 2))
+
+def run(svc, probs):
+    futs = [svc.submit(rt, A, B) for rt, A, B in probs]
+    ok = typed = wrong = 0
+    for (rt, A, B), f in zip(probs, futs):
+        try:
+            X = f.result(timeout=300)
+        except SlateError:
+            typed += 1
+            continue
+        scale = np.abs(A).max() * np.abs(X).max() + np.abs(B).max()
+        if np.abs(A @ X - B).max() <= 1e-6 * scale:
+            ok += 1
+        else:
+            wrong += 1
+    return ok, typed, wrong
+
+def svc_for(pol, **kw):
+    return SolverService(
+        cache=ExecutableCache(manifest_path=None), batch_max=4,
+        batch_window_s=0.002, dim_floor=16, nrhs_floor=4, replicas=2,
+        integrity=pol, **kw)
+
+# -- phase A: ABFT-certified stream under sdc_solve; hedged recovery --
+pol = IntegrityPolicy(mode="full", abft=True, hedge_factor=0.0,
+                      quarantine_cooldown_s=0.25)
+svc = svc_for(pol)
+for rt, n in (("gesv", n1), ("posv", n2)):
+    k = bk.bucket_for(rt, n, n, 2, np.float64, floor=16, nrhs_floor=4,
+                      tag="abft")
+    svc.cache.ensure_manifest(k, (1, 4))
+svc.warmup()
+faults.configure("sdc_solve:every=4,seed=2")
+faults.on()
+probs = [prob("gesv", n1, i) for i in range(24)] + [
+    prob("posv", n2, 100 + i) for i in range(12)]
+ok, typed, wrong = run(svc, probs)
+faults.reset()
+assert wrong == 0, f"phase A: {wrong} silent wrong answers delivered"
+assert ok + typed == len(probs) and ok >= 30, (ok, typed)
+c = metrics.counters()
+assert c.get("serve.integrity.fail", 0) >= 1, c
+assert c.get("serve.integrity.recovered", 0) >= 1, c
+assert c.get("serve.hedge.sent", 0) >= 1, c
+assert c.get("serve.hedge.won", 0) >= 1, c
+nA = len(probs)
+
+# -- phase B: every dispatch corrupted -> quarantine, then probe back --
+faults.configure("sdc_solve:every=1")
+faults.on()
+okB, typedB, wrongB = run(svc, [prob("gesv", n1, 500 + i)
+                                for i in range(8)])
+faults.reset()
+assert wrongB == 0 and okB + typedB == 8, (okB, typedB, wrongB)
+assert metrics.counters().get("serve.integrity.quarantined", 0) >= 1, (
+    "poisoned replicas never quarantined")
+time.sleep(0.3)  # past the quarantine cooldown: next delivery probes
+okP, typedP, wrongP = run(svc, [prob("gesv", n1, 600 + i)
+                                for i in range(6)])
+assert wrongP == 0 and okP == 6, (okP, typedP, wrongP)
+h = svc.health()
+assert h["integrity"] is not None and not h["integrity"]["quarantined"], (
+    h["integrity"])
+assert metrics.counters().get("serve.integrity.unquarantined", 0) >= 1
+svc.stop()
+
+# -- phase C: sdc_factor through the factor-cache miss path -----------
+pol2 = IntegrityPolicy(mode="full", hedge_factor=0.0,
+                       quarantine_cooldown_s=0.25)
+svc2 = svc_for(pol2, factor_cache=FactorCache())
+faults.configure("sdc_factor:every=3,seed=1")
+faults.on()
+probsC = [prob("gesv", n1, 700 + i) for i in range(10)] + [
+    prob("posv", n2, 800 + i) for i in range(4)]
+okC, typedC, wrongC = run(svc2, probsC)
+# repeated-A hits against possibly-poisoned cached factors: the
+# residual fence must catch them (counted stale), never a wrong X
+rt0, A0, _ = prob("gesv", n1, 700)
+okR, typedR, wrongR = run(svc2, [
+    (rt0, A0, np.random.default_rng(900 + i).standard_normal((n1, 2)))
+    for i in range(4)])
+faults.reset()
+assert wrongC == 0 and wrongR == 0, (wrongC, wrongR)
+assert okC + typedC == len(probsC) and okR + typedR == 4
+svc2.stop()
+nC = len(probsC) + 4
+
+# -- phase D: stragglers hedge off a deliberately-slowed lane ---------
+pol3 = IntegrityPolicy(mode="full", hedge_factor=0.5,
+                       hedge_min_age_s=0.005)
+svc3 = svc_for(pol3)
+# nrhs=5 -> rhs bucket 8: a FRESH bucket label, so the p99 history the
+# straggler trigger reads comes from phase D's own warmed clean
+# traffic (phase C's unwarmed first dispatch put its compile wall into
+# the 16x16x4 label's histogram, which would stretch p99 to seconds)
+def probD(seed):
+    r = np.random.default_rng(seed)
+    return ("gesv", r.standard_normal((n1, n1)) + n1 * np.eye(n1),
+            r.standard_normal((n1, 5)))
+kD = bk.bucket_for("gesv", n1, n1, 5, np.float64, floor=16, nrhs_floor=4)
+svc3.cache.ensure_manifest(kD, (1, 4))
+svc3.warmup()
+# clean traffic first: the straggler trigger compares queued age to
+# the bucket's OWN p99 history
+okW, _, _ = run(svc3, [probD(950 + i) for i in range(6)])
+assert okW == 6
+sent0 = metrics.counters().get("serve.hedge.sent", 0)
+won0 = metrics.counters().get("serve.hedge.won", 0)
+wasted0 = metrics.counters().get("serve.hedge.wasted", 0)
+faults.configure("latency:every=2,ms=150")  # every other dispatch slow
+faults.on()
+okD, typedD, wrongD = run(svc3, [probD(1000 + i) for i in range(32)])
+faults.reset()
+assert wrongD == 0 and okD == 32, (okD, typedD, wrongD)
+# drain before reading: the losing twins of already-resolved futures
+# are still queued/in flight, and their wasted/won accounting lands at
+# their own completion (stop(drain=True) is the satellite doing real
+# work here)
+svc3.stop(drain=True, drain_timeout=60.0)
+c = metrics.counters()
+sent1 = c.get("serve.hedge.sent", 0)
+assert sent1 > sent0, "no straggler was hedged off the slowed lane"
+assert (c.get("serve.hedge.won", 0) - won0
+        + c.get("serve.hedge.wasted", 0) - wasted0) >= 1, (
+    "hedged pairs completed without won/wasted accounting")
+total = nA + 8 + 6 + nC + 6 + 32
+print(f"integrity driver: {total} requests over 4 phases, 0 silent "
+      f"wrong answers; fail={int(c.get('serve.integrity.fail', 0))} "
+      f"recovered={int(c.get('serve.integrity.recovered', 0))} "
+      f"hedge sent={int(c.get('serve.hedge.sent', 0))} "
+      f"won={int(c.get('serve.hedge.won', 0))} "
+      f"quarantined={int(c.get('serve.integrity.quarantined', 0))} "
+      f"unquarantined={int(c.get('serve.integrity.unquarantined', 0))}")
+"""
+
+# Negative leg: the SAME corruption with the plane disabled must
+# deliver wrong answers (proving the injection is real) and the report
+# over its JSONL must exit NONZERO (proving an escape is flagged).
+_INTEGRITY_ESCAPE_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+
+svc = SolverService(cache=ExecutableCache(manifest_path=None),
+                    batch_max=4, batch_window_s=0.002, dim_floor=16,
+                    nrhs_floor=4, integrity=False)
+assert svc._integrity is None
+n = 12
+rng = np.random.default_rng(0)
+svc.submit("gesv", rng.standard_normal((n, n)) + n * np.eye(n),
+           rng.standard_normal((n, 2))).result(timeout=300)  # warm
+faults.configure("sdc_solve:every=2,seed=0")
+faults.on()
+wrong = 0
+for i in range(8):
+    r = np.random.default_rng(10 + i)
+    A = r.standard_normal((n, n)) + n * np.eye(n)
+    B = r.standard_normal((n, 2))
+    X = svc.submit("gesv", A, B).result(timeout=300)
+    scale = np.abs(A).max() * np.abs(X).max() + np.abs(B).max()
+    if np.abs(A @ X - B).max() > 1e-6 * scale:
+        wrong += 1
+faults.reset()
+svc.stop()
+assert wrong >= 1, "undefended stream delivered no wrong X (site dead?)"
+print(f"escape driver: {wrong} silent wrong answers delivered "
+      "(integrity off, as designed)")
+"""
+
+
+def integrity_gate() -> int:
+    """Integrity gate, three legs: (1) the integrity suite (ABFT
+    checks, certification, quarantine, hedging, drain/restore-stuck
+    satellites); (2) the four-phase SDC drill — sdc_factor + sdc_solve
+    armed over a warmed mixed gesv/posv stream with zero silent wrong
+    answers, quarantine engage/recover, hedges sent and won — judged
+    by tools/integrity_report.py (exit 0); (3) the escape proof: the
+    same corruption with the plane OFF delivers wrong answers and the
+    report exits NONZERO on that JSONL."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_integrity.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_integrity_") as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for var in ("SLATE_TPU_FAULTS", "SLATE_TPU_FACTOR_CACHE",
+                    "SLATE_TPU_TENANTS", "SLATE_TPU_ADAPTIVE",
+                    "SLATE_TPU_INTEGRITY", "SLATE_TPU_WARMUP",
+                    "SLATE_TPU_ARTIFACTS"):
+            env.pop(var, None)
+        jsonl = os.path.join(td, "integrity.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _INTEGRITY_DRIVER],
+            env=dict(env, SLATE_TPU_METRICS=jsonl,
+                     SLATE_TPU_INTEGRITY="full,abft"),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "integrity_report.py"),
+             jsonl],
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        # escape leg: plane off, same sites armed — the report MUST
+        # flag the run (a verdict tool that cannot fail proves nothing)
+        esc = os.path.join(td, "escape.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _INTEGRITY_ESCAPE_DRIVER],
+            env=dict(env, SLATE_TPU_METRICS=esc), cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "integrity_report.py"),
+             esc],
+            cwd=here,
+        )
+        if rc == 0:
+            print("integrity gate: report failed to flag an undefended "
+                  "SDC escape")
+            return 1
+    return 0
+
+
 # the full-tree slate-lint run must stay cheap enough to gate every PR
 # on the 2-core CI box; blowing this budget is itself a gate failure
 LINT_BUDGET_S = 15.0
@@ -1235,6 +1502,13 @@ def main() -> int:
                          "serve stream classified by roofline_report "
                          "+ a quick bench floored against "
                          "BENCH_FLOOR_CPU.json")
+    ap.add_argument("--integrity", action="store_true",
+                    help="run the integrity suite + the four-phase SDC "
+                         "drill (sdc_factor/sdc_solve over a warmed "
+                         "mixed stream: zero silent wrong answers, "
+                         "quarantine engage/recover, hedges win) "
+                         "judged by tools/integrity_report.py, + the "
+                         "escape proof (plane off -> report nonzero)")
     ap.add_argument("--lint", action="store_true",
                     help="run the slate-lint suite + a budgeted "
                          "full-tree static-analysis pass (nonzero on "
@@ -1268,6 +1542,8 @@ def main() -> int:
         return adaptive_gate()
     if args.perf:
         return perf_gate()
+    if args.integrity:
+        return integrity_gate()
     if args.lint:
         return lint_gate()
 

@@ -101,6 +101,10 @@ from . import refine
 # serving layer (lazy package: costs nothing until the first request)
 from . import serve
 
+# silent-data-corruption defense (ABFT certification, quarantine,
+# hedged re-execution — enforcement threads through serve/)
+from . import integrity
+
 __version__ = "0.1.0"
 
 __all__ = [name for name in dir() if not name.startswith("_")]

@@ -40,6 +40,23 @@ Sites (:data:`SITES`) and where they are checked:
                        hit path's residual validation must catch it,
                        bump ``serve.factor_cache.stale``, and re-solve
                        direct (``serve.service`` solve-phase dispatch)
+    ``sdc_factor``     silent data corruption in a freshly computed
+                       factorization: one element of the factor is
+                       perturbed to a FINITE wrong value
+                       (``faults.perturb``) before the solve and the
+                       cache put (``serve.service._factor_direct``) —
+                       the delivery certificate (integrity/) must
+                       catch the wrong X, and later hits on the
+                       poisoned cached factor must fall to the
+                       residual fence (``serve.factor_cache.stale``)
+    ``sdc_solve``      silent data corruption in a delivered solution:
+                       item 0 of a dispatched X is perturbed to a
+                       FINITE wrong value after execution
+                       (``serve.cache.run`` / gesv+posv
+                       ``direct_call``) — models a device returning
+                       plausible garbage; only delivery certification
+                       (``Option.ServeIntegrity``) stands between it
+                       and the client
     ``tenant_flood``   a synthetic burst of ``burst=`` low-priority
                        requests from tenant ``"flood"`` cloning the
                        triggering request's operands is injected at
@@ -151,6 +168,19 @@ SITE_SPECS: Tuple[SiteSpec, ...] = (
     # counted stale means the residual validation caught the mismatched
     # factor and the item was re-solved direct, never delivered wrong
     SiteSpec("factor_stale", recovery=("serve.factor_cache.stale",)),
+    # detection == containment for the integrity plane: a counted
+    # certificate failure means the wrong X was re-executed instead of
+    # delivered (serve.integrity.recovered / a typed error — never a
+    # silent wrong answer); hits on a factor poisoned by sdc_factor
+    # additionally land on the factor-cache residual fence (stale)
+    SiteSpec("sdc_factor", recovery=(
+        "serve.integrity.fail", "serve.integrity.recovered",
+        "serve.factor_cache.stale",
+    )),
+    SiteSpec("sdc_solve", recovery=(
+        "serve.integrity.fail", "serve.integrity.recovered",
+        "serve.factor_cache.stale",
+    )),
     # a synthetic tenant burst is absorbed when the admission plane
     # refused (some of) it: overload shedding, token-bucket/queue-share
     # quota rejections, or plain bounded-queue backpressure — a flood

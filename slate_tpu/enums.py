@@ -359,6 +359,8 @@ class Option(enum.Enum):
     ServeTenantQuota = "serve_tenant_quota"  # tenant spec (admission grammar)
     ServeAdaptiveWindow = "serve_adaptive_window"  # AIMD batch-window control
     ServeLatencyBudget = "serve_latency_budget"  # p99 budget, s (0 = off)
+    ServeIntegrity = "serve_integrity"  # SDC certification policy (integrity/)
+    ServeDrainTimeout = "serve_drain_timeout"  # stop(drain=True) bound, s
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

@@ -1,0 +1,53 @@
+"""slate_tpu.integrity — silent-data-corruption defense for the
+serving tier (ISSUE 14).
+
+Crashes, NaNs and overload are loud; a flaky chip that returns a
+finite-but-wrong X is silent — the breaker never opens, the
+finiteness fence passes, the client gets garbage.  This package is
+the defense plane the service threads through dispatch:
+
+* ``abft`` — Huang & Abraham-style algorithm-based fault tolerance:
+  checksum relations verified in-trace against the factors
+  (post-factor) and the solution (post-trsm) at O(n^2) extra work,
+  plus the cheap host-side delivery certificate and the
+  ``phase_flops``-style accounting mirror of the overhead.
+* ``policy`` — the ``SLATE_TPU_INTEGRITY`` / ``Option.ServeIntegrity``
+  certification policy (``off | sample=<p> | full``, ``,abft`` for
+  checksummed bucket cores) and the per-replica
+  :class:`~slate_tpu.integrity.policy.IntegrityScore` quarantine state
+  machine (certificate-failure EWMA, breaker-shaped probe/recovery —
+  distinct from the breaker, which only ever sees exceptions).
+
+The enforcement lives in ``serve/service.py``: a failed certificate
+never reaches the client — the request re-executes (hedged to a
+different replica when one exists, Dean & Barroso's tail-at-scale
+shape), quarantined lanes shed new admissions until a probe passes,
+and every event is counted (``serve.integrity.*``, ``serve.hedge.*``,
+``tools/integrity_report.py``).
+"""
+
+from __future__ import annotations
+
+from .abft import (  # noqa: F401
+    ABFT_BAD,
+    ABFT_TAG,
+    abft_flops,
+    checksum_certificate,
+    encode,
+    encode_rhs,
+    overhead_ratio,
+)
+from .policy import (  # noqa: F401
+    INTEGRITY_ENV,
+    IntegrityPolicy,
+    IntegrityScore,
+    from_options,
+    parse_spec,
+)
+
+__all__ = [
+    "ABFT_BAD", "ABFT_TAG", "abft_flops", "checksum_certificate",
+    "encode", "encode_rhs", "overhead_ratio",
+    "INTEGRITY_ENV", "IntegrityPolicy", "IntegrityScore",
+    "from_options", "parse_spec",
+]

@@ -1,0 +1,280 @@
+"""Algorithm-based fault tolerance (ABFT) for the served factorizations.
+
+Huang & Abraham (IEEE Trans. Computers 1984, PAPERS.md) encode a
+matrix with checksum rows/columns so that corruption introduced by a
+faulty processing element is *detectable from an invariant* in O(n^2)
+extra work, instead of O(n^3) recomputation.  The canonical encoding
+borders the operand::
+
+    A  ->  [[A,      A e],        (e = the ones vector; the bordered
+            [e^T A,  e^T A e]]     row/column carry the running sums)
+
+:func:`encode` / :func:`encode_rhs` build exactly that reference form
+(the unit tests prove the checksum identities on it).  **Design delta
+this repo takes**: the bordered matrix of an invertible A is *exactly
+singular* (its last row is the sum of the others), so factoring the
+bordered operand through partial pivoting would hinge the certificate
+on a rounding-noise pivot.  The serve cores therefore keep the operand
+unchanged — the bucket lattice, pads and BucketKey are untouched — and
+verify the *checksum relations* the encoding exists for, in-trace,
+against the factors the drivers already return:
+
+* **post-factor** (LU):  ``L (U e) == P (A e)``  — every element of L
+  and U participates in the product, so corruption anywhere in the
+  factor flips the relation; two triangular matvecs, O(n^2).
+  For Cholesky: ``L (L^H e) == A_sym e``.
+* **post-trsm**: ``(e^T A) X == e^T B`` — the column-checksum row
+  applied to the delivered solution; corruption in X (or in the trsm
+  sweeps that produced it) breaks the compressed residual, O(n nrhs)
+  after the O(n^2) ``e^T A``.
+
+Both relations are fenced at ``sqrt(eps)`` against an |L||U|e-style
+magnitude bound (the componentwise scale, so pivot growth does not
+false-positive), and their verdict is folded into the executable's
+``info`` output as :data:`ABFT_BAD` — a per-item flag the service's
+certification reads for free (``serve/service.py``).
+
+An ABFT-built bucket is keyed by ``BucketKey.tag == ABFT_TAG`` (the
+existing options-fingerprint field, so manifests, warmup and artifact
+fingerprints distinguish checksummed executables without a schema
+change).  :func:`abft_flops` is the pure accounting mirror of the
+extra work, the ``phase_flops`` counterpart behind the <= 15%-overhead
+acceptance bound (:func:`overhead_ratio`).
+
+Host-side, :func:`checksum_certificate` runs the post-trsm relation
+over the *true* (uncropped-request) operands at delivery — the cheap
+certificate for ABFT buckets, covering the device->host leg the
+in-trace flag cannot see (``faults.perturb`` injects exactly there).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: BucketKey.tag of executables whose cores carry the traced checksum
+#: checks (serve/cache._build_core routes on it)
+ABFT_TAG = "abft"
+
+#: ``info`` value of a batch item whose checksum relation failed — the
+#: in-trace per-item ``bad`` flag.  Negative so it can never collide
+#: with the drivers' nonzero-info contract (singular U / non-SPD are
+#: strictly positive) and costs the service one sign check to read.
+ABFT_BAD = -1
+
+
+# ---------------------------------------------------------------------------
+# reference encoding (Huang & Abraham's bordered operand)
+# ---------------------------------------------------------------------------
+
+
+def encode(A: np.ndarray) -> np.ndarray:
+    """The reference bordered encoding ``[[A, A e], [e^T A, e^T A e]]``
+    — an (n+1) x (n+1) array whose last column is the row sums and
+    last row the column sums of A.  Exact-singularity is the reason the
+    serve cores verify the relations instead of factoring this form
+    (module docstring); the unit tests prove the identities on it."""
+    A = np.asarray(A)
+    n = A.shape[0]
+    e = np.ones((n,), dtype=A.dtype)
+    c = A @ e
+    w = e @ A
+    out = np.zeros((n + 1, n + 1), dtype=A.dtype)
+    out[:n, :n] = A
+    out[:n, n] = c
+    out[n, :n] = w
+    out[n, n] = c.sum()
+    return out
+
+
+def encode_rhs(B: np.ndarray) -> np.ndarray:
+    """The matching RHS encoding: B with its column sums appended as a
+    checksum row ((n+1) x nrhs)."""
+    B = np.asarray(B)
+    if B.ndim == 1:
+        B = B[:, None]
+    return np.vstack([B, B.sum(axis=0, keepdims=True)])
+
+
+# ---------------------------------------------------------------------------
+# accounting mirror (the phase_flops counterpart)
+# ---------------------------------------------------------------------------
+
+
+def abft_flops(n: int, nrhs: int) -> float:
+    """Model FLOPs of the in-trace checks per item: the two checksum
+    vectors A e / e^T A (2n^2 each), the two triangular matvecs of the
+    factor relation plus their |L||U|e magnitude bound (~4n^2), and
+    the O(n nrhs) compressed solve residual with its scale."""
+    n, r = float(n), float(nrhs)
+    return 8.0 * n * n + 4.0 * n * r
+
+
+def overhead_ratio(key) -> float:
+    """ABFT overhead as a fraction of the bucket's model FLOPs — the
+    measured-by-mirror acceptance bound (<= 0.15 at n=2048).  ``key``
+    is a serve ``BucketKey``."""
+    from ..serve.buckets import phase_flops
+
+    return abft_flops(key.n, key.nrhs) / max(phase_flops(key), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# host-side certificate (delivery-time, true-request operands)
+# ---------------------------------------------------------------------------
+
+
+def checksum_certificate(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> bool:
+    """The post-trsm checksum relation over the delivered solve:
+    ``max|(e^T A) X - e^T B| <= sqrt(eps) * scale`` with the
+    componentwise magnitude scale ``|e^T A| |X| + |e^T B|`` — O(n^2)
+    against ``residual_ok``'s O(n^2 nrhs), and the same fence shape.
+    False on any non-finite X.  Square solves only (a least-squares
+    residual is not small by construction)."""
+    A = np.asarray(A)
+    B = np.asarray(B)
+    X = np.asarray(X)
+    if not np.all(np.isfinite(X)):
+        return False
+    if B.ndim == 1:
+        B = B[:, None]
+    if X.ndim == 1:
+        X = X[:, None]
+    w = A.sum(axis=0)  # e^T A
+    sb = B.sum(axis=0)  # e^T B
+    r = w @ X - sb
+    dt = np.result_type(A, X)
+    eps = float(np.finfo(np.dtype(dt).type(0).real.dtype).eps)
+    scale = float((np.abs(w) @ np.abs(X) + np.abs(sb)).max(initial=0.0))
+    return float(np.abs(r).max(initial=0.0)) <= np.sqrt(eps) * max(
+        scale, eps
+    )
+
+
+# ---------------------------------------------------------------------------
+# traced checks + serve cores (jax imported lazily, like serve/cache)
+# ---------------------------------------------------------------------------
+
+
+def _sqrt_eps(dtype) -> float:
+    """sqrt(machine eps) of a dtype's real field, as a static float
+    (the dtype is static at trace time — no traced coercion)."""
+    return float(
+        np.sqrt(np.finfo(np.dtype(dtype).type(0).real.dtype).eps)
+    )
+
+
+def gesv_check(Ag, Bg, Fg, perm, Xg):
+    """Traced checksum verdict for one LU solve: True = BAD.
+
+    ``Fg`` is the packed LU global (unit-lower L below, U on/above),
+    ``perm`` the forward row permutation (at least n entries), ``Xg``
+    the solved X.  Post-factor: ``L (U e) == (A e)[perm]``; post-trsm:
+    ``(e^T A) X == e^T B``.  Both fenced at sqrt(eps) against
+    componentwise magnitude bounds, so pivot growth never
+    false-positives."""
+    import jax.numpy as jnp
+
+    n = Ag.shape[0]
+    e = jnp.ones((n,), Ag.dtype)
+    tol = _sqrt_eps(Ag.dtype)
+    tiny = tol * tol  # eps of the real field
+    # post-factor relation
+    c = Ag @ e
+    cp = c[perm[:n]]
+    u = jnp.triu(Fg) @ e
+    v = jnp.tril(Fg, -1) @ u + u  # L (U e), unit diagonal
+    s = jnp.abs(jnp.triu(Fg)) @ e.real
+    s = jnp.abs(jnp.tril(Fg, -1)) @ s + s  # |L| |U| e magnitude bound
+    scale_f = jnp.max(s) + jnp.max(jnp.abs(c))
+    bad_f = jnp.max(jnp.abs(v - cp)) > tol * jnp.maximum(scale_f, tiny)
+    # post-trsm relation
+    w = e @ Ag
+    sb = e @ Bg
+    r = w @ Xg - sb
+    scale_s = jnp.max(jnp.abs(w) @ jnp.abs(Xg) + jnp.abs(sb))
+    bad_s = jnp.max(jnp.abs(r)) > tol * jnp.maximum(scale_s, tiny)
+    return bad_f | bad_s
+
+
+def posv_check(Ag, Bg, Lg, Xg):
+    """Traced checksum verdict for one Cholesky solve: True = BAD.
+    ``Lg`` is the (clean lower) factor global.  The operand checksum is
+    taken over the symmetrized lower triangle — posv reads only the
+    lower triangle of A, so junk above the diagonal must not flip the
+    certificate."""
+    import jax.numpy as jnp
+
+    n = Ag.shape[0]
+    e = jnp.ones((n,), Ag.dtype)
+    tol = _sqrt_eps(Ag.dtype)
+    tiny = tol * tol
+    lo = jnp.tril(Ag)
+    Asym = lo + jnp.conj(jnp.tril(Ag, -1)).T
+    c = Asym @ e
+    t = jnp.conj(Lg).T @ e  # L^H e
+    v = Lg @ t
+    s1 = jnp.abs(Lg).T @ e.real
+    s2 = jnp.abs(Lg) @ s1  # |L| |L^H| e magnitude bound
+    scale_f = jnp.max(s2) + jnp.max(jnp.abs(c))
+    bad_f = jnp.max(jnp.abs(v - c)) > tol * jnp.maximum(scale_f, tiny)
+    w = e @ Asym
+    sb = e @ Bg
+    r = w @ Xg - sb
+    scale_s = jnp.max(jnp.abs(w) @ jnp.abs(Xg) + jnp.abs(sb))
+    bad_s = jnp.max(jnp.abs(r)) > tol * jnp.maximum(scale_s, tiny)
+    return bad_f | bad_s
+
+
+def build_core(routine: str, nb: int, schedule: str):
+    """The checksummed serve core for one ABFT bucket: the same
+    driver pipeline as the plain full-phase core (serve/cache), plus
+    the traced post-factor and post-trsm checks, whose verdict rides
+    out as ``info = ABFT_BAD`` on flagged items (driver info wins when
+    positive — a singular input is a numerical property, not
+    corruption).  Called by ``serve/cache._build_core`` for keys whose
+    ``tag == ABFT_TAG``; vmapped per batch item by the cache."""
+    from ..drivers import chol as _chol
+    from ..drivers import lu as _lu
+    from ..enums import Option, Uplo
+    from ..matrix.matrix import HermitianMatrix, Matrix
+
+    opts = {Option.Schedule: schedule}
+
+    if routine == "gesv":
+
+        def core(Ag, Bg):
+            import jax.numpy as jnp
+
+            A = Matrix.from_global(Ag, nb)
+            B = Matrix.from_global(Bg, nb)
+            X, LU, piv, info = _lu.gesv(A, B, opts)
+            Xg = X.to_global()
+            bad = gesv_check(Ag, Bg, LU.to_global(), piv.perm, Xg)
+            info = jnp.where(
+                info > 0, info,
+                jnp.where(bad, jnp.int32(ABFT_BAD), jnp.int32(0)),
+            )
+            return Xg, info
+
+        return core
+
+    if routine == "posv":
+
+        def core(Ag, Bg):
+            import jax.numpy as jnp
+
+            A = HermitianMatrix.from_global(Ag, nb, uplo=Uplo.Lower)
+            B = Matrix.from_global(Bg, nb)
+            X, L, info = _chol.posv(A, B, opts)
+            Xg = X.to_global()
+            Lg = jnp.tril(L.to_global())
+            bad = posv_check(Ag, Bg, Lg, Xg)
+            info = jnp.where(
+                info > 0, info,
+                jnp.where(bad, jnp.int32(ABFT_BAD), jnp.int32(0)),
+            )
+            return Xg, info
+
+        return core
+
+    raise ValueError(f"ABFT serving supports gesv/posv, not {routine!r}")

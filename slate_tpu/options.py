@@ -77,6 +77,14 @@ _DEFAULTS = {
     Option.ServeTenantQuota: "",  # tenant spec ("" = tenancy off)
     Option.ServeAdaptiveWindow: False,  # AIMD window controller off
     Option.ServeLatencyBudget: 0.0,  # service-wide p99 budget (s; 0 = off)
+    # silent-data-corruption defense (integrity/): "" = plane off —
+    # zero-overhead default, one `is None` branch per delivery
+    # (SLATE_TPU_INTEGRITY env overrides; grammar off|sample=<p>|full
+    # with optional ,abft for checksummed bucket cores)
+    Option.ServeIntegrity: "",
+    # stop(drain=True) completes already-admitted requests for at most
+    # this many seconds before abandoning the rest (rolling restarts)
+    Option.ServeDrainTimeout: 30.0,
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

@@ -93,10 +93,17 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
     tq = get_option(opts, Option.ServeTenantQuota, _unset)
     aw = get_option(opts, Option.ServeAdaptiveWindow, _unset)
     lb = get_option(opts, Option.ServeLatencyBudget, _unset)
+    si = get_option(opts, Option.ServeIntegrity, _unset)
     cfg.update(
         tenants=None if tq is _unset else tq,
         adaptive=None if aw is _unset else bool(aw),
         latency_budget_s=None if lb is _unset else float(lb),
+        # an explicitly-empty integrity spec is the explicit
+        # off-switch (False) — collapsing it to None would let the
+        # service re-resolve SLATE_TPU_INTEGRITY, making env-armed
+        # certification un-disablable from opts (the factor_cache
+        # env-override trap)
+        integrity=None if si is _unset else (si or False),
     )
     cfg.update(kw)
     if cfg.get("factor_cache") is None:
@@ -147,7 +154,7 @@ def warmup(
     return svc.warmup(path=path, verbose=verbose)
 
 
-def restore(verbose: bool = False) -> dict:
+def restore(verbose: bool = False, timeout: Optional[float] = None) -> dict:
     """Bring the warmed executable set live artifact-first (the
     cold-start path: each manifest entry is loaded from the
     ``SLATE_TPU_ARTIFACTS`` store where a verified artifact exists,
@@ -157,10 +164,27 @@ def restore(verbose: bool = False) -> dict:
     service with an artifact store runs this automatically on start —
     poll ``health()["phase"]`` (cold -> restoring -> ready) or call
     :func:`wait_ready` to gate traffic on it.  Any start-time pass is
-    waited out first, so this never races it (already-live entries
-    make the explicit pass a cheap no-op)."""
+    waited out first — bounded by ``timeout`` (None = wait forever) —
+    so this never races it (already-live entries make the explicit
+    pass a cheap no-op).  If the bound expires while a start-time pass
+    is still RUNNING (a wedged restore thread —
+    ``health()["restore_stuck_s"]`` says for how long), raises
+    :class:`TimeoutError` instead of launching a second pass
+    concurrently with the stuck one.  A service with no pass in
+    flight (built paused, or restore never configured) just runs the
+    synchronous pass as before."""
     svc = get_service()
-    svc.wait_ready()
+    if not svc.wait_ready(timeout):
+        h = svc.health()
+        if h["phase"] == "restoring":
+            raise TimeoutError(
+                "start-time restore still running after "
+                f"{timeout:g}s (restore_stuck_s="
+                f"{h['restore_stuck_s']}); not starting a concurrent "
+                "pass"
+            )
+        # cold / never-started: nothing in flight to race — fall
+        # through to the synchronous pass (pre-timeout behavior)
     return svc.restore(verbose=verbose)
 
 

@@ -142,6 +142,18 @@ def _build_core(key: BucketKey) -> Callable:
             f"solve-phase serving supports gesv/posv, not {key.routine!r}"
         )
 
+    if key.tag == "abft" and key.routine in ("gesv", "posv"):
+        # checksummed bucket (integrity/abft): the same driver pipeline
+        # plus in-trace post-factor and post-trsm checksum checks whose
+        # per-item verdict rides out as info = ABFT_BAD (< 0) — the
+        # service's delivery certification reads it for free.  The
+        # "abft" tag is a reserved options-fingerprint value: manifests
+        # and artifact fingerprints key the checksummed executable
+        # apart from its plain sibling without a BucketKey change.
+        from ..integrity import abft as _abft
+
+        return _abft.build_core(key.routine, nb, key.schedule)
+
     if key.precision == "mixed":
         # mixed-precision bucket: low-precision factor + device-resident
         # IR (drivers/mixed.serve_mixed_core — fully traceable, classical
@@ -219,7 +231,10 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
             raise NumericalError(
                 f"gesv: singular U({int(info)})", int(info)
             ).with_context(routine=routine)
-        return np.asarray(X.to_global())
+        # sdc_solve on the direct path too: the fallback/re-execution
+        # lane is hardware like any other (finite wrong value on the
+        # flat first element; certification must catch it)
+        return faults.perturb("sdc_solve", np.asarray(X.to_global()))
     if routine == "posv":
         Bm = Matrix.from_global(B, nb)
         X, _L, info = _chol.posv(
@@ -229,7 +244,7 @@ def direct_call(routine: str, A: np.ndarray, B: np.ndarray) -> np.ndarray:
             raise NumericalError(
                 f"posv: not SPD at {int(info)}", int(info)
             ).with_context(routine=routine)
-        return np.asarray(X.to_global())
+        return faults.perturb("sdc_solve", np.asarray(X.to_global()))
     if routine == "gels":
         nbm = min(64, max(A.shape))
         X = _qr.gels(Matrix.from_global(A, nbm), Matrix.from_global(B, nbm))
@@ -645,6 +660,15 @@ class ExecutableCache:
                 _device_id(None if key.mesh else device)
             )
         X = faults.corrupt("result_corrupt", np.asarray(X))
+        if key.routine in ("gesv", "posv"):
+            # sdc_solve: a device returning FINITE garbage (unlike
+            # result_corrupt's NaN) — invisible to the finiteness
+            # fence by construction; only delivery certification
+            # (integrity/) can catch it.  Scoped to the routines the
+            # certificate covers: injecting into gels (whose LS
+            # residual admits no cheap fence) would be an escape no
+            # configuration can defend, flagging chaos runs forever
+            X = faults.perturb("sdc_solve", np.asarray(X))
         info = faults.poison_info(
             "info_nonzero", np.atleast_1d(np.asarray(info))
         )

@@ -76,6 +76,20 @@ sharded lane — the multi-device serving tier ROADMAP item 1 asks for):
   ``batch_window_s`` as the ceiling (Clipper's shape), every decision
   recorded.  Unconfigured, the plane is None and every path is
   byte-identical to the pre-admission tier.
+* **Integrity plane** (``slate_tpu/integrity``, optional): with an
+  ``Option.ServeIntegrity`` / ``SLATE_TPU_INTEGRITY`` policy
+  (``off | sample=<p> | full``, optional ``,abft``), delivered
+  gesv/posv solves are *certified* — the residual fence, or the cheap
+  checksum relations when the bucket was built with ABFT cores — and
+  a failed certificate NEVER reaches the client: the request
+  re-executes, hedged to a different replica when one exists.  Each
+  lane's certificate-failure EWMA (:class:`IntegrityScore`, distinct
+  from the breaker: the breaker sees exceptions and NaNs, the score
+  sees certified-wrong answers) quarantines the lane at admission and
+  probes it back like a half-open breaker.  Queued requests at
+  deadline risk (age past the bucket's p99) are duplicated onto a
+  second lane, first correct result wins.  Unconfigured, the plane is
+  None and every delivery pays one branch.
 * :meth:`SolverService.health` returns a liveness/readiness snapshot
   (total + per-replica queue depth, per-replica worker liveness /
   restarts / dispatch counts / breaker states, recent failure rate)
@@ -115,7 +129,15 @@ admission plane adds ``serve.shed``, ``serve.rejected_quota`` /
 (``serve.tenant_overflow`` past the cap), ``serve.overload.level``
 gauge + ``.enter``/``.exit`` counters, and per-bucket
 ``serve.adaptive.<label>.window_s`` gauges with ``.widen``/``.shrink``
-change counters (``serve.adaptive.changes`` total).
+change counters (``serve.adaptive.changes`` total).  The integrity
+plane adds ``serve.integrity.checked`` / ``serve.integrity.fail`` /
+``serve.integrity.recovered`` / ``serve.integrity.abandoned``,
+quarantine transitions ``serve.integrity.quarantined`` /
+``serve.integrity.unquarantined`` (+ per-replica
+``serve.replica.<i>.quarantined`` / ``.unquarantined``), and the
+hedging triple ``serve.hedge.sent`` / ``serve.hedge.won`` /
+``serve.hedge.wasted``; ``serve.drained`` / ``serve.drain_abandoned``
+count graceful-drain outcomes at :meth:`stop`.
 
 Latency observability (this file is where the split is measured):
 ``serve.latency.<bucket>.queued`` / ``.execute`` / ``.total``
@@ -148,6 +170,8 @@ import numpy as np
 
 from ..aux import devmon, faults, metrics, spans
 from ..exceptions import InvalidInput, NumericalError, SlateError
+from ..integrity import abft as _abft
+from ..integrity import policy as _integ
 from . import admission as _adm
 from . import buckets as _bk
 from .cache import ExecutableCache, direct_call
@@ -241,6 +265,15 @@ class _Request:
     # request factors via _factor_direct instead of the batched path)
     factor_fp: Optional[str] = None
     factor_miss: bool = False
+    # integrity plane (all defaults when the plane is off): certificate
+    # failures so far, whether the current re-execution was hedged to a
+    # different replica, and — straggler hedging — whether this request
+    # IS the duplicate (is_hedge) and the first-result-wins pairing it
+    # shares with its twin (hedge_group)
+    cert_fails: int = 0
+    reexec_hedged: bool = False
+    is_hedge: bool = False
+    hedge_group: Optional["_HedgeGroup"] = None
     # request-scoped tracing (aux/spans; all None when tracing is off):
     # trace id, root "request" span (admit -> deliver), live "queued" span
     trace: Optional[str] = None
@@ -254,6 +287,37 @@ class _Request:
         )
 
 
+class _HedgeGroup:
+    """First-correct-result-wins pairing of a straggler and its hedge
+    (Dean & Barroso, "The Tail at Scale"): the twins share one Future;
+    whichever lane delivers first resolves it, the loser's completed
+    work counts ``serve.hedge.wasted``, and an exception resolves the
+    future only once EVERY member has failed (one slow-or-broken lane
+    must never fail a request its twin can still answer)."""
+
+    def __init__(self, members: int = 2):
+        self.lock = threading.Lock()
+        self.members = members
+        self.delivered = False
+        self.failed = 0
+
+    def first_result(self) -> bool:
+        """Claim the win; False when a twin already delivered."""
+        with self.lock:
+            if self.delivered:
+                return False
+            self.delivered = True
+            return True
+
+    def member_failed(self) -> bool:
+        """Record one member's failure; True when this was the LAST
+        live member and nothing delivered — only then may the caller
+        set the exception."""
+        with self.lock:
+            self.failed += 1
+            return not self.delivered and self.failed >= self.members
+
+
 class _Replica:
     """One serving lane: a queue, a supervised worker, per-bucket
     breakers, and (replicated tier) the device its dispatches pin to.
@@ -263,6 +327,9 @@ class _Replica:
     def __init__(self, name: str, device=None):
         self.name = name
         self.device = device
+        # integrity plane (None when the plane is off): this lane's
+        # certificate-failure EWMA + quarantine state (self-locked)
+        self.score: Optional[_integ.IntegrityScore] = None
         # the shared mutable lane state below is owned by the SERVICE's
         # condition lock (SolverService._cond): workers, admission, and
         # health probes all touch it — the annotations are ground truth
@@ -278,6 +345,8 @@ class _Replica:
         self.q_gauge = f"serve.replica.{name}.queue_depth"
         self.dispatched_counter = f"serve.replica.{name}.dispatched"
         self.oldest_gauge = f"serve.replica.{name}.oldest_queued_s"
+        self.quar_counter = f"serve.replica.{name}.quarantined"
+        self.unquar_counter = f"serve.replica.{name}.unquarantined"
         self.lat_hist = f"serve.latency.replica.{name}.total"
         self.lane = f"replica-{name}"  # span lane label (one Perfetto row)
 
@@ -378,6 +447,25 @@ class SolverService:
         compare against (``Option.ServeLatencyBudget`` when None);
         per-request deadlines override it per request.  0 disables
         burn-driven control (the plane still does tenancy).
+    integrity: silent-data-corruption defense policy
+        (:class:`~slate_tpu.integrity.policy.IntegrityPolicy`, a spec
+        string — grammar ``off | sample=<p> | full`` with optional
+        ``,abft`` and tuning keys — or ``False`` to disable
+        explicitly, overriding the env).  None (default) resolves
+        ``SLATE_TPU_INTEGRITY`` then ``Option.ServeIntegrity`` —
+        disabled by default: one ``is None`` branch per delivery,
+        byte-identical behavior.  When enabled: delivered gesv/posv
+        solves are certified (the residual fence, or the cheap ABFT
+        checksum relations when the bucket was built with ``abft``),
+        a failed certificate NEVER reaches the client (the request
+        re-executes, hedged to a different replica when one exists),
+        each replica lane carries an :class:`IntegrityScore` whose
+        certificate-failure EWMA quarantines the lane at admission
+        (probed like a half-open breaker — distinct from the breaker,
+        which only sees exceptions and NaNs), and queued requests at
+        deadline risk (age past the bucket's p99) are hedged to a
+        second replica, first correct result wins
+        (``serve.hedge.{sent,won,wasted}``).
     faults_spec: aux/faults grammar string; arms + enables injection
         (Option.Faults when None; empty = no injection).  Injection is
         process-global — the arming service owns it and disarms on
@@ -414,8 +502,10 @@ class SolverService:
         tenants=None,
         adaptive: Optional[bool] = None,
         latency_budget_s: Optional[float] = None,
+        integrity=None,
         faults_spec: Optional[str] = None,
         restore_on_start: Optional[bool] = None,
+        restore_stuck_after_s: float = 60.0,
         start: bool = True,
     ):
         # None -> the Serve* Option defaults (one source of truth with
@@ -514,6 +604,12 @@ class SolverService:
         self._phase = PHASE_COLD
         self._restore_result: Optional[Dict[str, int]] = None
         self._restore_thread: Optional[threading.Thread] = None
+        # restore-stuck surfacing: past this age a still-restoring
+        # phase is reported in health()["restore_stuck_s"] so an
+        # orchestrator polling wait_ready(timeout=)/health() can tell
+        # a wedged restore thread from a merely slow one
+        self.restore_stuck_after_s = float(restore_stuck_after_s)
+        self._restore_started: Optional[float] = None
         self._rng = random.Random(retry_seed)
         self._cond = threading.Condition()
         self._running = False
@@ -540,6 +636,14 @@ class SolverService:
         if self._admission is not None:
             for rep in self._lanes:
                 rep.q = self._admission.new_queue()
+        # the integrity plane (certification + quarantine + hedging):
+        # None unless configured — the zero-overhead contract is one
+        # `is None` branch per delivery and per sweep
+        self._integrity = _integ.from_options(integrity)
+        if self._integrity is not None:
+            for rep in self._lanes:
+                rep.score = self._integrity.new_score()
+        self._hedge_last_sweep = 0.0  # guarded by: _cond
         self._restarts = 0
         self._recent_fail: Deque[float] = deque(maxlen=256)
         # latency-histogram labels this service has dispatched (the SLO
@@ -616,6 +720,7 @@ class SolverService:
                 self._phase = PHASE_READY
                 return
             self._phase = PHASE_RESTORING
+            self._restore_started = time.monotonic()
             t = threading.Thread(
                 target=self._run_restore, name="slate-serve-restore",
                 daemon=True,
@@ -698,9 +803,51 @@ class SolverService:
             rep.thread = t
         t.start()
 
-    def stop(self, timeout: float = 10.0) -> None:
+    def stop(
+        self,
+        timeout: float = 10.0,
+        drain: bool = False,
+        drain_timeout: Optional[float] = None,
+    ) -> None:
         """Stop the workers; unstarted/leftover requests resolve with
-        Rejected (futures never hang)."""
+        Rejected (futures never hang).
+
+        ``drain=True`` is the rolling-restart shape: admission closes
+        immediately (new submits raise Rejected) but the workers keep
+        running until every already-admitted request has resolved —
+        bounded by ``drain_timeout`` (``Option.ServeDrainTimeout``
+        when None) — so an orchestrator cycling replicas never fails
+        in-flight futures.  Requests completed during the drain count
+        ``serve.drained``; ones still pending at the bound count
+        ``serve.drain_abandoned`` and resolve Rejected like any other
+        leftover."""
+        if drain:
+            if drain_timeout is None:
+                from ..enums import Option
+                from ..options import get_option
+
+                drain_timeout = float(
+                    get_option(None, Option.ServeDrainTimeout)
+                )
+            deadline_d = time.monotonic() + max(float(drain_timeout), 0.0)
+
+            def _pending_locked() -> int:
+                return sum(
+                    len(rep.q) + len(rep.inflight) for rep in self._lanes
+                )
+
+            with self._cond:
+                # close admission NOW: a drain that keeps admitting can
+                # never finish.  _running stays True — the workers keep
+                # popping and resolving what was already admitted.
+                self._stopped = True
+                start_pending = left = _pending_locked()
+                while left and time.monotonic() < deadline_d:
+                    self._cond.wait(0.02)
+                    left = _pending_locked()
+            metrics.inc("serve.drained", max(start_pending - left, 0))
+            if left:
+                metrics.inc("serve.drain_abandoned", left)
         with self._cond:
             self._running = False
             self._stopped = True
@@ -919,12 +1066,28 @@ class SolverService:
                 f"{routine}: sharded routing unavailable (no mesh "
                 "configured, or the routine has no sharded path)"
             )
+        # ABFT bucket routing: with the integrity plane's abft flag on,
+        # eligible requests (gesv/posv, full precision, single device)
+        # bucket under tag="abft" — the checksummed core family
+        # (integrity/abft via cache._build_core).  Mutually exclusive
+        # with the factor cache: factor-eligible traffic already rides
+        # a 100%-residual-fenced hit path and a certified miss path,
+        # so it keeps its machinery and the plain key.  BucketKey is
+        # untouched — the checksum executables ride the existing
+        # halving lattice under the existing tag field.
+        use_abft = (
+            self._integrity is not None and self._integrity.abft
+            and self.factor_cache is None
+            and routine in ("gesv", "posv")
+            and prec == "full" and not mesh
+        )
         key: Optional[_bk.BucketKey] = None
         if not (routine == "gels" and m < n):
             key = _bk.bucket_for(
                 routine, m, n, nrhs, A.dtype,
                 floor=self.dim_floor, nrhs_floor=self.nrhs_floor,
                 schedule=self.schedule, precision=prec, mesh=mesh,
+                tag=_abft.ABFT_TAG if use_abft else "",
             )
         # factor cache (ONE branch when disabled): fingerprint eligible
         # requests, classify hit (dispatch the trsm-only solve bucket
@@ -1106,6 +1269,18 @@ class SolverService:
             return self._replicas[0]
         loads = [len(r.q) + len(r.inflight) for r in self._replicas]
         open_fl = None
+        if self._integrity is not None:
+            # quarantine exclusion: a lane whose IntegrityScore is
+            # quarantined AND still cooling down sheds NEW admissions
+            # to healthy peers (capacity degrades, answers don't);
+            # once the cooldown elapses the lane is selectable again
+            # and its next certified delivery is the probe — the same
+            # shape as the breaker's half-open window below
+            now_q = time.monotonic()
+            open_fl = [
+                r.score is not None and r.score.excluded(now_q)
+                for r in self._replicas
+            ]
         if key is not None:
             # exclude a breaker-open replica only while its cooldown is
             # still running (Breaker.cooling_down — one definition with
@@ -1113,15 +1288,20 @@ class SolverService:
             # selectable again, or the half-open probe (driven by
             # _execute when a batch reaches the lane) could never fire
             # and the breaker would stay open forever behind healthy
-            # peers
+            # peers.  Merged OR-wise with the quarantine flags above —
+            # either exclusion steers admission off the lane.
             now = time.monotonic()
-            open_fl = []
+            br_fl = []
             for r in self._replicas:
                 b = r.breakers.get(key)
-                open_fl.append(
+                br_fl.append(
                     b is not None
                     and b.cooling_down(now, self.breaker_cooldown_s)
                 )
+            open_fl = (
+                br_fl if open_fl is None
+                else [a or b for a, b in zip(open_fl, br_fl)]
+            )
         return self._replicas[self.placement.select_replica(loads, open_fl)]
 
     def queue_depth(self) -> int:
@@ -1203,6 +1383,32 @@ class SolverService:
         shard_lane = lanes.pop() if self._shard_rep is not None else None
         if shard_lane is not None:
             shard_lane["mesh"] = self.placement.mesh
+        # restore-stuck surfacing (satellite): a phase that has sat in
+        # "restoring" past restore_stuck_after_s reports its age, so a
+        # wait_ready(timeout=) caller that got False can tell a wedged
+        # restore thread from a slow one with one more probe
+        restore_stuck_s = None
+        if phase == PHASE_RESTORING and self._restore_started is not None:
+            age = now - self._restore_started
+            if age > self.restore_stuck_after_s:
+                restore_stuck_s = round(age, 3)
+        # the integrity plane (None when off): policy + per-lane
+        # quarantine scores (self-locked; read outside _cond)
+        integrity = None
+        if self._integrity is not None:
+            scores = {
+                rep.name: rep.score.snapshot(now)
+                for rep in self._lanes if rep.score is not None
+            }
+            integrity = {
+                "policy": self._integrity.describe(),
+                "abft": self._integrity.abft,
+                "replicas": scores,
+                "quarantined": sorted(
+                    n for n, s in scores.items()
+                    if s["state"] == _integ.SCORE_QUARANTINED
+                ),
+            }
         # the SLO surface: per-bucket tail percentiles (total = admit ->
         # deliver) from the serve.latency histograms, plus the
         # deadline-budget burn counters — only populated while metrics
@@ -1247,6 +1453,8 @@ class SolverService:
             "phase": phase,
             "ready": bool(running and alive and phase == PHASE_READY),
             "restore": restore_result,
+            "restore_stuck_s": restore_stuck_s,
+            "integrity": integrity,
             "running": running,
             "worker_alive": alive,
             "worker_restarts": restarts,
@@ -1380,6 +1588,17 @@ class SolverService:
                     expired.extend(dead)
                 if expired:
                     break  # cancel outside the lock, then come back
+                if (
+                    self._integrity is not None
+                    and self._integrity.hedge_factor > 0
+                    and len(self._replicas) > 1 and metrics.is_on()
+                ):
+                    # deadline-risk stragglers: any queued request
+                    # whose age has passed the bucket's p99 gets a
+                    # duplicate dispatched on another lane (sweeps ALL
+                    # lanes from whichever worker runs first — a
+                    # wedged lane cannot sweep its own queue)
+                    self._hedge_stragglers_locked(now)
                 first = self._pop_eligible_locked(rep, now)
                 if first is not None:
                     break
@@ -1461,6 +1680,18 @@ class SolverService:
 
     def _miss_queued(self, req: _Request) -> None:
         """Deadline passed while still queued: cancel, never start."""
+        if req.is_hedge or req.future.done():
+            # a hedge twin (or the original whose twin already
+            # delivered): the LOGICAL request is accounted once, by
+            # its primary — no deadline counters, no burn observation;
+            # the resolution below is a no-op on a done future beyond
+            # closing spans / group bookkeeping
+            _resolve_exc(
+                req.future,
+                DeadlineExceeded(f"{req.routine}: hedge twin expired"),
+                req=req,
+            )
+            return
         metrics.inc("serve.deadline_miss")
         metrics.inc("serve.deadline_miss_queued")
         if self._admission is not None:
@@ -1486,8 +1717,17 @@ class SolverService:
             req=req,
         )
 
-    def _miss_late(self) -> None:
-        """Finished past the deadline: result still delivered, counted."""
+    def _miss_late(self, req: Optional[_Request] = None) -> None:
+        """Finished past the deadline: result still delivered, counted.
+        Hedge twins are skipped — as is a hedged PRIMARY whose twin
+        already resolved the future (the client got a timely answer;
+        only the losing lane was late) — so the logical request counts
+        once, and only when the client actually waited."""
+        if req is not None and (
+            req.is_hedge
+            or (req.hedge_group is not None and req.future.done())
+        ):
+            return
         metrics.inc("serve.deadline_miss")
         metrics.inc("serve.deadline_miss_late")
 
@@ -1697,9 +1937,13 @@ class SolverService:
                 )
             late = r.deadline is not None and now > r.deadline
             info = int(info_b[i]) if i < len(info_b) else 0
-            if info != 0:
+            if info > 0:
+                # strictly positive: the drivers' numerical contract
+                # (singular U, non-SPD) — deterministic, never retried.
+                # Negative info is the ABFT in-trace bad flag, handled
+                # with the certification below.
                 if late:
-                    self._miss_late()
+                    self._miss_late(r)
                 self._observe_total(rep, key.label, r, now)
                 metrics.inc("serve.numerical_errors")
                 deliver.append(functools.partial(
@@ -1707,6 +1951,7 @@ class SolverService:
                     NumericalError(f"{r.routine}: info={info}", info), r,
                 ))
                 continue
+            abft_bad = info < 0
             X = _bk.crop_result(key, X_b[i], r.n, r.nrhs)
             mixed = key.precision == "mixed"
             if (self.validate or mixed) and not np.all(np.isfinite(X)):
@@ -1736,8 +1981,30 @@ class SolverService:
                     corrupt += 1
                 deliver.append(functools.partial(self._direct, r))
                 continue
+            # delivery certification (integrity plane; ONE branch when
+            # off): a finite-but-wrong X — the sdc_solve/sdc_factor
+            # chaos sites, a flaky chip — must never reach the client.
+            # ABFT buckets carry the in-trace verdict (abft_bad) for
+            # free; the host-side certificate (checksum relation, or
+            # the full residual fence for plain buckets) covers the
+            # device->host leg.  A failed certificate re-executes,
+            # hedged to a different replica when one exists.
+            if self._integrity is not None and r.routine in (
+                "gesv", "posv"
+            ):
+                if not self._certify(rep, r, X, key, abft_bad):
+                    deliver.append(
+                        functools.partial(self._cert_reexecute, rep, r)
+                    )
+                    continue
+            elif abft_bad:
+                # defense in depth: a flagged X from a checksummed
+                # executable is never delivered even if the plane was
+                # since disabled — re-solve direct
+                deliver.append(functools.partial(self._direct, r))
+                continue
             if late:
-                self._miss_late()  # finished late; still delivered
+                self._miss_late(r)  # finished late; still delivered
             self._observe_total(rep, key.label, r, now)
             deliver.append(functools.partial(_resolve, r.future, X, r))
         if len(batch) > 1:
@@ -1862,7 +2129,7 @@ class SolverService:
             if r.span is not None and spans.is_on():
                 spans.annotate(r.span, factor_hit=True)
             if late:
-                self._miss_late()
+                self._miss_late(r)
             self._observe_total(rep, key.label, r, now)
             deliver.append(functools.partial(_resolve, r.future, X, r))
         if stale and fc is not None:
@@ -1922,6 +2189,14 @@ class SolverService:
                         raw, perm = factor_only(
                             req.routine, req.A, schedule=self.schedule
                         )
+                        # sdc_factor: silent corruption of the freshly
+                        # computed factor (finite wrong value) — this
+                        # request's X goes wrong through the solve
+                        # below (delivery certification must catch
+                        # it), and the poisoned entry is CACHED, so
+                        # later hits must fall to the residual fence
+                        # (counted stale -> invalidate -> refactor)
+                        raw = faults.perturb("sdc_factor", raw)
                         entry = FactorEntry(
                             fp=fp, routine=req.routine, key=fkey,
                             factor=_bk.pad_square(raw, fkey.n), perm=perm,
@@ -1943,9 +2218,18 @@ class SolverService:
         except Exception as e:  # noqa: BLE001 — futures carry the error
             _resolve_exc(req.future, e, req=req)
             return
+        # delivery certification (ONE branch when the plane is off):
+        # the factor path is where sdc_factor bites — a silently
+        # corrupted fresh factor yields a finite wrong X that no
+        # finiteness fence sees
+        if self._integrity is not None and not self._certify(
+            rep, req, X, req.key, False
+        ):
+            self._cert_reexecute(rep, req)
+            return
         now = time.monotonic()
         if req.deadline is not None and now > req.deadline:
-            self._miss_late()
+            self._miss_late(req)
         # observe total under the DISPATCH key's label (req.key:
         # the full label for misses, the .solve label for items
         # demoted off a solve batch) so it pairs with the queued
@@ -1974,7 +2258,19 @@ class SolverService:
         (``serve.slo_burn.*``) — and, admission plane on, the control
         loop (overload EWMA + the bucket's AIMD window).  Called on
         every delivery; metrics are gated here, the control loop runs
-        with or without them."""
+        with or without them.  Hedge twins never observe — exactly one
+        total (the primary's) per logical request, preserving the
+        queued/total count alignment latency_report subtracts on.  A
+        hedged primary whose twin already resolved the future is
+        skipped too: the client-visible latency was the twin's, and
+        feeding the loser's (slower) wall into the histograms and the
+        burn controller would erase hedging's entire effect on
+        recorded p99 — or worse, shove the overload controller into
+        shedding over latencies nobody experienced."""
+        if req.is_hedge or (
+            req.hedge_group is not None and req.future.done()
+        ):
+            return
         total = now - req.t_submit
         if metrics.is_on():
             metrics.observe_hist(f"serve.latency.{label}.total", total)
@@ -2035,15 +2331,356 @@ class SolverService:
                 e.__context__ = batched_error
             _resolve_exc(req.future, e, req=req)
             return
+        # delivery certification (ONE branch when the plane is off):
+        # the direct lane is hardware like any other — sdc_solve fires
+        # here too, and the re-execution fallback must re-certify
+        if (
+            self._integrity is not None
+            and req.routine in ("gesv", "posv")
+            and not self._certify(None, req, X, req.key, False)
+        ):
+            self._cert_reexecute(None, req)
+            return
         now = time.monotonic()
         if req.deadline is not None and now > req.deadline:
-            self._miss_late()
+            self._miss_late(req)
         lbl = self._lat_label(req)
         if metrics.is_on():
             with self._cond:
                 self._seen_labels.add(lbl)
         self._observe_total(None, lbl, req, now)
         _resolve(req.future, X, req)
+
+    # -- integrity: certification, quarantine, hedged re-execution ---------
+
+    def _certify(
+        self,
+        rep: Optional[_Replica],
+        req: _Request,
+        X: np.ndarray,
+        key: Optional[_bk.BucketKey],
+        abft_bad: bool,
+    ) -> bool:
+        """One delivery's certificate (integrity plane ON — the caller
+        holds the ``is None`` branch).  Returns True to deliver, False
+        on a failed certificate (the caller re-executes; a wrong X
+        never reaches the client).
+
+        Verdict source: the in-trace ABFT bad flag when the bucket was
+        built with checksums (free), plus — per the policy's
+        ``full``/``sample=p`` gate — a host-side check covering the
+        device->host leg: the O(n^2) checksum relation for ABFT
+        buckets, the full residual fence otherwise.  Every verdict
+        feeds the lane's :class:`IntegrityScore`; the quarantine /
+        recovery transitions it causes are counted per replica."""
+        integ = self._integrity
+        if abft_bad:
+            ok = False
+        elif (
+            req.cert_fails
+            or (rep is not None and rep.score is not None
+                and rep.score.suspect())
+            or integ.should_check()
+        ):
+            # always certified regardless of the sampling rate: a
+            # RE-EXECUTION ("a failed certificate never reaches the
+            # client" admits no unsampled retry delivery — and the
+            # recovered/hedge.won accounting depends on the verdict)
+            # and any delivery from a QUARANTINED lane (the
+            # post-cooldown probe must be the next delivery, not the
+            # next sampled one ~1/p deliveries of wrong answers later)
+            is_abft = key is not None and key.tag == _abft.ABFT_TAG
+            A = _cert_operand(req)
+            ok = (
+                _abft.checksum_certificate(A, req.B, X) if is_abft
+                else residual_ok(A, req.B, X)
+            )
+        else:
+            return True  # unsampled delivery: no verdict, no score move
+        mon = metrics.is_on()
+        metrics.inc("serve.integrity.checked")
+        if rep is not None and rep.score is not None:
+            ev = rep.score.observe(ok, time.monotonic())
+            if ev == "quarantined":
+                metrics.inc("serve.integrity.quarantined")
+                if mon:
+                    metrics.inc(rep.quar_counter)
+                if spans.is_on():
+                    spans.event(
+                        "quarantined", trace=req.trace, lane=rep.lane,
+                        replica=rep.name,
+                    )
+            elif ev == "recovered":
+                metrics.inc("serve.integrity.unquarantined")
+                if mon:
+                    metrics.inc(rep.unquar_counter)
+                if spans.is_on():
+                    spans.event(
+                        "unquarantined", trace=req.trace, lane=rep.lane,
+                        replica=rep.name,
+                    )
+        if ok:
+            if req.cert_fails:
+                # a previously-failed request delivered a PASSING
+                # result: the re-execution (hedged or direct) won
+                metrics.inc("serve.integrity.recovered")
+                if req.reexec_hedged:
+                    metrics.inc("serve.hedge.won")
+                    req.reexec_hedged = False
+            return True
+        metrics.inc("serve.integrity.fail")
+        self._note_failure()
+        if spans.is_on() and req.trace is not None:
+            spans.event(
+                "cert_fail", trace=req.trace,
+                lane=rep.lane if rep is not None else "direct",
+                bucket=key.label if key is not None else None,
+                abft=abft_bad,
+            )
+        return False
+
+    def _cert_reexecute(
+        self, rep: Optional[_Replica], req: _Request
+    ) -> None:
+        """A failed certificate never reaches the client: re-execute.
+
+        While retry budget lasts (``policy.cert_retry_max``) the
+        request is HEDGED to a different replica — Dean & Barroso's
+        move: a suspect lane's work re-runs elsewhere, not in place
+        (``serve.hedge.sent``; the certified re-delivery counts
+        ``serve.integrity.recovered`` + ``serve.hedge.won``).  With no
+        other lane it re-runs on the direct driver (a different code
+        path off the suspect executable).  Budget exhausted: one
+        last-resort direct solve behind the full residual fence —
+        delivered only when it passes, else a typed NumericalError
+        (``serve.integrity.abandoned``; never a silent wrong X)."""
+        integ = self._integrity
+        req.cert_fails += 1
+        if req.future.done():
+            # a hedge twin already delivered this request: the failed
+            # result is discarded — no re-execution ladder for a
+            # future nobody can consume (the resolver still closes
+            # spans and the group bookkeeping)
+            _resolve_exc(
+                req.future,
+                NumericalError(
+                    f"{req.routine}: certificate-failed result "
+                    "discarded; hedge twin already delivered"
+                ),
+                req=req,
+            )
+            return
+        if req.is_hedge:
+            # a raced straggler CLONE: never re-execute it — its
+            # primary keeps the full retry ladder and may still
+            # deliver; this member just failed (suppressed by the
+            # group unless the primary fails too)
+            _resolve_exc(
+                req.future,
+                NumericalError(
+                    f"{req.routine}: hedge result failed certification"
+                ),
+                req=req,
+            )
+            return
+        if req.cert_fails <= integ.cert_retry_max:
+            other = None
+            if len(self._replicas) > 1:
+                excluded = self._quarantined_names()
+                with self._cond:
+                    if self._stopped or not self._running:
+                        # a lane re-enqueued onto after stop()'s
+                        # leftover harvest has no worker to ever pop
+                        # it — fall through to the in-place direct
+                        # re-execution below, which resolves the
+                        # future on THIS thread (futures never hang)
+                        other = None
+                    else:
+                        other = self._least_loaded_other_locked(
+                            rep, excluded
+                        )
+                    if other is not None:
+                        metrics.inc("serve.hedge.sent")
+                        req.reexec_hedged = True
+                        req.not_before = 0.0
+                        # the queued histogram observed this request at
+                        # its FIRST dispatch; a factor-path request
+                        # reaches here with attempt still 0, and the
+                        # re-enqueue must not observe it twice
+                        req.attempt = max(req.attempt, 1)
+                        if req.span is not None and spans.is_on():
+                            req.qspan = spans.start(
+                                "queued", trace=req.trace,
+                                parent=req.span, lane=other.lane,
+                                hedge=True,
+                            )
+                        other.q.appendleft(req)
+                        self._gauge_queues_locked()
+                        self._cond.notify_all()
+            if other is not None:
+                if spans.is_on() and req.trace is not None:
+                    spans.event(
+                        "hedge", trace=req.trace, lane=other.lane,
+                        reason="certificate", attempt=req.cert_fails,
+                    )
+                return
+            # single lane: the direct driver IS the different path off
+            # the suspect executable; _direct re-certifies (plane on)
+            self._direct(req)
+            return
+        # budget exhausted: last-resort direct solve, residual-fenced
+        try:
+            with metrics.phase(f"serve.direct.{req.routine}"):
+                X = direct_call(req.routine, req.A, req.B)
+        except Exception as e:  # noqa: BLE001 — futures carry the error
+            _resolve_exc(req.future, e, req=req)
+            return
+        if residual_ok(_cert_operand(req), req.B, X):
+            metrics.inc("serve.integrity.recovered")
+            if req.reexec_hedged:
+                metrics.inc("serve.hedge.won")
+                req.reexec_hedged = False
+            now = time.monotonic()
+            if req.deadline is not None and now > req.deadline:
+                self._miss_late(req)
+            self._observe_total(rep, self._lat_label(req), req, now)
+            _resolve(req.future, X, req)
+            return
+        # the last-resort fence caught corruption too: count it as a
+        # detection (serve.integrity.fail) alongside the refusal, or
+        # integrity_report's injected-vs-detected escape check would
+        # read a correctly-refused injection as a delivered escape
+        metrics.inc("serve.integrity.fail")
+        metrics.inc("serve.integrity.abandoned")
+        _resolve_exc(
+            req.future,
+            NumericalError(
+                f"{req.routine}: result failed integrity certification "
+                f"{req.cert_fails}x across re-executions; refusing to "
+                "deliver an uncertified X"
+            ),
+            req=req,
+        )
+
+    def _quarantined_names(self) -> set:
+        """Names of lanes currently quarantine-excluded (scores are
+        self-locked leaves: safe with or without ``_cond`` held, never
+        the other way around)."""
+        now = time.monotonic()
+        return {
+            r.name for r in self._replicas
+            if r.score is not None and r.score.excluded(now)
+        }
+
+    def _least_loaded_other_locked(
+        self, rep: Optional[_Replica], excluded: set
+    ) -> Optional[_Replica]:
+        """Least-loaded replica other than ``rep``, preferring lanes
+        NOT in ``excluded`` (quarantined) and falling back to one that
+        is — re-executing somewhere beats nowhere.  The ONE spelling
+        of hedge-target selection, shared by the certificate
+        re-execution and the straggler sweep."""
+        best = best_ex = None
+        load_b = load_ex = 0
+        for r in self._replicas:
+            if r is rep:
+                continue
+            load = len(r.q) + len(r.inflight)
+            if r.name in excluded:
+                if best_ex is None or load < load_ex:
+                    best_ex, load_ex = r, load
+            elif best is None or load < load_b:
+                best, load_b = r, load
+        return best if best is not None else best_ex
+
+    def _hedge_stragglers_locked(self, now: float) -> None:
+        """Deadline-risk straggler hedging (Dean & Barroso): any queued
+        request whose age has passed ``hedge_factor`` x its bucket's
+        p99 (the PR9 latency histograms) gets a DUPLICATE dispatched on
+        the least-loaded healthy other lane — first correct result
+        wins the shared Future, the loser counts
+        ``serve.hedge.wasted``.  Swept under ``_cond`` from every
+        worker's pop loop across ALL lanes (a wedged lane cannot sweep
+        its own queue).  Caller guarantees the plane is on, >= 2
+        replicas, and metrics armed (the p99 source)."""
+        integ = self._integrity
+        # rate-limit: every worker's pop/wait loop reaches here (up to
+        # every 50 ms each), and the sweep is O(total queue depth) of
+        # lock-held work plus a percentile per label — bound it to one
+        # sweep per hedge_min_age_s across the whole service (finer
+        # sweeps could not change any request's verdict anyway)
+        if now - self._hedge_last_sweep < max(integ.hedge_min_age_s, 0.01):
+            return
+        self._hedge_last_sweep = now
+        excluded: Optional[set] = None
+        p99s: dict = {}  # per-label memo: one histogram scan per sweep
+        hedged = False
+        for rep in self._replicas:
+            for r in list(rep.q):
+                if (
+                    r.is_hedge or r.hedge_group is not None
+                    or r.key is None or r.key.mesh or r.attempt
+                    or r.cert_fails
+                ):
+                    continue
+                age = now - r.t_submit
+                if age < integ.hedge_min_age_s:
+                    continue
+                lbl = r.key.label
+                if lbl not in p99s:
+                    p99s[lbl] = metrics.percentile(
+                        f"serve.latency.{lbl}.total", 99
+                    )
+                p99 = p99s[lbl]
+                if p99 is None or age < integ.hedge_factor * p99:
+                    continue
+                if excluded is None:
+                    excluded = self._quarantined_names()
+                tgt = self._least_loaded_other_locked(rep, excluded)
+                if tgt is None:
+                    continue
+                grp = _HedgeGroup()
+                r.hedge_group = grp
+                clone = _Request(
+                    routine=r.routine, key=r.key, A=r.A, B=r.B,
+                    m=r.m, n=r.n, nrhs=r.nrhs, future=r.future,
+                    deadline=r.deadline, retries=0, tenant=r.tenant,
+                    priority=r.priority, tenanted=r.tenanted,
+                    factor_fp=r.factor_fp, factor_miss=r.factor_miss,
+                    is_hedge=True, hedge_group=grp,
+                )
+                # attempt=1 skips the queued-histogram observation and
+                # the twin keeps the primary's clock (hedge latency is
+                # the request's latency, would it ever be observed)
+                clone.attempt = 1
+                clone.t_submit = r.t_submit
+                metrics.inc("serve.hedge.sent")
+                if spans.is_on() and r.trace is not None:
+                    spans.event(
+                        "hedge", trace=r.trace, lane=tgt.lane,
+                        reason="straggler", age_s=round(age, 4),
+                    )
+                tgt.q.appendleft(clone)
+                hedged = True
+        if hedged:
+            # wake the target lanes — but ONLY when something was
+            # enqueued: an unconditional notify from every worker's
+            # pop loop would ping-pong idle workers out of their
+            # cond.wait forever (a busy-spin on an idle service)
+            self._cond.notify_all()
+
+
+def _cert_operand(req: _Request) -> np.ndarray:
+    """The operand a certificate must check AGAINST: gesv reads all of
+    A, but posv references only the LOWER triangle (the api contract —
+    "solves with the LOWER triangle of A"), so certifying against junk
+    above the diagonal would fail every verdict on a numerically
+    correct X and abandon a documented-valid request.  Mirrors the
+    symmetrization the traced ``posv_check`` already does."""
+    if req.routine != "posv":
+        return req.A
+    A = np.asarray(req.A)
+    return np.tril(A) + np.conj(np.tril(A, -1)).T
 
 
 def _finish_spans(req: Optional[_Request], outcome: str) -> None:
@@ -2058,6 +2695,18 @@ def _finish_spans(req: Optional[_Request], outcome: str) -> None:
 
 def _resolve(fut: Future, value, req: Optional[_Request] = None) -> None:
     _finish_spans(req, "ok")
+    g = req.hedge_group if req is not None else None
+    if g is not None:
+        # first correct result wins the shared future; the loser's
+        # completed work is the hedge's cost, counted wasted
+        if g.first_result():
+            if not fut.done():
+                fut.set_result(value)
+            if req.is_hedge:
+                metrics.inc("serve.hedge.won")
+        else:
+            metrics.inc("serve.hedge.wasted")
+        return
     if not fut.done():
         fut.set_result(value)
 
@@ -2079,5 +2728,12 @@ def _resolve_exc(
                 _bk.priority_name(req.priority) if req.tenanted else None
             ),
         )
+    g = req.hedge_group if req is not None else None
+    if g is not None:
+        # a hedged pair fails only as a whole: one member's error is
+        # suppressed while its twin can still deliver
+        if g.member_failed() and not fut.done():
+            fut.set_exception(exc)
+        return
     if not fut.done():
         fut.set_exception(exc)

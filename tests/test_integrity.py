@@ -1,0 +1,674 @@
+"""Integrity-plane suite (ISSUE 14): ABFT checksum encoding + traced
+checks, the SLATE_TPU_INTEGRITY policy grammar, delivery
+certification (a finite-but-wrong X never reaches the client),
+per-replica quarantine with probe recovery, hedged re-execution
+(first-correct-result-wins), the residual_ok edge cases certification
+leans on, and the lifecycle satellites (stop(drain=True),
+wait_ready timeout + restore_stuck_s).
+
+A module-scoped ExecutableCache is shared so each (bucket, batch)
+executable compiles once for the file.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import NumericalError, SlateError
+from slate_tpu.integrity import (
+    ABFT_BAD,
+    ABFT_TAG,
+    IntegrityPolicy,
+    IntegrityScore,
+    abft_flops,
+    checksum_certificate,
+    encode,
+    encode_rhs,
+    overhead_ratio,
+)
+from slate_tpu.integrity import abft as abft_mod
+from slate_tpu.integrity import policy as pol_mod
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import (
+    FactorCache,
+    factor_only,
+    residual_ok,
+)
+from slate_tpu.serve.service import Rejected, SolverService, _HedgeGroup
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def integrity_env():
+    """Metrics on (counters are part of the contract under test),
+    faults disarmed before AND after every test."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    faults.reset()
+    yield
+    faults.reset()
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _svc(cache, **kw):
+    cfg = dict(
+        cache=cache, batch_max=4, batch_window_s=0.002,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, degrade_after=2,
+        retry_backoff_s=0.002, retry_backoff_cap_s=0.05,
+        breaker_cooldown_s=0.05,
+    )
+    cfg.update(kw)
+    return SolverService(**cfg)
+
+
+def _gesv_problem(n=12, nrhs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+def _posv_problem(n=12, nrhs=2, seed=0):
+    rng = np.random.default_rng(seed)
+    G = rng.standard_normal((n, n))
+    A = G @ G.T + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+# ---------------------------------------------------------------------------
+# ABFT encoding + checks
+# ---------------------------------------------------------------------------
+
+
+def test_encode_checksum_identities():
+    rng = np.random.default_rng(3)
+    A = rng.standard_normal((7, 7))
+    Ac = encode(A)
+    assert Ac.shape == (8, 8)
+    np.testing.assert_allclose(Ac[:7, 7], A.sum(axis=1))
+    np.testing.assert_allclose(Ac[7, :7], A.sum(axis=0))
+    assert np.isclose(Ac[7, 7], A.sum())
+    # the bordered form of an invertible A is exactly singular — the
+    # documented reason the cores verify relations instead of
+    # factoring the encoding
+    assert abs(np.linalg.det(Ac)) < 1e-8
+    B = rng.standard_normal((7, 3))
+    Bc = encode_rhs(B)
+    np.testing.assert_allclose(Bc[7], B.sum(axis=0))
+
+
+def test_checksum_certificate_pass_and_catch():
+    A, B = _gesv_problem(seed=1)
+    X = np.linalg.solve(A, B)
+    assert checksum_certificate(A, B, X)
+    Xw = X.copy()
+    Xw[3, 1] = Xw[3, 1] * 2 + 1  # the faults.perturb shape
+    assert not checksum_certificate(A, B, Xw)
+    Xn = X.copy()
+    Xn[0, 0] = np.nan
+    assert not checksum_certificate(A, B, Xn)
+
+
+def test_checksum_certificate_complex_and_vector():
+    rng = np.random.default_rng(5)
+    n = 10
+    A = (rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+         + n * np.eye(n)).astype(np.complex128)
+    b = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    x = np.linalg.solve(A, b)
+    assert checksum_certificate(A, b, x)
+    xw = x.copy()
+    xw[4] = xw[4] * 2 + 1
+    assert not checksum_certificate(A, b, xw)
+
+
+def test_traced_checks_catch_factor_and_solve_corruption():
+    """gesv_check/posv_check (the in-trace relations) flag corruption
+    in the factor and in the solution, and pass on clean pipelines."""
+    A, B = _gesv_problem(n=10, seed=2)
+    F, perm = factor_only("gesv", A)
+    X = np.linalg.solve(A, B)
+    assert not bool(abft_mod.gesv_check(A, B, F, perm, X))
+    Fw = F.copy()
+    Fw[4, 7] = Fw[4, 7] * 2 + 1  # corrupt U
+    assert bool(abft_mod.gesv_check(A, B, Fw, perm, X))
+    Fw2 = F.copy()
+    Fw2[7, 2] = Fw2[7, 2] * 2 + 1  # corrupt L
+    assert bool(abft_mod.gesv_check(A, B, Fw2, perm, X))
+    Xw = X.copy()
+    Xw[0, 0] += 1.0
+    assert bool(abft_mod.gesv_check(A, B, F, perm, Xw))
+
+    S, Bs = _posv_problem(n=10, seed=3)
+    L, _ = factor_only("posv", S)
+    Xs = np.linalg.solve(S, Bs)
+    assert not bool(abft_mod.posv_check(S, Bs, L, Xs))
+    Lw = L.copy()
+    Lw[6, 3] = Lw[6, 3] * 2 + 1
+    assert bool(abft_mod.posv_check(S, Bs, Lw, Xs))
+
+
+def test_abft_core_clean_and_flags_via_info():
+    """The checksummed core returns info==0 on a clean solve and the
+    correct X; driver info (positive) wins over the flag."""
+    core = abft_mod.build_core("gesv", 16, "auto")
+    A, B = _gesv_problem(n=12, seed=4)
+    Ap = bk.pad_square(A, 16)
+    Bp = bk.pad_rhs(B, 16, 4)
+    Xg, info = core(Ap, Bp)
+    assert int(info) == 0
+    assert np.abs(
+        np.asarray(Xg)[:12, :2] - np.linalg.solve(A, B)
+    ).max() < 1e-9
+    # a singular input surfaces as POSITIVE driver info, not ABFT_BAD
+    As = np.zeros((16, 16))
+    Xg, info = core(As, Bp)
+    assert int(info) > 0
+
+
+def test_abft_overhead_ratio_at_2048():
+    """The accounting-mirror acceptance bound: checksum overhead is
+    <= 15% of model FLOPs at n=2048 for both routines (it is in fact
+    under 1% — the O(n^2)-vs-O(n^3) point of ABFT)."""
+    for routine in ("gesv", "posv"):
+        key = bk.bucket_for(routine, 2048, 2048, 8, np.float64,
+                            tag=ABFT_TAG)
+        r = overhead_ratio(key)
+        assert 0 < r <= 0.15, (routine, r)
+    assert abft_flops(2048, 8) > 0
+
+
+# ---------------------------------------------------------------------------
+# policy grammar + score state machine
+# ---------------------------------------------------------------------------
+
+
+def test_policy_grammar():
+    assert pol_mod.parse_spec("") is None
+    assert pol_mod.parse_spec("off") is None
+    assert pol_mod.parse_spec("0") is None
+    p = pol_mod.parse_spec("full")
+    assert p.mode == "full" and not p.abft and p.should_check()
+    p = pol_mod.parse_spec("sample=0.5,abft,hedge=2.5,cooldown=1.5")
+    assert p.mode == "sample" and p.sample_p == 0.5 and p.abft
+    assert p.hedge_factor == 2.5 and p.quarantine_cooldown_s == 1.5
+    assert p.describe() == "sample=0.5,abft"
+    for bad in ("bogus", "sample", "sample=2.0", "full,nope=1",
+                "full,threshold=0"):
+        with pytest.raises(ValueError):
+            pol_mod.parse_spec(bad)
+
+
+def test_policy_env_and_explicit_off(monkeypatch):
+    monkeypatch.setenv(pol_mod.INTEGRITY_ENV, "full,abft")
+    p = pol_mod.from_options(None)
+    assert p is not None and p.abft
+    # explicit False is the off-switch even with the env armed
+    assert pol_mod.from_options(False) is None
+    # explicit policy object passes through
+    mine = IntegrityPolicy(mode="full")
+    assert pol_mod.from_options(mine) is mine
+    monkeypatch.setenv(pol_mod.INTEGRITY_ENV, "off")
+    assert pol_mod.from_options(None) is None
+
+
+def test_policy_sample_mode_is_seeded():
+    a = IntegrityPolicy(mode="sample", sample_p=0.5, seed=7)
+    b = IntegrityPolicy(mode="sample", sample_p=0.5, seed=7)
+    assert [a.should_check() for _ in range(32)] == [
+        b.should_check() for _ in range(32)
+    ]
+
+
+def test_integrity_score_lifecycle():
+    s = IntegrityScore(alpha=0.5, threshold=0.6, cooldown_s=10.0)
+    t = 100.0
+    assert s.observe(False, t) is None  # ewma 0.5: under threshold
+    assert s.observe(False, t) == "quarantined"  # 0.75 > 0.6
+    assert s.state == pol_mod.SCORE_QUARANTINED
+    assert s.excluded(t + 1.0)
+    # an OK during the cooldown is noted, not a probe
+    assert s.observe(True, t + 1.0) is None
+    assert s.state == pol_mod.SCORE_QUARANTINED
+    # a FAILED verdict extends the quarantine window
+    assert s.observe(False, t + 2.0) is None
+    assert s.excluded(t + 11.0)  # cooldown restarted at t+2
+    # past the cooldown the lane is selectable; the next pass recovers
+    assert not s.excluded(t + 13.0)
+    assert s.observe(True, t + 13.0) == "recovered"
+    assert s.state == pol_mod.SCORE_OK and s.ewma == 0.0
+    assert s.quarantines == 1
+    snap = s.snapshot(t + 14.0)
+    assert snap["state"] == "ok" and snap["quarantined_for_s"] is None
+
+
+def test_score_interleaved_ok_decays():
+    s = IntegrityScore(alpha=0.5, threshold=0.6, cooldown_s=1.0)
+    t = 0.0
+    for _ in range(8):  # isolated failures between passes never trip
+        assert s.observe(False, t) is None
+        assert s.observe(True, t) is None
+        assert s.observe(True, t) is None
+    assert s.state == pol_mod.SCORE_OK
+
+
+# ---------------------------------------------------------------------------
+# residual_ok edge cases (the fence certification leans on)
+# ---------------------------------------------------------------------------
+
+
+def test_residual_ok_complex():
+    rng = np.random.default_rng(11)
+    n = 10
+    A = (rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+         + n * np.eye(n)).astype(np.complex128)
+    B = (rng.standard_normal((n, 2))
+         + 1j * rng.standard_normal((n, 2))).astype(np.complex128)
+    X = np.linalg.solve(A, B)
+    assert residual_ok(A, B, X)
+    Xw = X.copy()
+    Xw[2, 0] = Xw[2, 0] * 2 + 1
+    assert not residual_ok(A, B, Xw)
+
+
+def test_residual_ok_nrhs1_column_vector():
+    A, B = _gesv_problem(nrhs=1, seed=12)
+    X = np.linalg.solve(A, B)
+    assert X.shape == (12, 1)
+    assert residual_ok(A, B, X)
+    Xw = X.copy()
+    Xw[5, 0] += 1.0
+    assert not residual_ok(A, B, Xw)
+
+
+def test_residual_ok_zero_rhs():
+    A, _ = _gesv_problem(seed=13)
+    B = np.zeros((12, 2))
+    assert residual_ok(A, B, np.zeros((12, 2)))  # exact solve
+    Xw = np.zeros((12, 2))
+    Xw[0, 0] = 1.0  # wrong against B=0 must still be caught
+    assert not residual_ok(A, B, Xw)
+
+
+def test_residual_ok_pad_identity_block_does_not_mask():
+    """The bucket pad [[A,0],[0,I]] solves the pad rows EXACTLY — an
+    exact pad block must not mask a corrupt top-left block."""
+    A, B = _gesv_problem(n=12, seed=14)
+    S = 16
+    Ap = bk.pad_square(A, S)
+    Bp = bk.pad_rhs(B, S, 2)
+    Xp = np.linalg.solve(Ap, Bp)
+    assert residual_ok(Ap, Bp, Xp)
+    Xw = Xp.copy()
+    Xw[3, 1] = Xw[3, 1] * 2 + 1  # corruption INSIDE the real block
+    assert not residual_ok(Ap, Bp, Xw)
+    assert not checksum_certificate(Ap, Bp, Xw)
+
+
+# ---------------------------------------------------------------------------
+# service integration: certification, hedged re-execution, quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_abft_buckets_route_and_serve_correctly(shared_cache):
+    pol = IntegrityPolicy(mode="full", abft=True, hedge_factor=0.0)
+    svc = _svc(shared_cache, integrity=pol)
+    try:
+        A, B = _gesv_problem(seed=20)
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+        S, Bs = _posv_problem(seed=21)
+        Xs = svc.submit("posv", S, Bs).result(timeout=300)
+        assert np.abs(Xs - np.linalg.solve(S, Bs)).max() < 1e-8
+        c = metrics.counters()
+        assert c.get("serve.integrity.checked", 0) >= 2
+        assert c.get("serve.integrity.fail", 0) == 0
+        # the checksummed executables live under the abft tag
+        labels = [k.label for k, _b in svc.cache.entries()]
+        assert any(ABFT_TAG in lbl for lbl in labels), labels
+    finally:
+        svc.stop()
+
+
+def test_abft_excluded_when_factor_cache_on(shared_cache):
+    """ABFT and the factor cache are mutually exclusive per service:
+    factor-eligible traffic keeps its (already residual-fenced)
+    machinery and the plain bucket key."""
+    pol = IntegrityPolicy(mode="full", abft=True, hedge_factor=0.0)
+    svc = _svc(shared_cache, integrity=pol, factor_cache=FactorCache())
+    try:
+        A, B = _gesv_problem(seed=22)
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+        c = metrics.counters()
+        assert c.get("serve.factor_cache.miss", 0) >= 1
+    finally:
+        svc.stop()
+
+
+def test_certificate_failure_hedges_and_recovers(shared_cache):
+    """sdc_solve corrupts a delivered X (finite): certification
+    catches it, the request re-executes hedged to the other replica,
+    and the client gets the CORRECT answer — plus the full counter
+    chain (fail -> hedge.sent -> recovered + hedge.won)."""
+    pol = IntegrityPolicy(mode="full", hedge_factor=0.0,
+                          quarantine_cooldown_s=5.0)
+    svc = _svc(shared_cache, integrity=pol, replicas=2)
+    try:
+        A, B = _gesv_problem(seed=23)
+        svc.submit("gesv", A, B).result(timeout=300)  # warm path
+        faults.arm("sdc_solve", once=True)
+        faults.on()
+        probs = [_gesv_problem(seed=30 + i) for i in range(6)]
+        futs = [svc.submit("gesv", a, b) for a, b in probs]
+        for (a, b), f in zip(probs, futs):
+            X = f.result(timeout=300)
+            assert np.abs(X - np.linalg.solve(a, b)).max() < 1e-8
+        c = metrics.counters()
+        assert c.get("faults.injected.sdc_solve", 0) == 1
+        assert c.get("serve.integrity.fail", 0) >= 1
+        assert c.get("serve.integrity.recovered", 0) >= 1
+        assert c.get("serve.hedge.sent", 0) >= 1
+        assert c.get("serve.hedge.won", 0) >= 1
+    finally:
+        svc.stop()
+
+
+def test_sdc_factor_caught_on_factor_path(shared_cache):
+    """sdc_factor poisons a fresh factor: this request's X is wrong
+    (certification catches, re-executes) AND the poisoned cached
+    factor's later hits fall to the residual fence (counted stale)."""
+    pol = IntegrityPolicy(mode="full", hedge_factor=0.0)
+    svc = _svc(shared_cache, integrity=pol, factor_cache=FactorCache())
+    try:
+        A, B = _gesv_problem(seed=40)
+        faults.arm("sdc_factor", once=True)
+        faults.on()
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+        c = metrics.counters()
+        assert c.get("faults.injected.sdc_factor", 0) == 1
+        detected = (
+            c.get("serve.integrity.fail", 0)
+            + c.get("serve.factor_cache.stale", 0)
+        )
+        assert detected >= 1, c
+    finally:
+        svc.stop()
+
+
+def test_quarantine_engages_and_probes_back(shared_cache):
+    pol = IntegrityPolicy(mode="full", hedge_factor=0.0,
+                          quarantine_cooldown_s=0.15, cert_retry_max=1)
+    svc = _svc(shared_cache, integrity=pol, replicas=2)
+    try:
+        A, B = _gesv_problem(seed=50)
+        svc.submit("gesv", A, B).result(timeout=300)  # warm
+        faults.arm("sdc_solve", every=1)
+        faults.on()
+        futs = [svc.submit("gesv", *_gesv_problem(seed=60 + i))
+                for i in range(8)]
+        for f in futs:
+            try:
+                f.result(timeout=300)  # typed errors allowed; hangs not
+            except SlateError:
+                pass
+        c = metrics.counters()
+        assert c.get("serve.integrity.quarantined", 0) >= 1
+        assert svc.health()["integrity"]["quarantined"]
+        faults.reset()
+        time.sleep(0.2)  # cooldown elapses; next delivery is the probe
+        for i in range(4):
+            a, b = _gesv_problem(seed=80 + i)
+            X = svc.submit("gesv", a, b).result(timeout=300)
+            assert np.abs(X - np.linalg.solve(a, b)).max() < 1e-8
+        h = svc.health()
+        assert not h["integrity"]["quarantined"], h["integrity"]
+        assert metrics.counters().get(
+            "serve.integrity.unquarantined", 0
+        ) >= 1
+    finally:
+        svc.stop()
+
+
+def test_quarantined_lane_excluded_at_admission(shared_cache):
+    """Admission steers around a quarantined lane while its cooldown
+    runs, and selects it again once the cooldown elapses (the probe
+    window) — the breaker-exclusion shape, fed by certificates."""
+    pol = IntegrityPolicy(mode="full", quarantine_cooldown_s=10.0)
+    svc = _svc(shared_cache, integrity=pol, replicas=2, start=False)
+    try:
+        r0 = svc._replicas[0]
+        now = time.monotonic()
+        r0.score.observe(False, now)
+        assert r0.score.observe(False, now) == "quarantined"
+        key = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                            nrhs_floor=NRHS_FLOOR)
+        with svc._cond:
+            for _ in range(6):
+                assert svc._pick_replica_locked(key) is svc._replicas[1]
+        # rewind the quarantine epoch past the cooldown: selectable again
+        r0.score.quarantined_at = now - 11.0
+        with svc._cond:
+            picks = {svc._pick_replica_locked(key).name for _ in range(6)}
+        assert "0" in picks
+    finally:
+        svc.stop()
+
+
+def test_posv_certified_with_junk_upper_triangle(shared_cache):
+    """posv reads only the LOWER triangle (the api contract) — junk
+    above the diagonal must not fail certification on a numerically
+    correct X (the certificate symmetrizes, like the traced check)."""
+    pol = IntegrityPolicy(mode="full", hedge_factor=0.0)
+    svc = _svc(shared_cache, integrity=pol)
+    try:
+        A, B = _posv_problem(seed=200)
+        Aj = A.copy()
+        Aj[np.triu_indices(12, 1)] = 1e3  # garbage upper triangle
+        X = svc.submit("posv", Aj, B).result(timeout=300)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-8
+        c = metrics.counters()
+        assert c.get("serve.integrity.checked", 0) >= 1
+        assert c.get("serve.integrity.fail", 0) == 0
+        assert c.get("serve.integrity.abandoned", 0) == 0
+    finally:
+        svc.stop()
+
+
+def test_integrity_off_zero_touch(shared_cache):
+    """Unconfigured plane: _integrity is None, no integrity metrics,
+    correct X — the zero-overhead contract."""
+    svc = _svc(shared_cache)
+    try:
+        assert svc._integrity is None
+        A, B = _gesv_problem(seed=90)
+        X = svc.submit("gesv", A, B).result(timeout=300)
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        c = metrics.counters()
+        assert c.get("serve.integrity.checked", 0) == 0
+        assert svc.health()["integrity"] is None
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# hedging mechanics (deterministic, no worker races)
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_group_first_result_wins():
+    from slate_tpu.serve.service import _Request, _resolve, _resolve_exc
+
+    A, B = _gesv_problem(seed=100)
+    fut = Future()
+    grp = _HedgeGroup()
+    prim = _Request(routine="gesv", key=None, A=A, B=B, m=12, n=12,
+                    nrhs=2, future=fut, hedge_group=grp)
+    clone = _Request(routine="gesv", key=None, A=A, B=B, m=12, n=12,
+                     nrhs=2, future=fut, is_hedge=True, hedge_group=grp)
+    X = np.linalg.solve(A, B)
+    _resolve(fut, X, clone)  # the hedge wins
+    _resolve(fut, X + 1, prim)  # the primary arrives late: wasted
+    assert np.abs(fut.result(timeout=1) - X).max() == 0
+    c = metrics.counters()
+    assert c.get("serve.hedge.won", 0) == 1
+    assert c.get("serve.hedge.wasted", 0) == 1
+
+    # exception suppression: one member's failure never fails the
+    # future while its twin can still deliver; both failing does
+    fut2 = Future()
+    grp2 = _HedgeGroup()
+    p2 = _Request(routine="gesv", key=None, A=A, B=B, m=12, n=12,
+                  nrhs=2, future=fut2, hedge_group=grp2)
+    c2 = _Request(routine="gesv", key=None, A=A, B=B, m=12, n=12,
+                  nrhs=2, future=fut2, is_hedge=True, hedge_group=grp2)
+    _resolve_exc(fut2, NumericalError("lane a died"), req=c2)
+    assert not fut2.done()
+    _resolve_exc(fut2, NumericalError("lane b died"), req=p2)
+    with pytest.raises(NumericalError):
+        fut2.result(timeout=1)
+
+
+def test_straggler_sweep_clones_to_other_lane(shared_cache):
+    """The straggler sweep hedges a queued request whose age passed
+    the bucket p99 onto the other lane (semi-unit: paused service,
+    hand-seeded histogram, no worker races)."""
+    pol = IntegrityPolicy(mode="full", hedge_factor=1.0,
+                          hedge_min_age_s=0.0)
+    svc = _svc(shared_cache, integrity=pol, replicas=2, start=False)
+    try:
+        from slate_tpu.serve.service import _Request
+
+        A, B = _gesv_problem(seed=110)
+        key = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                            nrhs_floor=NRHS_FLOOR)
+        for _ in range(4):  # the p99 history the trigger compares to
+            metrics.observe_hist(f"serve.latency.{key.label}.total",
+                                 0.001)
+        req = _Request(routine="gesv", key=key, A=A, B=B, m=12, n=12,
+                       nrhs=2)
+        req.t_submit = time.monotonic() - 0.5  # well past p99
+        svc._replicas[0].q.append(req)
+        with svc._cond:
+            svc._hedge_stragglers_locked(time.monotonic())
+        assert len(svc._replicas[1].q) == 1
+        clone = svc._replicas[1].q[0]
+        assert clone.is_hedge and clone.hedge_group is req.hedge_group
+        assert clone.future is req.future
+        assert metrics.counters().get("serve.hedge.sent", 0) == 1
+        # idempotent: a hedged request is never hedged twice
+        with svc._cond:
+            svc._hedge_stragglers_locked(time.monotonic())
+        assert metrics.counters().get("serve.hedge.sent", 0) == 1
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellites: stop(drain=True), wait_ready timeout + restore_stuck_s
+# ---------------------------------------------------------------------------
+
+
+def test_stop_drain_completes_inflight(shared_cache):
+    svc = _svc(shared_cache)
+    A, B = _gesv_problem(seed=120)
+    svc.submit("gesv", A, B).result(timeout=300)  # warm
+    faults.arm("latency", every=1, ms=30)
+    faults.on()
+    probs = [_gesv_problem(seed=130 + i) for i in range(4)]
+    futs = [svc.submit("gesv", a, b) for a, b in probs]
+    svc.stop(drain=True, drain_timeout=30.0)
+    faults.reset()
+    for (a, b), f in zip(probs, futs):
+        X = f.result(timeout=5)  # already resolved: drain completed it
+        assert np.abs(X - np.linalg.solve(a, b)).max() < 1e-8
+    c = metrics.counters()
+    assert c.get("serve.drained", 0) >= 1
+    assert c.get("serve.drain_abandoned", 0) == 0
+    # admission is closed the moment the drain starts
+    with pytest.raises(Rejected):
+        svc.submit("gesv", A, B)
+
+
+def test_stop_drain_bounded_abandons(shared_cache):
+    svc = _svc(shared_cache)
+    A, B = _gesv_problem(seed=140)
+    svc.submit("gesv", A, B).result(timeout=300)  # warm
+    faults.arm("latency", every=1, ms=300)
+    faults.on()
+    futs = [svc.submit("gesv", *_gesv_problem(seed=150 + i))
+            for i in range(3)]
+    svc.stop(drain=True, drain_timeout=0.05)
+    faults.reset()
+    resolved = 0
+    for f in futs:
+        try:
+            f.result(timeout=10)
+            resolved += 1
+        except SlateError:
+            resolved += 1  # Rejected leftovers: typed, never hung
+    assert resolved == 3
+    assert metrics.counters().get("serve.drain_abandoned", 0) >= 1
+
+
+class _BlockingRestoreCache(ExecutableCache):
+    """restore() parks on an event — the wedged-restore-thread
+    scenario the wait_ready timeout + restore_stuck_s satellite is
+    for."""
+
+    def __init__(self):
+        super().__init__(manifest_path=None)
+        self.release = threading.Event()
+
+    def restore(self, **kw):
+        self.release.wait(timeout=30.0)
+        return {"entries": 0, "restored": 0, "compiled": 0,
+                "failed": 0, "skipped": 0}
+
+
+def test_wait_ready_timeout_and_restore_stuck():
+    cache = _BlockingRestoreCache()
+    svc = SolverService(cache=cache, batch_max=2, dim_floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR, restore_on_start=True,
+                        restore_stuck_after_s=0.01)
+    try:
+        assert svc.wait_ready(0.15) is False  # bounded, returns False
+        h = svc.health()
+        assert h["phase"] == "restoring"
+        assert h["restore_stuck_s"] is not None
+        assert h["restore_stuck_s"] > 0.01
+        cache.release.set()
+        assert svc.wait_ready(10.0) is True
+        h = svc.health()
+        assert h["restore_stuck_s"] is None and h["phase"] == "ready"
+    finally:
+        cache.release.set()
+        svc.stop()
+
+
+def test_sdc_sites_registered():
+    """The new sites are first-class in the faults registry (armable,
+    SITE_SPECS-joined for chaos_report and the fault-site lint rule)."""
+    from slate_tpu.aux.faults import SITE_REGISTRY
+
+    for site in ("sdc_factor", "sdc_solve"):
+        assert site in SITE_REGISTRY
+        assert SITE_REGISTRY[site].recovery  # never a ghost site
+        faults.arm(site, once=True)
+    faults.reset()

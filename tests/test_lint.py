@@ -208,6 +208,36 @@ def test_fault_site_positive_unrecoverable_and_ghost_counter(tmp_path):
     assert "serve.not_emitted" in msgs
 
 
+def test_fault_site_sdc_site_without_recovery_fails(tmp_path):
+    """ISSUE 14 satellite: an SDC-style site declared with no recovery
+    counters (and not informational) must fail the fault-site rule —
+    chaos_report could otherwise never show its containment, and an
+    injection there would flag CI forever."""
+    root = _mini_repo(tmp_path, {
+        "slate_tpu/aux/faults.py": """
+            class SiteSpec:
+                def __init__(self, name, recovery=(), informational=False):
+                    pass
+
+            SITE_SPECS = (
+                SiteSpec("sdc_solve"),
+                SiteSpec("sdc_factor", recovery=("serve.integrity.fail",)),
+            )
+        """,
+        "slate_tpu/serve/svc.py": """
+            from ..aux import faults, metrics
+            metrics.inc("serve.integrity.fail")
+            def f(x):
+                return faults.perturb("sdc_solve", x)
+        """,
+    })
+    res = _lint(root, "fault-site")
+    msgs = " | ".join(f.message for f in res.findings)
+    assert "sdc_solve" in msgs and "no recovery" in msgs
+    # the sibling WITH an emitted recovery family is clean
+    assert "sdc_factor" not in msgs
+
+
 def test_fault_site_negative(tmp_path):
     root = _mini_repo(tmp_path, {
         "slate_tpu/aux/faults.py": _FAULTS_FIXTURE,

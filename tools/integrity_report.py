@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Silent-data-corruption verdict over a metrics JSONL.
+
+Reads a ``SLATE_TPU_METRICS`` dump from a run with the ``sdc_factor``
+/ ``sdc_solve`` chaos sites armed and judges the integrity plane
+(``slate_tpu/integrity``, ``Option.ServeIntegrity``):
+
+* **escape check** — every injected SDC must land on a detection
+  counter: ``serve.integrity.fail`` (a delivery certificate caught the
+  wrong X) or ``serve.factor_cache.stale`` (the factor-cache residual
+  fence caught a poisoned cached factor).  Injections exceeding the
+  summed detections mean finite-but-wrong answers reached clients
+  unflagged — the exact failure mode the plane exists for — and the
+  tool exits nonzero.
+* **containment check** — certificate failures must resolve: each
+  failed request either recovered (a re-execution delivered a PASSING
+  result, ``serve.integrity.recovered``) or was refused typed
+  (``serve.integrity.abandoned``).  Failures with neither signal mean
+  requests vanished.
+
+Also renders the hedging triple (``serve.hedge.{sent,won,wasted}``)
+and the quarantine transitions (``serve.integrity.quarantined`` /
+``.unquarantined`` + the per-replica ``serve.replica.<i>.quarantined``
+family) so one report answers: was corruption detected, was it
+contained, did the hedges win, did the sick lane quarantine and heal.
+
+Usage:
+    SLATE_TPU_METRICS=/tmp/sdc.jsonl python my_serving_app.py
+    python tools/integrity_report.py /tmp/sdc.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+SDC_SITES = ("sdc_factor", "sdc_solve")
+INJECT_PREFIX = "faults.injected."
+
+
+def _counters(path: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("type") == "counter":
+                out[row["name"]] = float(row.get("value", 0))
+    return out
+
+
+def analyze(path: str) -> dict:
+    c = _counters(path)
+    injected = {
+        site: int(c.get(INJECT_PREFIX + site, 0)) for site in SDC_SITES
+    }
+    detected_fail = int(c.get("serve.integrity.fail", 0))
+    detected_stale = int(c.get("serve.factor_cache.stale", 0))
+    total_injected = sum(injected.values())
+    recovered = int(c.get("serve.integrity.recovered", 0))
+    abandoned = int(c.get("serve.integrity.abandoned", 0))
+    # pooled escape math, faithful to SITE_SPECS: BOTH sites list the
+    # certificate counter AND the factor-cache stale fence as recovery
+    # families (an sdc_solve firing on a solve-phase HIT dispatch is
+    # caught by the residual fence and counted stale, not fail — a
+    # per-site split would flag that correctly-contained run as an
+    # escape).  The counters are process-global, so one site's
+    # detections can mask the other's escapes — the chaos_report
+    # shared-attribution caveat; for airtight per-site attribution,
+    # run one site per pass (the per-site injected counts printed
+    # below are the operator's cue).
+    detected = detected_fail + detected_stale
+    escaped = max(total_injected - detected, 0)
+    # containment: every certificate failure eventually recovered or
+    # was refused typed.  A single request can fail several
+    # certificates before recovering, so fails >= recovered+abandoned
+    # is normal — zero resolution signal against nonzero fails is not.
+    unresolved = detected_fail > 0 and recovered + abandoned == 0
+    return {
+        "injected": injected,
+        "total_injected": total_injected,
+        "detected_fail": detected_fail,
+        "detected_stale": detected_stale,
+        "checked": int(c.get("serve.integrity.checked", 0)),
+        "recovered": recovered,
+        "abandoned": abandoned,
+        "escaped": escaped,
+        "unresolved": unresolved,
+        "hedge": {
+            "sent": int(c.get("serve.hedge.sent", 0)),
+            "won": int(c.get("serve.hedge.won", 0)),
+            "wasted": int(c.get("serve.hedge.wasted", 0)),
+        },
+        "quarantined": int(c.get("serve.integrity.quarantined", 0)),
+        "unquarantined": int(c.get("serve.integrity.unquarantined", 0)),
+        "replicas": {
+            name[len("serve.replica."):-len(".quarantined")]: int(v)
+            for name, v in c.items()
+            if name.startswith("serve.replica.")
+            and name.endswith(".quarantined")
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from an SDC chaos run")
+    args = ap.parse_args(argv)
+
+    r = analyze(args.jsonl)
+    print(f"{'injected':>22}: " + "  ".join(
+        f"{s}={n}" for s, n in r["injected"].items()
+    ))
+    print(f"{'certificates checked':>22}: {r['checked']}")
+    print(f"{'detected':>22}: certificate_fail={r['detected_fail']}  "
+          f"factor_stale={r['detected_stale']}")
+    print(f"{'contained':>22}: recovered={r['recovered']}  "
+          f"abandoned_typed={r['abandoned']}")
+    h = r["hedge"]
+    print(f"{'hedges':>22}: sent={h['sent']}  won={h['won']}  "
+          f"wasted={h['wasted']}")
+    print(f"{'quarantine':>22}: entered={r['quarantined']}  "
+          f"recovered={r['unquarantined']}"
+          + ("  per-replica " + ", ".join(
+              f"{k}={v}" for k, v in sorted(r["replicas"].items())
+          ) if r["replicas"] else ""))
+
+    if r["total_injected"] == 0:
+        print("\nno sdc_factor/sdc_solve injections in this JSONL "
+              "(faults off?)")
+        return 0
+    bad = 0
+    if r["escaped"] > 0:
+        print(f"\nFAIL: {r['escaped']} injected SDC event(s) escaped "
+              "certification — finite wrong answers were delivered "
+              "unflagged")
+        bad = 1
+    if r["unresolved"]:
+        print("\nFAIL: certificate failures with zero recovery/abandon "
+              "signal — failed requests vanished")
+        bad = 1
+    if not bad:
+        print("\nevery injected SDC was detected and contained")
+    return bad
+
+
+if __name__ == "__main__":
+    sys.exit(main())
