@@ -1,7 +1,7 @@
 """slate-lint: AST-based invariant checking for the contracts every
 review pass has been policing by hand.
 
-Eight rules, each mechanizing a recurring bug class from CHANGES.md
+Ten rules, each mechanizing a recurring bug class from CHANGES.md
 (see each rule's ``bug`` attribute and the README "Static analysis"
 section):
 
@@ -19,7 +19,13 @@ rule                    invariant
 ``pytree-safety``       no enum-keyed dicts into jax; array dataclasses
                         carry eq=False
 ``lock-discipline``     ``# guarded by: <lock>`` fields only touched under
-                        the lock
+                        the lock (intraprocedural, per file)
+``race-guarded-by``     whole-program lock discipline: ``*_locked``
+                        helpers called with their locks held, resolvable
+                        annotated fields checked across modules
+``race-lock-order``     the nested-lock acquisition graph over
+                        serve/+integrity/+aux/ is acyclic; new edges vs
+                        the checked-in LOCK_ORDER.json are findings
 ``env-drift``           SLATE_TPU_* knobs and README env tables agree
 ``exception-context``   serve-path SlateError raises attach with_context()
 ======================  =====================================================
@@ -55,6 +61,8 @@ from . import rules_faults  # noqa: F401,E402
 from . import rules_trace  # noqa: F401,E402
 from . import rules_concurrency  # noqa: F401,E402
 from . import rules_env  # noqa: F401,E402
+from . import races  # noqa: F401,E402
+from .races import LOCK_GRAPH_NAME  # noqa: F401,E402
 
 __all__ = [
     "BASELINE_NAME", "Finding", "LintResult", "RULES", "Rule",

@@ -352,6 +352,12 @@ class LintResult:
     def to_json(self) -> dict:
         fp_of = dict((id(f), fp) for f, fp in self.all_with_fingerprints)
         return {
+            # schema_version advances whenever the report shape or the
+            # rule set changes incompatibly (2: the race-guarded-by /
+            # race-lock-order rules joined the registry) so report
+            # consumers can detect the format; "version" stays for
+            # pre-schema_version readers
+            "schema_version": 2,
             "version": 1,
             "ok": self.ok,
             "files": self.files,

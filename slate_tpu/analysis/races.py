@@ -1,0 +1,819 @@
+"""Race & deadlock rules: whole-program guarded-by analysis and the
+static lock-order graph (``aux/sync.py`` is the dynamic half of the
+same plane).
+
+Bug classes mechanized (CHANGES.md):
+
+* PR14's review passes caught three real concurrency bugs — an
+  idle-worker busy-spin from an unconditional notify, hedge clones
+  landing on quarantined lanes, stop()-raced re-enqueues that would
+  hang futures.  PR13's ``lock-discipline`` rule checks ``# guarded
+  by:`` annotations only *intraprocedurally, file by file*: a
+  ``*_locked`` helper is exempt (caller holds the lock) but nothing
+  checked its CALLERS, and an annotated field read from another module
+  was invisible.  ``race-guarded-by`` closes both holes: it follows
+  call edges from every ``*_locked`` helper to its callers and extends
+  field checking across modules wherever the attribute name resolves
+  unambiguously — superseding the intraprocedural rule, which stays as
+  the fallback for unresolvable names.
+* A deadlock needs two locks taken in two orders — invisible to any
+  single-file rule.  ``race-lock-order`` builds the global acquisition
+  graph from every nested ``with <lock>:`` / ``.acquire()`` region
+  across ``serve/``, ``integrity/`` and ``aux/`` — following calls
+  made while a lock is held, so ``with self._cond:`` calling
+  ``adm.quota_take`` (which takes the admission lock) is an edge even
+  though no ``with`` nests lexically.  A cycle is a potential deadlock
+  finding, and the shipped graph is emitted as a checked-in artifact
+  (:data:`LOCK_GRAPH_NAME`) so every NEW edge shows up in review
+  before it can close a cycle in production.
+
+Resolution discipline (no type inference, so precision comes from
+refusing to guess):
+
+* A guarded attribute is **resolvable project-wide** iff every class
+  in the linted tree that defines it carries a guard annotation for it
+  (or only one class defines it).  ``state`` (Breaker unguarded,
+  IntegrityScore guarded) is ambiguous → same-file checking only;
+  ``level`` (OverloadController alone) is resolvable → a lock-free
+  read from ``serve/service.py`` is flagged unless suppressed with a
+  justification.
+* A call is **followed** for lock-set propagation only when it
+  resolves deterministically: ``self.m()`` to the enclosing class,
+  bare names to the same module, ``alias.m()`` through the file's
+  project imports, and other ``obj.m()`` only when ``m`` is defined
+  exactly once in scope and is not a builtin-container-shaped name
+  (``.get()``/``.append()``/... are never followed — by-name matching
+  there would wire bogus edges through every dict lookup).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, FrozenSet, List, NamedTuple, Optional, Set, Tuple
+
+from .core import (
+    FileInfo,
+    Finding,
+    Project,
+    Rule,
+    enclosing_function,
+    rule,
+    terminal_name,
+)
+from .rules_concurrency import _under_lock, iter_attr_decls
+
+#: the checked-in lock-order graph artifact (repo root) — regenerate
+#: with ``tools/slate_lint.py --write-lock-graph`` after reviewing a
+#: new edge
+LOCK_GRAPH_NAME = "LOCK_ORDER.json"
+
+#: directories whose nested lock regions feed the lock-order graph
+LOCK_SCOPE = ("slate_tpu/serve/", "slate_tpu/integrity/", "slate_tpu/aux/")
+
+#: constructors that declare a lock (threading primitives and their
+#: aux/sync drop-in wrappers)
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_LOCK_ROOTS = {"threading", "sync"}
+
+#: attribute-call names never followed by the unique-name fallback:
+#: container/str/thread/lock/future API lookalikes whose by-name
+#: resolution would wire bogus edges through every dict lookup
+_CALL_DENY = frozenset({
+    "get", "pop", "popitem", "popleft", "append", "appendleft", "remove",
+    "clear", "update", "items", "keys", "values", "add", "discard",
+    "extend", "insert", "setdefault", "sort", "index", "count", "copy",
+    "join", "split", "rsplit", "strip", "lstrip", "rstrip", "partition",
+    "rpartition", "startswith", "endswith", "format", "encode", "decode",
+    "lower", "upper", "replace", "search", "match", "findall", "finditer",
+    "group", "wait", "wait_for", "notify", "notify_all", "acquire",
+    "release", "locked", "set", "is_set", "is_alive", "start", "cancel",
+    "result", "set_result", "set_exception", "done", "move_to_end",
+})
+
+
+# ---------------------------------------------------------------------------
+# project-wide guard table
+# ---------------------------------------------------------------------------
+
+
+class _Decl(NamedTuple):
+    """One class-attribute definition site (guarded or not)."""
+
+    attr: str
+    rel: str
+    klass: str
+    line: int
+    lock: Optional[str]  # None = defined without a guard annotation
+    external: bool
+
+
+def _attr_decls(f: FileInfo) -> List[_Decl]:
+    """Every class-attribute definition in one file — with the
+    ``# guarded by:`` annotation when present (the shared declaration
+    walk, guarded and unguarded sites alike)."""
+    return [
+        _Decl(
+            attr, f.rel, node.name, lineno,
+            m.group(1) if m else None, bool(m and m.group(2)),
+        )
+        for attr, node, lineno, m in iter_attr_decls(f)
+    ]
+
+
+class _AttrInfo(NamedTuple):
+    resolvable: bool
+    anyof: FrozenSet[str]  # locks, any one of which satisfies an access
+    guard_files: FrozenSet[str]  # files declaring a guard (intra turf)
+    decl: str  # human locator of one guarded declaration
+
+
+def guard_table(project: Project) -> Dict[str, _AttrInfo]:
+    """attr name -> project-wide guard info (see the module docstring's
+    resolvability discipline).  Cached per run."""
+    cached = project.cache.get("races_guard_table")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    decls: Dict[str, List[_Decl]] = {}
+    for f in project.files:
+        for d in _attr_decls(f):
+            decls.setdefault(d.attr, []).append(d)
+    table: Dict[str, _AttrInfo] = {}
+    for attr, ds in decls.items():
+        guarded = [d for d in ds if d.lock]
+        if not guarded:
+            continue
+        # resolvable iff every DEFINING CLASS carries a guard for the
+        # attr (per-class, not per-site: __init__ may assign a guarded
+        # field a second time without re-annotating)
+        classes = {(d.rel, d.klass) for d in ds}
+        guarded_classes = {(d.rel, d.klass) for d in guarded}
+        table[attr] = _AttrInfo(
+            resolvable=classes == guarded_classes,
+            anyof=frozenset(d.lock for d in guarded),
+            guard_files=frozenset(d.rel for d in guarded),
+            decl=f"{guarded[0].rel}:{guarded[0].line}",
+        )
+    project.cache["races_guard_table"] = table
+    return table
+
+
+# ---------------------------------------------------------------------------
+# whole-program guarded-by: _locked call edges + cross-module fields
+# ---------------------------------------------------------------------------
+
+
+def _locked_defs(project: Project) -> Dict[str, List[Tuple[FileInfo, ast.AST]]]:
+    """Every ``*_locked`` function definition, by name (nested defs
+    included — the stop() drain helper is one)."""
+    cached = project.cache.get("races_locked_defs")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    out: Dict[str, List[Tuple[FileInfo, ast.AST]]] = {}
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name.endswith("_locked"):
+                out.setdefault(node.name, []).append((f, node))
+    project.cache["races_locked_defs"] = out
+    return out
+
+
+def _requirements(
+    project: Project, name: str,
+    _visiting: Optional[Set[str]] = None,
+) -> List[FrozenSet[str]]:
+    """The locks a ``*_locked`` helper's caller must hold: one any-of
+    set per distinct guarded field the helper (transitively, through
+    other ``*_locked`` calls) touches.  Empty when nothing resolves —
+    the intraprocedural fallback (no finding)."""
+    memo = project.cache.setdefault("races_locked_reqs", {})
+    if name in memo:
+        return memo[name]
+    top = _visiting is None
+    if top:
+        _visiting = set()
+    if name in _visiting:
+        return []  # mutual recursion: the other frame owns the result
+    _visiting.add(name)
+    table = guard_table(project)
+    defs = _locked_defs(project)
+    sets: Set[FrozenSet[str]] = set()
+    for f, node in defs.get(name, ()):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                info = table.get(sub.attr)
+                if info is None:
+                    continue
+                # the helper's own file's guards apply to it (the
+                # intraprocedural semantics); project-resolvable
+                # attrs apply everywhere
+                if info.resolvable or f.rel in info.guard_files:
+                    sets.add(info.anyof)
+            elif isinstance(sub, ast.Call):
+                callee = terminal_name(sub.func)
+                if (
+                    callee and callee != name
+                    and callee.endswith("_locked") and callee in defs
+                ):
+                    for s in _requirements(project, callee, _visiting):
+                        sets.add(s)
+    _visiting.discard(name)
+    out = sorted(sets, key=sorted)
+    # memoize only complete (top-level) results: inside a traversal a
+    # mutually recursive helper may have been cut short by the
+    # _visiting check above, and caching that truncated set would
+    # silently skip its lock requirements for the rest of the run
+    if top:
+        memo[name] = out
+    return out
+
+
+@rule
+class RaceGuardedBy(Rule):
+    """Whole-program guarded-by analysis: ``*_locked`` helpers are only
+    called with their locks held, and resolvable annotated fields are
+    checked across module boundaries (the intraprocedural
+    ``lock-discipline`` rule stays as the fallback for unresolvable
+    names)."""
+
+    name = "race-guarded-by"
+    summary = (
+        "*_locked helpers are called with their (transitively "
+        "required) locks held, and '# guarded by:' fields resolvable "
+        "project-wide are checked across modules"
+    )
+    bug = "cross-module lock-discipline races the per-file rule misses"
+
+    def check_project(self, project: Project):
+        table = guard_table(project)
+        defs = _locked_defs(project)
+        for f in project.files:
+            for node in ast.walk(f.tree):
+                # -- _locked call discipline -------------------------
+                if isinstance(node, ast.Call):
+                    callee = terminal_name(node.func)
+                    if (
+                        callee and callee.endswith("_locked")
+                        and callee in defs
+                    ):
+                        encl = enclosing_function(node)
+                        fname = getattr(encl, "name", "")
+                        if (
+                            fname == "__init__"
+                            or fname.endswith("_locked")
+                        ):
+                            continue  # the chain is checked at ITS callers
+                        for req in _requirements(project, callee):
+                            if not any(
+                                _under_lock(node, lk) for lk in req
+                            ):
+                                locks = "/".join(sorted(req))
+                                yield Finding(
+                                    self.name, f.rel, node.lineno,
+                                    node.col_offset,
+                                    f"call to {callee}() without "
+                                    f"holding {locks!r} — the _locked "
+                                    "suffix is a caller-holds-the-lock "
+                                    "contract; wrap the call in `with "
+                                    f"*.{locks}:` or rename the helper",
+                                )
+                                break
+                    continue
+                # -- cross-module field accesses ---------------------
+                if not isinstance(node, ast.Attribute):
+                    continue
+                info = table.get(node.attr)
+                if info is None or not info.resolvable:
+                    continue
+                if f.rel in info.guard_files:
+                    continue  # lock-discipline's (intraprocedural) turf
+                encl = enclosing_function(node)
+                fname = getattr(encl, "name", "")
+                if fname == "__init__" or fname.endswith("_locked"):
+                    continue
+                if any(_under_lock(node, lk) for lk in info.anyof):
+                    continue
+                locks = "/".join(sorted(info.anyof))
+                yield Finding(
+                    self.name, f.rel, node.lineno, node.col_offset,
+                    f"cross-module access to {node.attr!r} (guarded by "
+                    f"{locks!r}, declared at {info.decl}) outside "
+                    f"`with *.{locks}:` — take the lock, or suppress "
+                    "with a justification if the lock-free read is "
+                    "deliberate",
+                )
+
+
+# ---------------------------------------------------------------------------
+# the static lock-order graph
+# ---------------------------------------------------------------------------
+
+
+class _LockDecl(NamedTuple):
+    attr: str
+    rel: str
+    klass: Optional[str]  # None = module-level
+
+    @property
+    def node(self) -> str:
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        mod = mod[len("slate_tpu/"):] if mod.startswith("slate_tpu/") else mod
+        return (
+            f"{mod}.{self.klass}.{self.attr}" if self.klass
+            else f"{mod}.{self.attr}"
+        )
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    nm = terminal_name(value.func)
+    if nm not in _LOCK_CTORS:
+        return False
+    root = value.func
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    if isinstance(root, ast.Name):
+        return root.id in _LOCK_ROOTS or root.id in _LOCK_CTORS
+    return False
+
+
+class _GraphCtx:
+    """Everything the graph walk needs, built once per project: lock
+    declarations, per-file import maps, and function registries over
+    the :data:`LOCK_SCOPE` files."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.files = [
+            f for f in project.files
+            if f.rel.startswith(LOCK_SCOPE)
+        ]
+        self.decls: List[_LockDecl] = []
+        self.decl_by_attr: Dict[str, List[_LockDecl]] = {}
+        # (rel, klass|None, attr) -> decl, for scoped resolution
+        self.decl_scoped: Dict[Tuple[str, Optional[str], str], _LockDecl] = {}
+        # function registries
+        self.module_funcs: Dict[str, Dict[str, ast.AST]] = {}
+        self.classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        self.class_methods: Dict[
+            Tuple[str, str], Dict[str, ast.AST]
+        ] = {}
+        self.methods_by_name: Dict[str, List[Tuple[FileInfo, str, ast.AST]]] = {}
+        self.imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        for f in self.files:
+            self._scan_file(f)
+
+    def _scan_file(self, f: FileInfo) -> None:
+        rel = f.rel
+        self.module_funcs[rel] = {}
+        self.classes[rel] = {}
+        self.imports[rel] = self._import_map(f)
+        for node in f.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and _is_lock_ctor(
+                        node.value
+                    ):
+                        self._add_decl(_LockDecl(tgt.id, rel, None))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_funcs[rel][node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[rel][node.name] = node
+                methods: Dict[str, ast.AST] = {}
+                for sub in node.body:
+                    if isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        methods[sub.name] = sub
+                self.class_methods[(rel, node.name)] = methods
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign):
+                        tgt = sub.targets[0]
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and _is_lock_ctor(sub.value)
+                        ):
+                            self._add_decl(
+                                _LockDecl(tgt.attr, rel, node.name)
+                            )
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                klass = self._owning_class(f, node)
+                self.methods_by_name.setdefault(node.name, []).append(
+                    (f, klass, node)
+                )
+
+    @staticmethod
+    def _owning_class(f: FileInfo, node: ast.AST) -> Optional[str]:
+        from .core import parents
+
+        for anc in parents(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None  # nested function: not a method
+        return None
+
+    def _add_decl(self, d: _LockDecl) -> None:
+        key = (d.rel, d.klass, d.attr)
+        if key in self.decl_scoped:
+            return
+        self.decl_scoped[key] = d
+        self.decls.append(d)
+        self.decl_by_attr.setdefault(d.attr, []).append(d)
+
+    def _import_map(
+        self, f: FileInfo
+    ) -> Dict[str, Tuple[str, Optional[str]]]:
+        """alias -> (target rel, member|None): module aliases map with
+        member None; from-imported functions/classes carry the member
+        name."""
+        out: Dict[str, Tuple[str, Optional[str]]] = {}
+        pkg_parts = f.rel.split("/")[:-1]  # the file's package dirs
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            # ast.ImportFrom.level (relative-import depth), not the
+            # overload controller's guarded field of the same name
+            if node.level == 0:  # slate-lint: disable=race-guarded-by
+                base = (node.module or "").split(".")
+            else:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]  # slate-lint: disable=race-guarded-by
+                if node.module:
+                    base = base + node.module.split(".")
+            for alias in node.names:
+                name = alias.asname or alias.name
+                as_module = "/".join(base + [alias.name]) + ".py"
+                if as_module in self.project.by_rel:
+                    out[name] = (as_module, None)
+                    continue
+                as_member = "/".join(base) + ".py"
+                if as_member in self.project.by_rel:
+                    out[name] = (as_member, alias.name)
+        return out
+
+    # -- lock resolution ----------------------------------------------------
+
+    def resolve_lock(
+        self, expr: ast.AST, rel: str, klass: Optional[str]
+    ) -> Optional[_LockDecl]:
+        nm = terminal_name(expr)
+        if nm is None:
+            return None
+        if isinstance(expr, ast.Name):
+            d = self.decl_scoped.get((rel, None, nm))
+            if d is not None:
+                return d
+        elif (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and klass is not None
+        ):
+            d = self.decl_scoped.get((rel, klass, nm))
+            if d is not None:
+                return d
+        cands = self.decl_by_attr.get(nm, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # -- call resolution ----------------------------------------------------
+
+    def resolve_call(
+        self, call: ast.Call, f: FileInfo, klass: Optional[str]
+    ) -> Optional[Tuple[FileInfo, Optional[str], ast.AST]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            nm = func.id
+            node = self.module_funcs.get(f.rel, {}).get(nm)
+            if node is not None:
+                return (f, None, node)
+            cls = self.classes.get(f.rel, {}).get(nm)
+            if cls is not None:
+                init = self.class_methods.get((f.rel, nm), {}).get("__init__")
+                return (f, nm, init) if init is not None else None
+            imp = self.imports.get(f.rel, {}).get(nm)
+            if imp is not None:
+                return self._resolve_member(imp)
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        nm = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and klass:
+            node = self.class_methods.get((f.rel, klass), {}).get(nm)
+            if node is not None:
+                return (f, klass, node)
+        if isinstance(recv, ast.Name):
+            imp = self.imports.get(f.rel, {}).get(recv.id)
+            if imp is not None and imp[1] is None:
+                target = self.project.by_rel.get(imp[0])
+                if target is not None:
+                    node = self.module_funcs.get(imp[0], {}).get(nm)
+                    if node is not None:
+                        return (target, None, node)
+                    cls = self.classes.get(imp[0], {}).get(nm)
+                    if cls is not None:
+                        init = self.class_methods.get(
+                            (imp[0], nm), {}
+                        ).get("__init__")
+                        if init is not None:
+                            return (target, nm, init)
+                return None
+        # unique-name fallback, denylisted against container lookalikes
+        if nm in _CALL_DENY:
+            return None
+        cands = self.methods_by_name.get(nm, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def _resolve_member(
+        self, imp: Tuple[str, Optional[str]]
+    ) -> Optional[Tuple[FileInfo, Optional[str], ast.AST]]:
+        rel, member = imp
+        target = self.project.by_rel.get(rel)
+        if target is None or member is None:
+            return None
+        node = self.module_funcs.get(rel, {}).get(member)
+        if node is not None:
+            return (target, None, node)
+        if member in self.classes.get(rel, {}):
+            init = self.class_methods.get((rel, member), {}).get("__init__")
+            if init is not None:
+                return (target, member, init)
+        return None
+
+    # -- transitive lock sets ----------------------------------------------
+
+    def locks_of(
+        self, f: FileInfo, klass: Optional[str], node: ast.AST,
+        _visiting: Optional[Set[int]] = None,
+    ) -> Set[str]:
+        """Qualified locks ``node`` may acquire, transitively through
+        resolvable calls (memoized; call-graph cycles are cut)."""
+        memo = self.project.cache.setdefault("races_locksets", {})
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        if _visiting is None:
+            _visiting = set()
+        if key in _visiting:
+            return set()
+        _visiting.add(key)
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    d = self.resolve_lock(item.context_expr, f.rel, klass)
+                    if d is not None:
+                        out.add(d.node)
+            elif isinstance(sub, ast.Call):
+                if (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "acquire"
+                ):
+                    d = self.resolve_lock(sub.func.value, f.rel, klass)
+                    if d is not None:
+                        out.add(d.node)
+                    continue
+                resolved = self.resolve_call(sub, f, klass)
+                if resolved is not None and resolved[2] is not node:
+                    out |= self.locks_of(*resolved, _visiting=_visiting)
+        _visiting.discard(key)
+        memo[key] = out
+        return out
+
+
+def _graph_ctx(project: Project) -> _GraphCtx:
+    ctx = project.cache.get("races_graph_ctx")
+    if ctx is None:
+        ctx = project.cache["races_graph_ctx"] = _GraphCtx(project)
+    return ctx
+
+
+def lock_graph(project: Project) -> Dict[Tuple[str, str], str]:
+    """The static acquisition-order graph over :data:`LOCK_SCOPE`:
+    ``(held, acquired) -> "rel:line"`` provenance (first site, in
+    deterministic file/line order).  An edge means: somewhere, the
+    second lock is (possibly through calls) acquired while the first
+    is held."""
+    cached = project.cache.get("races_lock_graph")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    ctx = _graph_ctx(project)
+    raw: List[Tuple[str, str, str, int]] = []  # (from, to, rel, line)
+    for f in ctx.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.With):
+                continue
+            encl = enclosing_function(node)
+            klass = (
+                ctx._owning_class(f, encl) if encl is not None else None
+            )
+            held = [
+                d for d in (
+                    ctx.resolve_lock(it.context_expr, f.rel, klass)
+                    for it in node.items
+                ) if d is not None
+            ]
+            if not held:
+                continue
+            # `with a, b:` is itself an ordering
+            for i, a in enumerate(held):
+                for b in held[i + 1:]:
+                    if a.node != b.node:
+                        raw.append((a.node, b.node, f.rel, node.lineno))
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    acquired: Set[str] = set()
+                    if isinstance(sub, ast.With):
+                        for item in sub.items:
+                            d = ctx.resolve_lock(
+                                item.context_expr, f.rel, klass
+                            )
+                            if d is not None:
+                                acquired.add(d.node)
+                    elif isinstance(sub, ast.Call):
+                        if (
+                            isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "acquire"
+                        ):
+                            d = ctx.resolve_lock(
+                                sub.func.value, f.rel, klass
+                            )
+                            if d is not None:
+                                acquired.add(d.node)
+                        else:
+                            resolved = ctx.resolve_call(sub, f, klass)
+                            if resolved is not None:
+                                acquired |= ctx.locks_of(*resolved)
+                    if not acquired:
+                        continue
+                    for a in held:
+                        for b in acquired:
+                            if a.node != b:
+                                raw.append(
+                                    (a.node, b, f.rel, sub.lineno)
+                                )
+    raw.sort(key=lambda e: (e[0], e[1], e[2], e[3]))
+    edges: Dict[Tuple[str, str], str] = {}
+    for a, b, rel, line in raw:
+        edges.setdefault((a, b), f"{rel}:{line}")
+    project.cache["races_lock_graph"] = edges
+    return edges
+
+
+def graph_cycles(
+    edges: Dict[Tuple[str, str], str]
+) -> List[List[str]]:
+    """Cycles in the order graph (one representative per strongly
+    connected component with >= 2 nodes), each as a node list."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    # Tarjan SCC, iterative
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        onstack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+    return sccs
+
+
+def graph_to_doc(edges: Dict[Tuple[str, str], str]) -> dict:
+    """The artifact shape ``LOCK_ORDER.json`` carries."""
+    return {
+        "version": 1,
+        "edges": [
+            {"from": a, "to": b, "via": via}
+            for (a, b), via in sorted(edges.items())
+        ],
+    }
+
+
+def load_graph_artifact(root: str) -> Optional[Set[Tuple[str, str]]]:
+    """The checked-in graph's (from, to) pairs; None when absent."""
+    path = os.path.join(root, LOCK_GRAPH_NAME)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return {
+        (e["from"], e["to"]) for e in doc.get("edges", ())
+    }
+
+
+def write_graph_artifact(root: str, project: Project) -> str:
+    """Regenerate the checked-in artifact from the current tree."""
+    path = os.path.join(root, LOCK_GRAPH_NAME)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(graph_to_doc(lock_graph(project)), fh, indent=2)
+        fh.write("\n")
+    return path
+
+
+@rule
+class RaceLockOrder(Rule):
+    """The static lock-order graph: a cycle is a potential deadlock,
+    and — when the checked-in :data:`LOCK_GRAPH_NAME` artifact exists —
+    every edge not in it is a reviewable finding (regenerate with
+    ``tools/slate_lint.py --write-lock-graph`` after review)."""
+
+    name = "race-lock-order"
+    summary = (
+        "the nested-lock acquisition graph over serve/+integrity/+aux/ "
+        "is acyclic, and new edges vs the checked-in LOCK_ORDER.json "
+        "show up as findings"
+    )
+    bug = "cross-module lock-order inversions no single file shows"
+
+    def check_project(self, project: Project):
+        edges = lock_graph(project)
+        for comp in graph_cycles(edges):
+            # anchor at the provenance of one edge inside the cycle
+            via = None
+            for (a, b), v in sorted(edges.items()):
+                if a in comp and b in comp:
+                    via = v
+                    break
+            rel, _, line = (via or "LOCK_ORDER.json:1").rpartition(":")
+            yield Finding(
+                self.name, rel or LOCK_GRAPH_NAME, int(line), 0,
+                "lock-order cycle (potential deadlock): "
+                + " <-> ".join(comp)
+                + " — break the cycle or move one acquisition outside "
+                "the other lock's region",
+            )
+        known = load_graph_artifact(project.root)
+        if known is None:
+            return  # no artifact in this tree (fixtures)
+        for (a, b), via in sorted(edges.items()):
+            if (a, b) in known:
+                continue
+            rel, _, line = via.rpartition(":")
+            yield Finding(
+                self.name, rel, int(line), 0,
+                f"new lock-order edge {a} -> {b} not in "
+                f"{LOCK_GRAPH_NAME} — review it for inversions against "
+                "the shipped graph, then regenerate the artifact with "
+                "tools/slate_lint.py --write-lock-graph",
+            )
+        stale = sorted(known - set(edges))
+        if stale:
+            pairs = ", ".join(f"{a} -> {b}" for a, b in stale[:4])
+            more = f" (+{len(stale) - 4} more)" if len(stale) > 4 else ""
+            yield Finding(
+                self.name, LOCK_GRAPH_NAME, 1, 0,
+                f"{LOCK_GRAPH_NAME} lists edges the tree no longer "
+                f"has: {pairs}{more} — regenerate with "
+                "tools/slate_lint.py --write-lock-graph",
+            )

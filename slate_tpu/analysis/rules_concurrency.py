@@ -50,11 +50,14 @@ class _Guard(NamedTuple):
     line: int
 
 
-def _guards(f: FileInfo) -> List[_Guard]:
-    """``# guarded by:`` annotations on attribute definitions, per
-    class: ``self.q = ...  # guarded by: _cond`` in a method body, or
-    an annotated class-level field."""
-    out: List[_Guard] = []
+def iter_attr_decls(f: FileInfo):
+    """Every class-attribute definition site in one file, as ``(attr,
+    class node, lineno, guard match-or-None)``: ``self.x = ...`` in a
+    method body, or a bare/annotated class-level field.  The ONE
+    spelling of the declaration walk — the intraprocedural
+    ``lock-discipline`` rule and the whole-program ``races`` rules
+    both build on it, so they can never diverge on which fields they
+    consider annotated."""
     for node in ast.walk(f.tree):
         if not isinstance(node, ast.ClassDef):
             continue
@@ -82,12 +85,20 @@ def _guards(f: FileInfo) -> List[_Guard]:
                     attr = tgt.id
             if attr is None:
                 continue
-            m = _GUARD_RE.search(f.line_text(sub.lineno))
-            if m:
-                out.append(_Guard(
-                    attr, m.group(1), bool(m.group(2)), node, sub.lineno
-                ))
-    return out
+            yield attr, node, sub.lineno, _GUARD_RE.search(
+                f.line_text(sub.lineno)
+            )
+
+
+def _guards(f: FileInfo) -> List[_Guard]:
+    """``# guarded by:`` annotations on attribute definitions, per
+    class: ``self.q = ...  # guarded by: _cond`` in a method body, or
+    an annotated class-level field."""
+    return [
+        _Guard(attr, m.group(1), bool(m.group(2)), node, lineno)
+        for attr, node, lineno, m in iter_attr_decls(f)
+        if m
+    ]
 
 
 def _under_lock(node: ast.AST, lock: str) -> bool:

@@ -15,6 +15,12 @@
   memory gauges with graceful None on backends without memory_stats,
   and the roofline peaks table (SLATE_TPU_PEAKS override); armed by
   SLATE_TPU_DEVMON=1, one bool per call site when off.
+- aux.sync: instrumented Lock/RLock/Condition runtime — Eraser-style
+  lockset checking over `# guarded by:` fields, live lock-order cycle
+  detection with both stacks of an inversion, happens-before hand-off
+  edges (Condition wait/notify, Future resolution), and seeded
+  replayable yield points; armed by SLATE_TPU_SYNC_CHECK=1, plain
+  threading primitives (zero overhead) when off.
 """
 
-from . import devmon, faults, metrics, spans, trace  # noqa: F401
+from . import devmon, faults, metrics, spans, sync, trace  # noqa: F401

@@ -57,6 +57,13 @@ Sites (:data:`SITES`) and where they are checked:
                        plausible garbage; only delivery certification
                        (``Option.ServeIntegrity``) stands between it
                        and the client
+    ``lock_contend``   injected sleep inside INSTRUMENTED lock
+                       acquisitions (``aux/sync`` wrappers, armed by
+                       ``SLATE_TPU_SYNC_CHECK``), ``ms=`` spec key —
+                       inflates lock hold/wait times so the race
+                       plane's stress runs widen the windows the
+                       seeded yield points alone might not hit; inert
+                       while the sync runtime is off
     ``tenant_flood``   a synthetic burst of ``burst=`` low-priority
                        requests from tenant ``"flood"`` cloning the
                        triggering request's operands is injected at
@@ -181,6 +188,15 @@ SITE_SPECS: Tuple[SiteSpec, ...] = (
         "serve.integrity.fail", "serve.integrity.recovered",
         "serve.factor_cache.stale",
     )),
+    # lock-hold inflation for the race plane (aux/sync): the injected
+    # sleep fires inside instrumented lock acquisitions, widening race
+    # windows the seeded yield points alone might not hit.  Like
+    # latency, added delay violates nothing by itself — deadline
+    # traffic surfaces it through the late-miss counter, and a
+    # contention-only run with no deadline traffic is a legitimate
+    # zero-signal outcome
+    SiteSpec("lock_contend", recovery=("serve.deadline_miss_late",),
+             informational=True),
     # a synthetic tenant burst is absorbed when the admission plane
     # refused (some of) it: overload shedding, token-bucket/queue-share
     # quota rejections, or plain bounded-queue backpressure — a flood

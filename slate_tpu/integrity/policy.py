@@ -42,8 +42,9 @@ from __future__ import annotations
 
 import os
 import random
-import threading
 from typing import Optional
+
+from ..aux import sync
 
 INTEGRITY_ENV = "SLATE_TPU_INTEGRITY"
 
@@ -98,7 +99,7 @@ class IntegrityPolicy:
         self.quarantine_alpha = float(quarantine_alpha)
         self.cert_retry_max = max(int(cert_retry_max), 0)
         self._rng = random.Random(seed)
-        self._rng_lock = threading.Lock()
+        self._rng_lock = sync.Lock(name="integrity.IntegrityPolicy._rng_lock")
 
     def should_check(self) -> bool:
         """Does this delivery get a certificate?  ``full`` -> always;
@@ -210,11 +211,16 @@ class IntegrityScore:
         self.alpha = float(alpha)
         self.threshold = float(threshold)
         self.cooldown_s = float(cooldown_s)
-        self._lock = threading.Lock()
-        self.ewma = 0.0
-        self.state = SCORE_OK
-        self.quarantined_at = 0.0
-        self.quarantines = 0  # lifetime quarantine transitions
+        # sync.Lock: plain threading.Lock unless the race plane is on
+        self._lock = sync.Lock(name="integrity.IntegrityScore._lock")
+        # the EWMA + quarantine state machine: workers observe from
+        # delivery loops while admission and health() read concurrently
+        # — the annotations are ground truth for the lock-discipline
+        # and race-guarded-by lint rules
+        self.ewma = 0.0  # guarded by: _lock
+        self.state = SCORE_OK  # guarded by: _lock
+        self.quarantined_at = 0.0  # guarded by: _lock
+        self.quarantines = 0  # lifetime transitions  # guarded by: _lock
 
     def observe(self, ok: bool, now: float) -> Optional[str]:
         """Fold one certificate verdict in; returns the transition it

@@ -81,12 +81,11 @@ from __future__ import annotations
 
 import math
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..aux import metrics, spans
+from ..aux import metrics, spans, sync
 from .buckets import (
     DEFAULT_TENANT,
     PRIORITIES,
@@ -646,7 +645,9 @@ class AdmissionControl:
         self.ceiling_s = float(ceiling_s)
         self.overload = overload or OverloadController()
         self.clock = clock
-        self._lock = threading.Lock()
+        # sync.Lock: plain threading.Lock unless SLATE_TPU_SYNC_CHECK
+        # armed the race plane (zero overhead off)
+        self._lock = sync.Lock(name="admission.AdmissionControl._lock")
         self._states: Dict[str, _TenantState] = {}  # guarded by: _lock
         self._windows: Dict[str, AdaptiveWindow] = {}  # guarded by: _lock
         self._capped = metrics.CappedKeys(TENANT_METRIC_CAP)

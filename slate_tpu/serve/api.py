@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..aux import sync
 from ..enums import Option
 from ..exceptions import InvalidInput  # noqa: F401  (re-export: taxonomy)
 from ..options import Options, get_option
@@ -230,7 +231,13 @@ def _sync(routine, A, B, deadline, retries, precision=None,
     )
     # no result-timeout: the worker resolves every admitted future
     # (deadline expiry included), so blocking here cannot hang
-    return fut.result()
+    try:
+        return fut.result()
+    finally:
+        # race plane: pair the worker's hb_publish at resolution, so a
+        # guarded field the client touches after result() is ordered
+        # after the worker's writes (one bool when off)
+        sync.hb_receive(fut)
 
 
 def gesv(A, B, deadline: Optional[float] = None, retries: int = 0,

@@ -73,7 +73,7 @@ import threading
 import time
 from typing import Callable, Optional, Tuple
 
-from ..aux import faults, metrics
+from ..aux import faults, metrics, sync
 from .buckets import BucketKey, content_fields, fingerprint
 
 ARTIFACTS_ENV = "SLATE_TPU_ARTIFACTS"
@@ -213,7 +213,8 @@ class ArtifactStore:
     def __init__(self, root: str, seed_xla_cache: bool = True):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
-        self._lock = threading.Lock()
+        # sync.Lock: plain threading.Lock unless the race plane is on
+        self._lock = sync.Lock(name="artifacts.ArtifactStore._lock")
         self._runtime: Optional[dict] = None  # resolved on first use
         # (key, batch) pairs whose load() verified a cache_seed entry
         # this process: the recompile that follows must not pay a

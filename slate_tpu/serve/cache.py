@@ -50,7 +50,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..aux import devmon, faults, metrics, spans
+from ..aux import devmon, faults, metrics, spans, sync
 from ..exceptions import NumericalError
 from .artifacts import ArtifactStore, store_from_env
 from .buckets import (
@@ -282,30 +282,34 @@ class ExecutableCache:
         manifest_path: Optional[str] = None,
         artifact_dir: Optional[str] = None,
     ):
-        self._lock = threading.RLock()
-        self._exes: Dict[Tuple[BucketKey, int], Callable] = {}
-        self._entries: Set[Tuple[BucketKey, int]] = set()
+        # sync.RLock: plain threading.RLock unless SLATE_TPU_SYNC_CHECK
+        # armed the race plane.  The worker pool, warmup() and
+        # restore() all race on the tables below — the annotations are
+        # ground truth for the lock-discipline / race-guarded-by rules
+        self._lock = sync.RLock(name="cache.ExecutableCache._lock")
+        self._exes: Dict[Tuple[BucketKey, int], Callable] = {}  # guarded by: _lock
+        self._entries: Set[Tuple[BucketKey, int]] = set()  # guarded by: _lock
         # how each live executable came to be: "artifact" (export blob
         # deserialized) or "compile" (built here) — restore() reports it
-        self._origin: Dict[Tuple[BucketKey, int], str] = {}
+        self._origin: Dict[Tuple[BucketKey, int], str] = {}  # guarded by: _lock
         # device ids each entry has dispatched on (None = default
         # placement): warmup/restore prime every replica device that is
         # not in here yet, so multi-replica steady state is compile-free
         # on EVERY device, not just the first one traffic happened to hit
-        self._primed: Dict[Tuple[BucketKey, int], Set] = {}
+        self._primed: Dict[Tuple[BucketKey, int], Set] = {}  # guarded by: _lock
         # single-flight cold builds: (key, batch) -> Event while one
         # thread builds.  The replica worker pool spreads a same-bucket
         # burst across lanes on purpose, so without this every lane
         # would pay the full trace+compile (~10-25 s per f64 shape) for
         # the SAME executable; the pre-placement single worker
         # serialized builds for free
-        self._building: Dict[Tuple[BucketKey, int], threading.Event] = {}
+        self._building: Dict[Tuple[BucketKey, int], threading.Event] = {}  # guarded by: _lock
         # per-executable cost/memory registry (aux/devmon build-time
         # capture): (key, batch) -> {"flops", "bytes_accessed",
         # "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
         # ...}.  Persisted beside each manifest entry ("cost" field) so
         # a restored process has the evidence without recapturing
-        self._costs: Dict[Tuple[BucketKey, int], dict] = {}
+        self._costs: Dict[Tuple[BucketKey, int], dict] = {}  # guarded by: _lock
         self.artifacts: Optional[ArtifactStore] = store_from_env(artifact_dir)
         self.manifest_path = (
             manifest_path
@@ -785,7 +789,11 @@ class ExecutableCache:
                 on_error(key, batch, e)
                 yield key, batch, "failed", None
                 continue
-            origin = self._origin.get((key, batch), "compile")
+            # under the lock: a worker-thread cold build may be
+            # writing _origin concurrently with this pass (a true
+            # positive the whole-program guarded-by run surfaced)
+            with self._lock:
+                origin = self._origin.get((key, batch), "compile")
             if live:
                 # the executable predates this pass; only new devices
                 # were primed — no fresh restore/compile to report, but

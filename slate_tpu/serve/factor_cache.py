@@ -62,14 +62,13 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 import numpy as np
 
-from ..aux import metrics
+from ..aux import metrics, sync
 from .buckets import BucketKey
 
 FACTOR_CACHE_ENV = "SLATE_TPU_FACTOR_CACHE"
@@ -268,7 +267,7 @@ def residual_ok(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> bool:
 # jitted rank-k Cholesky up/downdate, cached per (downdate, shape/dtype
 # via jax's own cache); downdate is a static python bool
 _update_jits: Dict[bool, object] = {}
-_update_lock = threading.Lock()
+_update_lock = sync.Lock(name="factor_cache._update_lock")
 
 
 def _chol_update_jit(downdate: bool):
@@ -305,9 +304,13 @@ class FactorCache:
     ):
         self.max_entries = max(int(max_entries), 1)
         self.max_bytes = max(int(max_bytes), 1)
-        self._lock = threading.RLock()
-        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()
-        self._bytes = 0
+        # sync.RLock: plain threading.RLock unless SLATE_TPU_SYNC_CHECK
+        # armed the race plane.  Admission and every replica worker
+        # race on the LRU — the annotations are ground truth for the
+        # lock-discipline / race-guarded-by lint rules
+        self._lock = sync.RLock(name="factor_cache.FactorCache._lock")
+        self._entries: "OrderedDict[str, FactorEntry]" = OrderedDict()  # guarded by: _lock
+        self._bytes = 0  # guarded by: _lock
 
     # -- introspection -----------------------------------------------------
 
@@ -345,6 +348,7 @@ class FactorCache:
         or None.  Does NOT count hit/miss — the service counts those at
         the dispatch that actually serves (or misses) the factor."""
         with self._lock:
+            sync.guarded(self, "_entries")  # race-plane probe (no-op off)
             entry = self._entries.get(fp)
             if entry is not None:
                 self._entries.move_to_end(fp)
@@ -362,6 +366,7 @@ class FactorCache:
             record("uncacheable", fp=entry.fp, label=entry.key.label)
             return False
         with self._lock:
+            sync.guarded(self, "_entries")  # race-plane probe (no-op off)
             old = self._entries.pop(entry.fp, None)
             if old is not None:
                 self._bytes -= old.nbytes

@@ -168,7 +168,7 @@ from typing import Deque, Dict, List, Optional, Union
 
 import numpy as np
 
-from ..aux import devmon, faults, metrics, spans
+from ..aux import devmon, faults, metrics, spans, sync
 from ..exceptions import InvalidInput, NumericalError, SlateError
 from ..integrity import abft as _abft
 from ..integrity import policy as _integ
@@ -296,14 +296,18 @@ class _HedgeGroup:
     must never fail a request its twin can still answer)."""
 
     def __init__(self, members: int = 2):
-        self.lock = threading.Lock()
+        # sync.Lock: a plain threading.Lock unless SLATE_TPU_SYNC_CHECK
+        # armed the race plane (construction-time decision, zero
+        # overhead off)
+        self.lock = sync.Lock(name="service._HedgeGroup.lock")
         self.members = members
-        self.delivered = False
-        self.failed = 0
+        self.delivered = False  # guarded by: lock
+        self.failed = 0  # guarded by: lock
 
     def first_result(self) -> bool:
         """Claim the win; False when a twin already delivered."""
         with self.lock:
+            sync.guarded(self, "delivered")
             if self.delivered:
                 return False
             self.delivered = True
@@ -314,6 +318,7 @@ class _HedgeGroup:
         live member and nothing delivered — only then may the caller
         set the exception."""
         with self.lock:
+            sync.guarded(self, "failed")
             self.failed += 1
             return not self.delivered and self.failed >= self.members
 
@@ -611,7 +616,10 @@ class SolverService:
         self.restore_stuck_after_s = float(restore_stuck_after_s)
         self._restore_started: Optional[float] = None
         self._rng = random.Random(retry_seed)
-        self._cond = threading.Condition()
+        # the ONE service lock (workers, admission, health, drain all
+        # meet here) — instrumented under SLATE_TPU_SYNC_CHECK, a plain
+        # threading.Condition otherwise
+        self._cond = sync.Condition(name="service.SolverService._cond")
         self._running = False
         self._stopped = False  # stop() called; submit() rejects until start()
         # the replicated tier: one lane per replica (replica i pins to
@@ -853,6 +861,7 @@ class SolverService:
             self._stopped = True
             leftovers: List[_Request] = []
             for rep in self._lanes:
+                sync.guarded(rep, "q")
                 leftovers.extend(rep.q)
                 rep.q.clear()
             # zero the per-replica queue gauges too, or a metrics dump
@@ -1017,10 +1026,17 @@ class SolverService:
                     spans.event(
                         "shed", trace=_trace, lane="client", tenant=tname,
                         priority=_bk.priority_name(prio),
-                        level=adm.overload.level,
+                        # deliberately lock-free level read: span attrs
+                        # tolerate a stale value, and taking the
+                        # admission lock on every shed would serialize
+                        # the O(1) refusal path the plane exists for
+                        level=adm.overload.level,  # slate-lint: disable=race-guarded-by
                     )
                 raise Shed(
-                    f"{routine}: overload level {adm.overload.level} "
+                    # deliberately lock-free: the error string tolerates
+                    # a stale level (the shed verdict itself was taken
+                    # under adm's own locking in sheds())
+                    f"{routine}: overload level {adm.overload.level} "  # slate-lint: disable=race-guarded-by
                     f"is shedding {_bk.priority_name(prio)}-priority "
                     "traffic; back off or raise priority"
                 ).with_context(
@@ -1232,6 +1248,7 @@ class SolverService:
                 req.qspan = spans.start(
                     "queued", trace=_trace, parent=_root, lane=rep.lane,
                 )
+            sync.guarded(rep, "q")  # race-plane lockset probe (no-op off)
             rep.q.append(req)
             self._gauge_queues_locked()
             self._cond.notify_all()
@@ -1511,6 +1528,7 @@ class SolverService:
         respawn the worker."""
         metrics.inc("serve.worker_restarts")
         with self._cond:
+            sync.guarded(rep, "inflight")
             inflight, rep.inflight = rep.inflight, []
             rep.restarts += 1
             self._restarts += 1
@@ -1543,10 +1561,12 @@ class SolverService:
             if not batch:
                 continue
             with self._cond:
+                sync.guarded(rep, "inflight")
                 rep.inflight = batch
             faults.check("worker_death")  # in-flight: supervision must cover
             self._execute(rep, batch)
             with self._cond:
+                sync.guarded(rep, "inflight")
                 rep.inflight = []
 
     def _pop_eligible_locked(
@@ -1556,6 +1576,7 @@ class SolverService:
         — or, with the admission plane on, the weighted-fair choice
         across tenants (FairQueue's virtual-time schedule; FIFO within
         a tenant, and exactly FIFO with a single tenant)."""
+        sync.guarded(rep, "q")  # race-plane lockset probe (no-op off)
         if self._admission is not None:
             return rep.q.pop_eligible(now)
         for i, r in enumerate(rep.q):
@@ -1863,6 +1884,7 @@ class SolverService:
                     "queued", trace=r.trace, parent=r.span, lane=rep.lane,
                     retry=True,
                 )
+            sync.guarded(rep, "q")
             rep.q.appendleft(r)
             self._cond.notify_all()
 
@@ -2514,6 +2536,7 @@ class SolverService:
                                 parent=req.span, lane=other.lane,
                                 hedge=True,
                             )
+                        sync.guarded(other, "q")
                         other.q.appendleft(req)
                         self._gauge_queues_locked()
                         self._cond.notify_all()
@@ -2660,6 +2683,7 @@ class SolverService:
                         "hedge", trace=r.trace, lane=tgt.lane,
                         reason="straggler", age_s=round(age, 4),
                     )
+                sync.guarded(tgt, "q")
                 tgt.q.appendleft(clone)
                 hedged = True
         if hedged:
@@ -2695,6 +2719,9 @@ def _finish_spans(req: Optional[_Request], outcome: str) -> None:
 
 def _resolve(fut: Future, value, req: Optional[_Request] = None) -> None:
     _finish_spans(req, "ok")
+    # race plane: the worker's writes to the result happen-before any
+    # thread that reads it off the future (one bool when off)
+    sync.hb_publish(fut)
     g = req.hedge_group if req is not None else None
     if g is not None:
         # first correct result wins the shared future; the loser's
@@ -2715,6 +2742,7 @@ def _resolve_exc(
     fut: Future, exc: Exception, req: Optional[_Request] = None
 ) -> None:
     _finish_spans(req, type(exc).__name__)
+    sync.hb_publish(fut)  # hand-off edge, as in _resolve
     if req is not None and isinstance(exc, SlateError):
         exc.with_context(
             routine=req.routine,

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""race-report: judge a sync-runtime dump, or check the static
+lock-order graph against the checked-in artifact.
+
+    python tools/race_report.py /tmp/sync.json       # judge a stress run
+    python tools/race_report.py --check-graph        # artifact freshness
+
+Dump mode reads the JSON ``aux/sync.dump()`` wrote after an
+instrumented stress run (``SLATE_TPU_SYNC_CHECK=1`` — see the README
+"Race & deadlock detection" section): it prints every violation with
+both stacks (the two halves of a lock-order inversion, or the two
+unordered accesses of an unguarded field) plus the observed acquisition
+edges, and exits nonzero when ANY violation was recorded — the
+``run_tests.py --race`` gate runs it over the clean serve stress leg
+(must exit 0) and over the two planted-fixture legs (must exit
+nonzero; a verdict tool that cannot fail proves nothing).
+
+``--check-graph`` recomputes the static lock-order graph
+(``slate_tpu/analysis/races.py``) and compares it with the checked-in
+``LOCK_ORDER.json``: exits nonzero on a cycle, a new edge, a stale
+artifact edge, or a missing artifact.  Regenerate after review with
+``tools/slate_lint.py --write-lock-graph``.
+
+Stdlib-only, loads the analysis package by file path (the slate_lint
+pattern), so the verdict survives an import-broken library tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """slate_tpu/analysis without executing slate_tpu/__init__ (which
+    imports jax) — shared spelling with tools/slate_lint.py."""
+    name = "slate_lint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(_ROOT, "slate_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _indent(stack: str, pad: str = "    | ") -> str:
+    return "\n".join(pad + ln for ln in (stack or "<no stack>").splitlines())
+
+
+def judge_dump(path: str, verbose: bool = True) -> int:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    violations = doc.get("violations", [])
+    edges = doc.get("edges", [])
+    print(
+        f"race-report: {len(violations)} violation(s), "
+        f"{len(edges)} observed lock-order edge(s), "
+        f"{doc.get('fields', 0)} probed field(s) "
+        f"(seed={doc.get('seed')}, yield_p={doc.get('yield_p')})"
+    )
+    for i, v in enumerate(violations, 1):
+        kind = v.get("kind", "?")
+        print(f"\n[{i}] {kind}: {v.get('detail', '')}")
+        stacks = v.get("stacks", [])
+        labels = (
+            ("first ordering established at", "inverted at")
+            if kind == "lock_order"
+            else ("previous access", "conflicting access")
+        )
+        for label, stack in zip(labels, stacks):
+            print(f"  {label}:")
+            if verbose:
+                print(_indent(stack))
+    if violations:
+        print(
+            "\nrace-report: FAIL — re-run the stress leg with the same "
+            f"SLATE_TPU_SYNC_CHECK spec (seed={doc.get('seed')}) to "
+            "replay the schedule"
+        )
+        return 1
+    print("race-report: clean")
+    return 0
+
+
+def check_graph(root: str) -> int:
+    analysis = _load_analysis()
+    races = analysis.races
+    loaded = analysis.core.load_project(root)
+    edges = races.lock_graph(loaded.project)
+    cycles = races.graph_cycles(edges)
+    rc = 0
+    for comp in cycles:
+        print(f"race-report: lock-order CYCLE: {' <-> '.join(comp)}")
+        rc = 1
+    known = races.load_graph_artifact(root)
+    if known is None:
+        print(
+            f"race-report: no {races.LOCK_GRAPH_NAME} at the repo root "
+            "— generate it with tools/slate_lint.py --write-lock-graph"
+        )
+        return 1
+    cur = set(edges)
+    for a, b in sorted(cur - known):
+        print(
+            f"race-report: NEW edge {a} -> {b} (via {edges[(a, b)]}) "
+            f"not in {races.LOCK_GRAPH_NAME} — review, then regenerate"
+        )
+        rc = 1
+    for a, b in sorted(known - cur):
+        print(
+            f"race-report: STALE artifact edge {a} -> {b} no longer in "
+            "the tree — regenerate"
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"race-report: lock-order graph OK ({len(cur)} edge(s), "
+            "acyclic, artifact in sync)"
+        )
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", default=None,
+                    help="sync-runtime JSON dump to judge")
+    ap.add_argument("--check-graph", action="store_true",
+                    help="check the static lock-order graph against "
+                         "the checked-in artifact instead")
+    ap.add_argument("--root", default=_ROOT,
+                    help="repo root for --check-graph")
+    ap.add_argument("--quiet", action="store_true",
+                    help="omit the violation stacks")
+    args = ap.parse_args(argv)
+    if args.check_graph:
+        return check_graph(args.root)
+    if args.dump is None:
+        ap.error("need a dump path (or --check-graph)")
+    return judge_dump(args.dump, verbose=not args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

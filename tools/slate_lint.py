@@ -69,12 +69,25 @@ def main(argv=None) -> int:
                          f"{analysis.BASELINE_NAME})")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept the run's findings as the new baseline")
+    ap.add_argument("--write-lock-graph", action="store_true",
+                    help="regenerate the checked-in lock-order graph "
+                         f"artifact (<root>/{analysis.LOCK_GRAPH_NAME}) "
+                         "from the current tree")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for name in sorted(analysis.RULES):
             r = analysis.RULES[name]
             print(f"{name:18} {r.summary}")
+        return 0
+
+    if args.write_lock_graph:
+        loaded = analysis.core.load_project(args.root)
+        path = analysis.races.write_graph_artifact(
+            args.root, loaded.project
+        )
+        n = len(analysis.races.lock_graph(loaded.project))
+        print(f"lock-order graph written: {path} ({n} edge(s))")
         return 0
 
     if args.write_baseline and args.rules:
