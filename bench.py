@@ -771,6 +771,62 @@ def main(argv=None):
 
     run_entry("serve_multitenant", entry_serve_multitenant)
 
+    # -- sustained soak throughput: the soak fabric's open-loop replay
+    # (multitenant + repeated-A mix, all serve planes armed) through a
+    # warm service.  The headline is delivered req/s at the offered
+    # rate's ceiling plus the client-observed p99 — the number the
+    # --soak gate budgets against, tracked here so regressions show up
+    # in bench_diff before they show up as a red gate ------------------
+    def entry_soak_sustained():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.serve import buckets as _bk
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.factor_cache import FactorCache
+        from slate_tpu.serve.service import SolverService
+        from slate_tpu.soak import replay as _rp
+
+        reqs = 4000 if on_tpu else 1200
+        svc = SolverService(
+            cache=ExecutableCache(manifest_path=None), batch_max=8,
+            batch_window_s=0.001, dim_floor=16, nrhs_floor=4,
+            factor_cache=FactorCache(max_entries=32),
+            tenants="gold:weight=4;good:weight=2;free:rate=400,share=0.5",
+            adaptive=True, latency_budget_s=0.5,
+        )
+        try:
+            for routine, n in (("gesv", 12), ("posv", 12), ("gesv", 24)):
+                k = _bk.bucket_for(routine, n, n, 2, np.float64,
+                                   floor=16, nrhs_floor=4)
+                svc.cache.ensure_manifest(k, (1, 8))
+                svc.cache.ensure_manifest(k.solve_sibling(), (1, 8))
+            svc.warmup()
+            spec = _rp.merge_specs(
+                _rp.gen_multitenant(reqs // 2, seed=1, rate_rps=500.0),
+                _rp.gen_repeated_a(reqs // 2, seed=2, rate_rps=500.0,
+                                   distinct=8),
+            )
+            # factor the pools before measuring: steady-state numbers,
+            # not cold-cache numbers (the --soak gate does the same)
+            _rp.replay(svc, _rp.warm_spec(spec, gap_s=0.01), speed=1.0,
+                       seed=0, check_results=False)
+            with _m.deltas() as d:
+                res = _rp.replay(svc, spec, speed=4.0, seed=0,
+                                 check_results=False)
+                compiles = int(d.get("jit.compilations"))
+        finally:
+            svc.stop()
+        return {
+            "requests": res["submitted"],
+            "delivered": res["delivered"],
+            "refused": res["refused"],
+            "requests_per_s": round(res["requests_per_s"], 1),
+            "p50_s": res["p50_s"], "p99_s": res["p99_s"],
+            "seconds": round(res["wall_s"], 3),
+            "steady_compiles": compiles,
+        }
+
+    run_entry("soak_sustained", entry_soak_sustained)
+
     # -- two-stage heev values (he2hb + bulge chase + bisection) ----------
     nh = 1024 if on_tpu else 96
 

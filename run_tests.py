@@ -1705,6 +1705,256 @@ def lint_gate() -> int:
     return 0
 
 
+# Soak driver: ~10^4 requests (x100 with SLATE_SOAK_SCALE=full)
+# replayed open-loop against ONE service with EVERY plane armed at
+# once — batching, factor cache, tenants+adaptive admission, deadline
+# traffic, integrity certification with hedging and quarantine — while
+# latency/SDC/worker-death faults fire and the health timeline
+# samples.  Phase 2 is the record->replay round trip: a low-rate
+# stream is recorded off the live delivery tap, the RECORDING is
+# replayed twice (same spec, same seed), and the driver asserts the
+# workload-mix histograms agree and the two runs land within the
+# documented tolerance.  tools/soak_report.py judges the dump.
+_SOAK_DRIVER = """
+import os
+import sys
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.integrity import policy as ipol
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import FactorCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.soak import record, replay
+from slate_tpu.soak.timeline import TimelineSampler
+
+full = os.environ.get("SLATE_SOAK_SCALE") == "full"
+S = 100 if full else 1
+metrics.on()
+metrics.reset()
+spans.on(ring=262144 if full else 65536)
+svc = SolverService(
+    cache=ExecutableCache(manifest_path=None), batch_max=8,
+    batch_window_s=0.001, dim_floor=16, nrhs_floor=4, replicas=2,
+    retry_backoff_s=0.002, breaker_cooldown_s=0.02, retry_seed=0,
+    factor_cache=FactorCache(max_entries=64),
+    tenants="gold:weight=4;good:weight=2;free:rate=300,share=0.5;"
+            "abuser:rate=60,burst=16,share=0.25",
+    adaptive=True, latency_budget_s=0.5,
+    integrity=ipol.parse_spec("full,hedge=1.5,cooldown=0.25"),
+)
+for rt, n in (("gesv", 12), ("posv", 12), ("gesv", 24)):
+    k = bk.bucket_for(rt, n, n, 2, np.float64, floor=16, nrhs_floor=4)
+    svc.cache.ensure_manifest(k, (1, 8))
+    # the factor cache dispatches hits onto the solve-phase sibling:
+    # omit it from warmup and the soak compiles mid-run
+    svc.cache.ensure_manifest(k.solve_sibling(), (1, 8))
+svc.warmup()
+
+spec = replay.merge_specs(
+    replay.gen_repeated_a(5000 * S, seed=2, rate_rps=240, distinct=10),
+    replay.gen_repeated_a(1500 * S, seed=3, rate_rps=75, distinct=4,
+                          routine="posv"),
+    replay.gen_multitenant(1800 * S, seed=1, rate_rps=88),
+    replay.gen_deadline_storm(800 * S, seed=4, rate_rps=40),
+    replay.gen_adversarial_flood(900 * S, seed=5, rate_rps=45),
+)
+rt_spec = replay.merge_specs(
+    replay.gen_multitenant(700, seed=11, rate_rps=70),
+    replay.gen_repeated_a(500, seed=12, rate_rps=60, distinct=5),
+)
+# pool-warm BOTH phases' factors, then zero the books: the soak
+# measures the steady state (0 compiles, warm factor cache)
+replay.replay(svc, replay.warm_spec(spec), speed=1.0, seed=0)
+replay.replay(svc, replay.warm_spec(rt_spec), speed=1.0, seed=0)
+metrics.reset()
+
+faults.configure("latency:every=97,ms=30;sdc_solve:every=211,seed=3;"
+                 "worker_death:every=1501")
+faults.on()
+sampler = TimelineSampler(svc, period_s=0.05).start()
+res = replay.replay(svc, spec, speed=1.0, seed=0)
+faults.reset()
+assert res["submitted"] == (res["delivered"] + res["typed_errors"]
+                            + res["refused"]), res
+print(f"soak main: {res['submitted']} submitted, "
+      f"{res['delivered']} delivered, {res['typed_errors']} typed, "
+      f"{res['refused']} refused, {res['bad_results']} bad, "
+      f"{res['requests_per_s']} req/s, "
+      f"p99={(res['p99_s'] or 0) * 1e3:.1f}ms")
+
+# ---- phase 2: record -> replay round trip + determinism ------------
+rec = record.Recorder().attach()
+rt_res = replay.replay(svc, rt_spec, speed=1.0, seed=0)
+rec.detach()
+recorded = rec.rows()
+assert len(recorded) == rt_res["delivered"] + rt_res["typed_errors"], (
+    len(recorded), rt_res)
+mix_in = record.mix_histogram(recorded)
+
+runs = []
+for i in (0, 1):
+    r2 = record.Recorder().attach()
+    runs.append((replay.replay(svc, recorded, speed=1.0, seed=0),
+                 record.mix_histogram(r2.detach().rows())))
+mix_out = runs[0][1]
+
+def close(a, b, what):
+    assert set(a) == set(b), (what, sorted(a), sorted(b))
+    for key in a:
+        tol = max(5, int(0.05 * a[key]))
+        assert abs(a[key] - b[key]) <= tol, (what, key, a[key], b[key])
+
+close(mix_in["tenants"], mix_out["tenants"], "tenants")
+close(mix_in["priorities"], mix_out["priorities"], "priorities")
+close(mix_in["shapes"], mix_out["shapes"], "shapes")
+# repeat groups: fingerprints are of the matrix BYTES, which differ
+# between original and regenerated operands — the preserved invariant
+# is the group-size structure, not the fingerprint values
+gs_in = sorted(mix_in["repeat_groups"].values())
+gs_out = sorted(mix_out["repeat_groups"].values())
+assert abs(len(gs_in) - len(gs_out)) <= 1, (gs_in, gs_out)
+assert abs(sum(gs_in) - sum(gs_out)) <= max(10, int(0.05 * sum(gs_in)))
+# determinism: same recorded spec + same seed, twice — delivered
+# tallies agree within the documented tolerance (scheduling jitter
+# moves a few requests between delivered and shed, never the sum)
+(ra, _), (rb, _) = runs
+for r in (ra, rb):
+    assert r["submitted"] == (r["delivered"] + r["typed_errors"]
+                              + r["refused"]), r
+tol = max(10, int(0.02 * ra["submitted"]))
+assert abs(ra["delivered"] - rb["delivered"]) <= tol, (ra, rb)
+print(f"round trip: {len(recorded)} recorded, mixes agree; "
+      f"determinism: {ra['delivered']} vs {rb['delivered']} delivered")
+
+pressure = spans.pressure()
+if pressure["evicted"] == 0:
+    replay.orphan_spans()  # publishes the soak.orphan_spans gauge
+else:  # an evicting ring fabricates orphans; report skips the check
+    print(f"span ring evicted {pressure['evicted']} - orphan audit "
+          "skipped")
+sampler.stop()
+svc.stop(drain=True, drain_timeout=300)
+c = metrics.counters()
+assert c["serve.requests"] == c["soak.submitted"] - c["soak.refused"], (
+    c["serve.requests"], c["soak.submitted"], c["soak.refused"])
+metrics.dump()
+print("soak driver: all phases complete, books reconcile")
+"""
+
+# Negative leg: the SAME SDC corruption with the integrity plane AND
+# the factor-cache residual fence disarmed must deliver wrong answers
+# to the replay engine's client-side check (soak.bad_results > 0) and
+# the soak report over that JSONL must exit NONZERO.
+_SOAK_ESCAPE_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.soak import replay
+from slate_tpu.soak.timeline import TimelineSampler
+
+metrics.on()
+metrics.reset()
+spans.on(ring=8192)
+svc = SolverService(cache=ExecutableCache(manifest_path=None),
+                    batch_max=8, batch_window_s=0.001, dim_floor=16,
+                    nrhs_floor=4, replicas=2, factor_cache=False,
+                    integrity=False)
+assert svc._integrity is None
+k = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=16, nrhs_floor=4)
+svc.cache.ensure_manifest(k, (1, 8))
+svc.warmup()
+metrics.reset()
+spec = replay.gen_repeated_a(400, seed=7, rate_rps=200, distinct=4)
+faults.configure("sdc_solve:every=7,seed=5")
+faults.on()
+sampler = TimelineSampler(svc, period_s=0.05).start()
+res = replay.replay(svc, spec, speed=1.0, seed=0)
+faults.reset()
+sampler.stop()
+replay.orphan_spans()  # publishes the soak.orphan_spans gauge
+svc.stop(drain=True, drain_timeout=120)
+metrics.dump()
+assert res["bad_results"] > 0, (
+    "undefended soak delivered no wrong X (site dead?)", res)
+print(f"escape driver: {res['bad_results']} silent wrong answers "
+      "delivered (integrity off, as designed)")
+"""
+
+
+def soak_gate(full: bool = False) -> int:
+    """Trace-driven soak gate, three legs: (1) the soak suite
+    (recorder/replay/timeline units, all-planes health shape,
+    metrics_merge); (2) the soak drill — ~10^4 requests (~10^6 with
+    ``--full``) against a fully-armed 2-replica service under
+    latency/SDC/worker-death faults, with the record->replay round
+    trip and the two-run determinism check inline — judged by
+    tools/soak_report.py (exit 0: books reconcile, zero escapes, zero
+    orphans, tails in budget, compile-free steady state, every
+    disruption recovered); (3) the escape proof: the same SDC with
+    every defense disarmed must make the report exit NONZERO."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_soak.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_soak_") as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for var in ("SLATE_TPU_FAULTS", "SLATE_TPU_FACTOR_CACHE",
+                    "SLATE_TPU_TENANTS", "SLATE_TPU_ADAPTIVE",
+                    "SLATE_TPU_INTEGRITY", "SLATE_TPU_WARMUP",
+                    "SLATE_TPU_ARTIFACTS"):
+            env.pop(var, None)
+        jsonl = os.path.join(td, "soak.jsonl")
+        denv = dict(env, SLATE_TPU_METRICS=jsonl)
+        if full:
+            denv["SLATE_SOAK_SCALE"] = "full"
+        rc = subprocess.call(
+            [sys.executable, "-c", _SOAK_DRIVER], env=denv, cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "soak_report.py"),
+             jsonl, "--p99-budget-ms", "2000",
+             "--tenant-p99-budget-ms", "2000",
+             "--min-timeline-rows", "50",
+             "--min-delivered", str(500000 if full else 5000)],
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        # escape leg: defenses off, same SDC — the report MUST flag
+        # the run (a verdict tool that cannot fail proves nothing)
+        esc = os.path.join(td, "escape.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _SOAK_ESCAPE_DRIVER],
+            env=dict(env, SLATE_TPU_METRICS=esc), cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "soak_report.py"), esc],
+            cwd=here,
+        )
+        if rc == 0:
+            print("soak gate: report failed to flag an undefended "
+                  "SDC escape")
+            return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier1", action="store_true",
@@ -1770,6 +2020,17 @@ def main() -> int:
                          "SLATE_TPU_SYNC_CHECK judged by "
                          "tools/race_report.py, and two planted "
                          "fixtures the report MUST flag")
+    ap.add_argument("--soak", action="store_true",
+                    help="run the trace-driven soak gate: the soak "
+                         "suite + ~10^4 replayed requests against a "
+                         "fully-armed service under faults with the "
+                         "record->replay round trip and determinism "
+                         "checks, judged by tools/soak_report.py, + "
+                         "the escape proof (defenses off -> report "
+                         "nonzero)")
+    ap.add_argument("--full", action="store_true",
+                    help="with --soak: scale the drill to ~10^6 "
+                         "requests (tens of minutes)")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -1804,6 +2065,8 @@ def main() -> int:
         return lint_gate()
     if args.race:
         return race_gate()
+    if args.soak:
+        return soak_gate(full=args.full)
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

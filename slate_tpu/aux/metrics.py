@@ -94,10 +94,13 @@ _timers: Dict[str, List[float]] = {}
 _hists: Dict[str, "Histogram"] = {}
 _events: List[dict] = []
 _costs: Dict[str, dict] = {}
+_timeline: List[dict] = []
 _context = threading.local()
 
 _MAX_EVENTS = 200_000
+_MAX_TIMELINE = 100_000
 _dropped_events = 0
+_dropped_timeline = 0
 
 
 # ---------------------------------------------------------------------------
@@ -125,7 +128,7 @@ def is_on() -> bool:
 
 def reset() -> None:
     """Clear every counter/gauge/timer/event (keeps on/off state)."""
-    global _t0, _dropped_events
+    global _t0, _dropped_events, _dropped_timeline
     with _lock:
         _counters.clear()
         _gauges.clear()
@@ -133,7 +136,9 @@ def reset() -> None:
         _hists.clear()
         _events.clear()
         _costs.clear()
+        _timeline.clear()
         _dropped_events = 0
+        _dropped_timeline = 0
         _t0 = time.perf_counter() if _enabled else None
 
 
@@ -171,6 +176,39 @@ def observe(name: str, seconds: float) -> None:
             t[1] += seconds
             t[2] = min(t[2], seconds)
             t[3] = max(t[3], seconds)
+
+
+# -- timeline rows (sampled time-series snapshots; the soak plane) ----------
+
+
+def record_timeline(fields: Dict[str, Any]) -> None:
+    """Append one time-series sample row (the soak fabric's
+    ``{"type": "timeline"}`` JSONL rows — ``soak/timeline.py`` samples
+    ``health()`` + devmon gauges through here on a background cadence).
+    Every existing summary line is an END-OF-RUN aggregate; these rows
+    are the mid-run trajectory — a quarantine storm that engaged and
+    recovered before the dump is invisible to every other row type.
+    Bounded like ``_events`` (oldest kept, newest dropped past
+    :data:`_MAX_TIMELINE`, drop count surfaced in the meta line); a
+    ``t`` stamp relative to the registry clock is added when absent.
+    No-op (one bool check) when metrics are off."""
+    if not _enabled:
+        return
+    global _dropped_timeline
+    with _lock:
+        if len(_timeline) >= _MAX_TIMELINE:
+            _dropped_timeline += 1
+            return
+        row = dict(fields)
+        if "t" not in row:
+            row["t"] = round(time.perf_counter() - (_t0 or 0.0), 6)
+        _timeline.append(row)
+
+
+def timeline() -> List[dict]:
+    """Snapshot of the recorded timeline rows, oldest first."""
+    with _lock:
+        return [dict(r) for r in _timeline]
 
 
 # -- bounded-cardinality key families ---------------------------------------
@@ -879,15 +917,21 @@ def dump(path: Optional[str] = None) -> Optional[str]:
             for k, h in _hists.items() if h.count
         }
         costsnap = {k: dict(v) for k, v in _costs.items()}
+        tlsnap = [dict(r) for r in _timeline]
         dropped = _dropped_events
+        dropped_tl = _dropped_timeline
     with open(path, "w") as f:
         meta = {"type": "meta", "schema": 1, "unix_time": time.time(),
                 "pid": os.getpid()}
         if dropped:
             meta["dropped_events"] = dropped
+        if dropped_tl:
+            meta["dropped_timeline"] = dropped_tl
         f.write(json.dumps(meta) + "\n")
         for ev in events:
             f.write(json.dumps({"type": "event", **ev}) + "\n")
+        for row in tlsnap:
+            f.write(json.dumps({"type": "timeline", **row}) + "\n")
         for name in sorted(csnap):
             f.write(json.dumps(
                 {"type": "counter", "name": name, "value": csnap[name]}

@@ -173,6 +173,32 @@ def evicted() -> int:
     return _evicted
 
 
+def pressure() -> dict:
+    """Eviction-pressure snapshot of the flight recorder: capacity,
+    current fill, lifetime evictions, and the estimated coverage
+    window (newest end minus oldest start across the ring) — the span
+    of history a ring->spec soak recording can still reconstruct.  A
+    nonzero ``evicted`` with a short ``window_s`` means a recording
+    taken NOW is already truncated; ``health()["trace_ring"]``
+    surfaces this so the gap is visible before it becomes a silently
+    short load spec."""
+    with _lock:
+        size = len(_ring)
+        if size:
+            oldest = _ring[0]
+            newest = _ring[-1]
+            window = (newest.t_end if newest.t_end is not None
+                      else newest.t_start) - oldest.t_start
+        else:
+            window = 0.0
+        return {
+            "capacity": _ring.maxlen or 0,
+            "size": size,
+            "evicted": _evicted,
+            "window_s": round(max(window, 0.0), 6),
+        }
+
+
 def new_trace() -> str:
     """A fresh trace id (one per serve request)."""
     return f"t{os.getpid():x}-{next(_trace_ids):x}"

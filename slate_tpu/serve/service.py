@@ -164,7 +164,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Union
+from typing import Callable, Deque, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -1335,7 +1335,9 @@ class SolverService:
         (last 60 s over a bounded window), and — with metrics on — the
         SLO surface: per-bucket p50/p95/p99 total latency
         (``latency``) and the deadline-budget burn tiers
-        (``slo_burn``) — and, with devmon on (``SLATE_TPU_DEVMON=1``),
+        (``slo_burn``) — with span tracing on, the flight recorder's
+        eviction pressure (``trace_ring``: capacity/size/evicted/
+        coverage window) — and, with devmon on (``SLATE_TPU_DEVMON=1``),
         the device surface: the per-bucket build-time cost/memory
         registry (``cost``: flops/bytes + argument/output/temp/peak
         bytes per batch point), each latency row's ``peak_bytes``
@@ -1451,6 +1453,11 @@ class SolverService:
         # because big" vs "slow because cold"), and a per-device
         # memory snapshot (bytes_in_use None on backends without
         # memory_stats — graceful, never a crash)
+        # span-ring eviction pressure (None with tracing off): a soak
+        # recording taken off a ring that has been silently evicting
+        # is already truncated — surface capacity/evicted/coverage so
+        # the gap is visible in the probe, not in a short load spec
+        trace_ring = spans.pressure() if spans.is_on() else None
         cost = devices = None
         if devmon.is_on():
             cost = self.cache.costs_by_label() or None
@@ -1486,6 +1493,7 @@ class SolverService:
             "sharded": shard_lane,
             "latency": latency,
             "slo_burn": slo_burn,
+            "trace_ring": trace_ring,
             "cost": cost,
             "devices": devices,
             "factor_cache": (
@@ -2707,6 +2715,42 @@ def _cert_operand(req: _Request) -> np.ndarray:
     return np.tril(A) + np.conj(np.tril(A, -1)).T
 
 
+# -- delivery taps (the soak recorder's hook) -------------------------------
+#
+# Module-level observers of request resolution: each tap is called
+# ``tap(req, outcome)`` exactly where the request's future is about to
+# resolve (outcome "ok" or the exception class name).  Zero overhead
+# unarmed — the hot path pays ONE truthiness check on an empty list —
+# and a tap can never break delivery (exceptions are swallowed).  A
+# hedged pair fires once per member resolution; consumers that want
+# one event per client request dedup on ``id(req.future)`` (twins
+# share the future).  soak/record.py is the only in-tree consumer.
+
+_delivery_taps: List[Callable[["_Request", str], None]] = []
+
+
+def add_delivery_tap(fn: Callable[["_Request", str], None]) -> None:
+    """Register a delivery observer (idempotent per function)."""
+    if fn not in _delivery_taps:
+        _delivery_taps.append(fn)
+
+
+def remove_delivery_tap(fn: Callable[["_Request", str], None]) -> None:
+    """Unregister a delivery observer (missing fn is a no-op)."""
+    try:
+        _delivery_taps.remove(fn)
+    except ValueError:
+        pass
+
+
+def _fire_delivery_taps(req: "_Request", outcome: str) -> None:
+    for tap in list(_delivery_taps):
+        try:
+            tap(req, outcome)
+        except Exception:
+            pass  # observability must never break delivery
+
+
 def _finish_spans(req: Optional[_Request], outcome: str) -> None:
     """Close a request's span chain at resolution: any still-open
     queued span, then the root (idempotent — the first outcome wins,
@@ -2719,6 +2763,8 @@ def _finish_spans(req: Optional[_Request], outcome: str) -> None:
 
 def _resolve(fut: Future, value, req: Optional[_Request] = None) -> None:
     _finish_spans(req, "ok")
+    if _delivery_taps and req is not None:
+        _fire_delivery_taps(req, "ok")
     # race plane: the worker's writes to the result happen-before any
     # thread that reads it off the future (one bool when off)
     sync.hb_publish(fut)
@@ -2742,6 +2788,8 @@ def _resolve_exc(
     fut: Future, exc: Exception, req: Optional[_Request] = None
 ) -> None:
     _finish_spans(req, type(exc).__name__)
+    if _delivery_taps and req is not None:
+        _fire_delivery_taps(req, type(exc).__name__)
     sync.hb_publish(fut)  # hand-off edge, as in _resolve
     if req is not None and isinstance(exc, SlateError):
         exc.with_context(

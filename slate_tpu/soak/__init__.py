@@ -1,0 +1,38 @@
+"""Trace-driven soak fabric: record real workload shape, replay it
+deterministically at scale with every serve plane armed at once, and
+watch the whole system through one time-series lens.
+
+The serve tier is traced, fair, certified, quarantine-capable, and
+race-checked — but each plane is judged by its own gate in isolation.
+This package closes the loop (ROADMAP item 7b; Dapper-style workload
+reconstruction from the span ring, PAPERS.md "Tracing"; the
+tail-at-scale effect only accumulates under sustained mixed load,
+Dean & Barroso):
+
+* :mod:`slate_tpu.soak.record` — workload recorder: a delivery-hook
+  tap on a live :class:`~slate_tpu.serve.service.SolverService` (or
+  the PR9 span ring) becomes a durable, replayable JSONL load spec.
+  Operands are never persisted — matrices regenerate deterministically
+  from ``matgen`` philox seeds, and ``repeat_fp`` preserves same-A
+  burst structure for the factor cache.
+* :mod:`slate_tpu.soak.replay` — replay engine: drives a recorded or
+  synthesized spec (bundled generators: multitenant burst, repeated-A
+  stream, adversarial flood, deadline storm) against a live service
+  with open-loop pacing at ``speed`` x, seeded end to end.
+* :mod:`slate_tpu.soak.timeline` — health timeline: samples
+  ``health()`` + devmon gauges on a background cadence into
+  ``{"type": "timeline"}`` JSONL rows (the registry's first
+  time-series view — every other row type is end-of-run aggregate).
+
+``tools/soak_report.py`` joins the timeline with the metric families
+into one judged verdict; ``run_tests.py --soak`` is the gate.
+
+Zero overhead off, like every other plane: nothing here hooks the
+serve tier until a recorder/sampler is explicitly armed, and the
+delivery tap costs the hot path one truthiness check on an empty
+list.
+"""
+
+from . import record, replay, timeline  # noqa: F401
+
+__all__ = ["record", "replay", "timeline"]

@@ -415,7 +415,12 @@ def test_sdc_factor_caught_on_factor_path(shared_cache):
 def test_quarantine_engages_and_probes_back(shared_cache):
     pol = IntegrityPolicy(mode="full", hedge_factor=0.0,
                           quarantine_cooldown_s=0.15, cert_retry_max=1)
-    svc = _svc(shared_cache, integrity=pol, replicas=2)
+    # batch_max=1: sdc_solve perturbs only element [0] of the solved
+    # batch, so a coalesced batch delivers passing certificates for
+    # items 1..k-1 and the pass/fail interleave holds the EWMA under
+    # the quarantine threshold — singleton batches make every delivery
+    # fail and the trip deterministic
+    svc = _svc(shared_cache, integrity=pol, replicas=2, batch_max=1)
     try:
         A, B = _gesv_problem(seed=50)
         svc.submit("gesv", A, B).result(timeout=300)  # warm

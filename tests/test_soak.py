@@ -1,0 +1,570 @@
+"""Soak-fabric tests: workload recorder, deterministic replay,
+health timeline, span-ring pressure, metrics merge, and the unified
+soak verdict (``tools/soak_report.py``).
+
+The service-backed tests share one module-scoped ExecutableCache (the
+test_serve pattern) so each (bucket, batch) executable compiles once
+for the file; the report/merge tools are exercised on hand-built
+JSONLs (they are stdlib-only by contract and must work without the
+library).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import metrics, spans
+from slate_tpu.integrity import policy as ipol
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve import service as serve_service
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import FactorCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.soak import record, replay
+from slate_tpu.soak.timeline import TimelineSampler, sample_row
+
+FLOOR = 16
+NRHS_FLOOR = 4
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.join(_REPO, "tools")
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _ensure(cache, routine, n, batches=(1, 4)):
+    k = bk.bucket_for(routine, n, n, 2, np.float64,
+                      floor=FLOOR, nrhs_floor=NRHS_FLOOR)
+    cache.ensure_manifest(k, batches)
+    cache.ensure_manifest(k.solve_sibling(), batches)
+    return k
+
+
+# ---------------------------------------------------------------------------
+# generators + materialize (pure, no service)
+# ---------------------------------------------------------------------------
+
+
+def test_generators_deterministic():
+    for name, gen in replay.GENERATORS.items():
+        a = gen(60, seed=3)
+        b = gen(60, seed=3)
+        assert a == b, name
+        c = gen(60, seed=4)
+        assert a != c, name
+        assert len(a) == 60 or name == "adversarial_flood", name
+        for row in a:
+            for f in record.SPEC_FIELDS:
+                assert f in row, (name, f)
+
+
+def test_materialize_repeat_structure():
+    rows = replay.gen_repeated_a(12, seed=1, distinct=3)
+    cache = {}
+    groups = {}
+    for r in rows:
+        A, B = replay.materialize(r, seed=0, cache=cache)
+        groups.setdefault(r["repeat_fp"], []).append((A, B))
+    assert len(groups) == 3
+    for fp, items in groups.items():
+        a0 = items[0][0]
+        for A, B in items[1:]:
+            # same repeat_fp -> byte-identical matrix, fresh rhs
+            assert A.tobytes() == a0.tobytes(), fp
+            assert B.tobytes() != items[0][1].tobytes(), fp
+    mats = {items[0][0].tobytes() for items in groups.values()}
+    assert len(mats) == 3  # distinct groups get distinct matrices
+    # the cache memoizes A per group
+    assert len(cache) == 3
+
+
+def test_materialize_solvable_and_seed_sensitivity():
+    row = replay.gen_multitenant(1, seed=0)[0]
+    A0, B0 = replay.materialize(row, seed=0)
+    A1, _ = replay.materialize(row, seed=1)
+    assert A0.tobytes() != A1.tobytes()  # replay seed perturbs operands
+    X = np.linalg.solve(A0, B0)
+    assert np.all(np.isfinite(X))
+    assert replay._residual_ok(row["routine"], A0, B0, X)
+    assert not replay._residual_ok(row["routine"], A0, B0, X * 2 + 1)
+
+
+def test_warm_spec_one_row_per_pool():
+    spec = replay.merge_specs(
+        replay.gen_repeated_a(40, seed=2, distinct=4),
+        replay.gen_multitenant(40, seed=1, distinct=4),
+    )
+    warm = replay.warm_spec(spec, gap_s=0.01)
+    fps = [w["repeat_fp"] for w in warm]
+    assert len(fps) == len(set(fps))  # one row per pool
+    assert set(fps) == {r["repeat_fp"] for r in spec if r["repeat_fp"]}
+    assert all(w["deadline_s"] is None for w in warm)
+    offs = [w["t_offset"] for w in warm]
+    assert offs == sorted(offs)
+    assert offs[-1] == pytest.approx(0.01 * (len(warm) - 1))
+
+
+def test_spec_save_load_roundtrip(tmp_path):
+    rows = replay.gen_deadline_storm(25, seed=9)
+    path = str(tmp_path / "spec.jsonl")
+    record.save(rows, path, source="synth")
+    back = record.load(path)
+    stripped = [{k: v for k, v in r.items() if k != "type"} for r in back]
+    assert stripped == sorted(rows, key=lambda r: r["t_offset"])
+    head = json.loads(open(path).read().splitlines()[0])
+    assert head["type"] == "spec_meta"
+    assert head["count"] == 25
+    assert head["source"] == "synth"
+    # a newer spec version must refuse loudly, not misparse silently
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "type": "spec_meta", "version": record.SPEC_VERSION + 1,
+            "count": 0,
+        }) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        record.load(path)
+
+
+def test_mix_histogram():
+    rows = replay.gen_multitenant(40, seed=1, distinct=4)
+    mix = record.mix_histogram(rows)
+    assert sum(mix["tenants"].values()) == 40
+    assert set(mix["tenants"]) == {"gold", "free"}
+    assert mix["tenants"]["free"] == 10  # every 4th row
+    assert sum(mix["priorities"].values()) == 40
+    assert sum(mix["repeat_groups"].values()) == 40
+    assert all(":" in s for s in mix["shapes"])
+
+
+# ---------------------------------------------------------------------------
+# span-ring pressure + metrics timeline primitives
+# ---------------------------------------------------------------------------
+
+
+def test_spans_pressure():
+    spans.on(ring=8)
+    try:
+        spans.clear()
+        p = spans.pressure()
+        assert p["capacity"] == 8
+        assert p["size"] == 0
+        assert p["evicted"] == 0
+        assert p["window_s"] == 0.0
+        for i in range(12):
+            spans.end(spans.start("request"))
+        p = spans.pressure()
+        assert p["size"] == 8
+        assert p["evicted"] == 4
+        assert p["window_s"] >= 0.0
+    finally:
+        spans.off()
+        spans.clear()
+
+
+def test_metrics_timeline_rows(tmp_path):
+    metrics.record_timeline({"queue_depth": 3, "ready": True})
+    metrics.record_timeline({"queue_depth": 5, "t": 1.25})
+    rows = metrics.timeline()
+    assert len(rows) == 2
+    assert rows[0]["queue_depth"] == 3
+    assert "t" in rows[0]  # stamped at record time when absent
+    assert rows[1]["t"] == 1.25
+    path = str(tmp_path / "m.jsonl")
+    metrics.dump(path)
+    dumped = [
+        json.loads(line) for line in open(path)
+        if json.loads(line).get("type") == "timeline"
+    ]
+    assert len(dumped) == 2
+    assert dumped[1]["queue_depth"] == 5
+    metrics.reset()
+    assert metrics.timeline() == []
+
+
+def test_metrics_timeline_off_is_free():
+    metrics.off()
+    metrics.record_timeline({"queue_depth": 1})
+    metrics.on()
+    assert metrics.timeline() == []
+
+
+# ---------------------------------------------------------------------------
+# recorder + replay + timeline against a live service
+# ---------------------------------------------------------------------------
+
+
+def _service(shared_cache, **kw):
+    defaults = dict(
+        cache=shared_cache, batch_max=4, batch_window_s=0.001,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+    )
+    defaults.update(kw)
+    return SolverService(**defaults)
+
+
+def test_recorder_tap_and_zero_overhead_off(shared_cache):
+    _ensure(shared_cache, "gesv", 12)
+    assert serve_service._delivery_taps == []  # off by default
+    svc = _service(shared_cache, factor_cache=FactorCache(max_entries=8))
+    try:
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((12, 12)) + 12 * np.eye(12)
+        rec = record.Recorder()
+        with rec:
+            assert len(serve_service._delivery_taps) == 1
+            futs = [
+                svc.submit("gesv", A, rng.standard_normal((12, 2)),
+                           deadline=30.0)
+                for _ in range(3)
+            ]
+            for f in futs:
+                f.result(timeout=300)
+        assert serve_service._delivery_taps == []  # detached
+        rows = rec.rows()
+        assert len(rows) == 3
+        for r in rows:
+            assert r["routine"] == "gesv"
+            assert r["bucket_shape"] == [12, 12, 2]
+            assert r["dtype"] == "float64"
+            assert r["deadline_s"] == pytest.approx(30.0, abs=0.5)
+            assert r["repeat_fp"]  # factor cache armed -> fingerprinted
+        # same A -> same fingerprint -> same matrix_seed (the recorded
+        # spec preserves the same-A burst for the factor cache)
+        assert len({r["repeat_fp"] for r in rows}) == 1
+        assert len({r["matrix_seed"] for r in rows}) == 1
+        assert len({r["rhs_seed"] for r in rows}) == 3
+        # resolutions after detach are not recorded
+        svc.submit("gesv", A, rng.standard_normal((12, 2))).result(
+            timeout=300)
+        assert len(rec.rows()) == 3
+    finally:
+        svc.stop()
+
+
+def test_replay_reconciles_and_records_round_trip(shared_cache):
+    _ensure(shared_cache, "gesv", 12)
+    svc = _service(shared_cache, factor_cache=FactorCache(max_entries=8))
+    spans.on(ring=4096)
+    try:
+        spans.clear()
+        spec = replay.gen_repeated_a(30, seed=5, rate_rps=500, distinct=2)
+        rec = record.Recorder()
+        with rec:
+            res = replay.replay(svc, spec, speed=2.0, seed=0)
+        assert res["submitted"] == 30
+        assert res["submitted"] == (
+            res["delivered"] + res["typed_errors"] + res["refused"]
+        )
+        assert res["bad_results"] == 0
+        assert res["p50_s"] is not None
+        c = metrics.counters()
+        assert c["soak.submitted"] == 30
+        assert c["soak.delivered"] == res["delivered"]
+        assert len(rec.rows()) == res["delivered"] + res["typed_errors"]
+        assert replay.orphan_spans() == 0
+        # ring -> spec reconstruction sees the same request stream
+        ring_rows = record.from_ring()
+        assert len(ring_rows) >= res["delivered"]
+        assert all(r["routine"] == "gesv" for r in ring_rows)
+    finally:
+        svc.stop()
+        spans.off()
+        spans.clear()
+
+
+def test_timeline_sampler(shared_cache):
+    _ensure(shared_cache, "gesv", 12)
+    svc = _service(shared_cache)
+    try:
+        with TimelineSampler(svc, period_s=0.02):
+            time.sleep(0.15)
+        rows = metrics.timeline()
+        assert len(rows) >= 4  # baseline + cadence + terminal
+        for r in rows:
+            assert isinstance(r["ready"], bool)
+            assert isinstance(r["queue_depth"], int)
+            assert isinstance(r["breakers_open"], int)
+            assert "t" in r
+        ts = [r["t"] for r in rows]
+        assert ts == sorted(ts)
+    finally:
+        svc.stop()
+
+
+def test_sample_row_with_planes_armed(shared_cache):
+    _ensure(shared_cache, "gesv", 12)
+    svc = _service(
+        shared_cache,
+        factor_cache=FactorCache(max_entries=8),
+        tenants="gold:weight=4;free:rate=100,share=0.5",
+        adaptive=True, latency_budget_s=0.5,
+        integrity=ipol.parse_spec("full"),
+    )
+    spans.on(ring=1024)
+    try:
+        row = sample_row(svc)
+        assert isinstance(row["quarantined"], int)
+        assert isinstance(row["ring_evicted"], int)
+        assert isinstance(row["factor_cache_bytes"], int)
+        assert "overload_level" in row
+    finally:
+        svc.stop()
+        spans.off()
+        spans.clear()
+
+
+def test_health_all_planes_armed_sections_and_latency(shared_cache):
+    """Satellite: health() with EVERY plane armed at once — all
+    documented sections present with stable types, and the probe
+    stays cheap enough to poll."""
+    _ensure(shared_cache, "gesv", 12)
+    svc = _service(
+        shared_cache,
+        factor_cache=FactorCache(max_entries=8),
+        tenants="gold:weight=4;free:rate=100,share=0.5",
+        adaptive=True, latency_budget_s=0.5,
+        integrity=ipol.parse_spec("full,hedge=1.5,cooldown=0.5"),
+    )
+    spans.on(ring=1024)
+    try:
+        rng = np.random.default_rng(1)
+        A = rng.standard_normal((12, 12)) + 12 * np.eye(12)
+        for tenant in ("gold", "free"):
+            svc.submit("gesv", A, rng.standard_normal((12, 2)),
+                       tenant=tenant).result(timeout=300)
+        t0 = time.monotonic()
+        h = svc.health()
+        probe_s = time.monotonic() - t0
+        assert probe_s < 0.25, f"health() took {probe_s:.3f}s"
+        for key in ("ok", "phase", "ready", "restore", "integrity",
+                    "running", "worker_alive", "worker_restarts",
+                    "queue_depth", "queue_limit", "inflight", "breakers",
+                    "open_buckets", "replicas", "sharded", "latency",
+                    "slo_burn", "trace_ring", "cost", "devices",
+                    "factor_cache", "tenants", "admission",
+                    "failures_60s", "failure_rate_60s", "uptime_s"):
+            assert key in h, key
+        assert isinstance(h["ready"], bool)
+        assert isinstance(h["queue_depth"], int)
+        assert isinstance(h["replicas"], list)
+        # every armed plane populates its section (None = plane off)
+        assert h["integrity"] is not None
+        assert h["integrity"]["policy"].startswith("full")
+        assert h["factor_cache"] is not None
+        assert isinstance(h["factor_cache"]["entries"], int)
+        assert h["tenants"] is not None
+        assert h["admission"] is not None
+        assert h["trace_ring"] is not None
+        assert h["trace_ring"] == spans.pressure()
+        assert isinstance(h["latency"], dict) and h["latency"]
+        for row in h["latency"].values():
+            assert set(row) >= {"count", "p50", "p95", "p99"}
+    finally:
+        svc.stop()
+        spans.off()
+        spans.clear()
+
+
+# ---------------------------------------------------------------------------
+# tools: metrics_merge + soak_report (subprocess, stdlib-only contract)
+# ---------------------------------------------------------------------------
+
+
+def _hist_row(name, values):
+    sys.path.insert(0, _TOOLS)
+    try:
+        import metrics_merge as mm
+    finally:
+        sys.path.pop(0)
+    counts = [0] * (len(mm.HIST_EDGES) + 1)
+    for v in values:
+        i = 0
+        while i < len(mm.HIST_EDGES) and v > mm.HIST_EDGES[i]:
+            i += 1
+        counts[i] += 1
+    ordered = sorted(values)
+    return {
+        "type": "hist", "name": name, "count": len(values),
+        "total_s": round(sum(values), 6), "min_s": min(values),
+        "max_s": max(values),
+        "p50": ordered[len(ordered) // 2], "p95": ordered[-1],
+        "p99": ordered[-1],
+        "buckets": [
+            ["inf" if i >= len(mm.HIST_EDGES)
+             else float(f"{mm.HIST_EDGES[i]:.9g}"), k]
+            for i, k in enumerate(counts) if k
+        ],
+    }
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_metrics_merge(tmp_path):
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    merged = str(tmp_path / "m.jsonl")
+    _write_jsonl(a, [
+        {"type": "meta", "schema": 1},
+        {"type": "counter", "name": "soak.submitted", "value": 10},
+        {"type": "gauge", "name": "g", "value": 1},
+        {"type": "timer", "name": "t", "count": 2, "total_s": 1.0,
+         "min_s": 0.4, "max_s": 0.6},
+        _hist_row("serve.latency.x.total", [0.01, 0.02, 0.04]),
+        {"type": "timeline", "t": 0.5, "queue_depth": 1},
+        {"type": "event", "name": "debug", "t": 0.1},
+    ])
+    _write_jsonl(b, [
+        {"type": "counter", "name": "soak.submitted", "value": 5},
+        {"type": "gauge", "name": "g", "value": 7},
+        {"type": "timer", "name": "t", "count": 1, "total_s": 0.2,
+         "min_s": 0.2, "max_s": 0.2},
+        _hist_row("serve.latency.x.total", [0.08]),
+        {"type": "timeline", "t": 0.25, "queue_depth": 9},
+    ])
+    rc = subprocess.call(
+        [sys.executable, os.path.join(_TOOLS, "metrics_merge.py"),
+         a, b, "-o", merged],
+    )
+    assert rc == 0
+    rows = [json.loads(line) for line in open(merged)]
+    by = {}
+    for r in rows:
+        by.setdefault(r["type"], []).append(r)
+    assert "event" not in by  # dropped
+    [meta] = by["meta"]
+    assert meta["merged_from"] == ["a.jsonl", "b.jsonl"]
+    [ctr] = by["counter"]
+    assert ctr["value"] == 15  # counters sum
+    [g] = by["gauge"]
+    assert g["value"] == 7  # last wins
+    [t] = by["timer"]
+    assert (t["count"], t["total_s"], t["min_s"], t["max_s"]) == (
+        3, 1.2, 0.2, 0.6)
+    [h] = by["hist"]
+    assert h["count"] == 4
+    assert sum(k for _le, k in h["buckets"]) == 4
+    assert 0.01 <= h["p50"] <= 0.04  # re-ranked from merged buckets
+    assert 0.04 < h["p99"] <= 0.08
+    tl = by["timeline"]
+    assert [r["t"] for r in tl] == [0.25, 0.5]  # re-sorted
+    assert tl[0]["src"] == "b.jsonl"
+    # an off-lattice edge is a schema violation, not a silent misfile
+    bad = str(tmp_path / "bad.jsonl")
+    _write_jsonl(bad, [
+        {"type": "hist", "name": "h", "count": 1, "total_s": 1.0,
+         "min_s": 1.0, "max_s": 1.0, "buckets": [[0.007, 1]]},
+    ])
+    rc = subprocess.call(
+        [sys.executable, os.path.join(_TOOLS, "metrics_merge.py"),
+         bad, "-o", str(tmp_path / "out.jsonl")],
+        stderr=subprocess.DEVNULL,
+    )
+    assert rc != 0
+
+
+def _verdict_rows(submitted=100, delivered=90, typed=4, refused=6,
+                  bad=0, orphans=0, compiles=0, serve_requests=None,
+                  timeline_n=5, p99=0.05):
+    if serve_requests is None:
+        serve_requests = submitted - refused
+    rows = [
+        {"type": "meta", "schema": 1},
+        {"type": "counter", "name": "soak.submitted", "value": submitted},
+        {"type": "counter", "name": "soak.delivered", "value": delivered},
+        {"type": "counter", "name": "soak.typed_errors", "value": typed},
+        {"type": "counter", "name": "soak.refused", "value": refused},
+        {"type": "counter", "name": "soak.bad_results", "value": bad},
+        {"type": "counter", "name": "serve.requests",
+         "value": serve_requests},
+        {"type": "counter", "name": "jit.compilations", "value": compiles},
+        {"type": "gauge", "name": "soak.orphan_spans", "value": orphans},
+        _hist_row("serve.latency.gesv.16x16x4.float64.total",
+                  [p99 / 2, p99 / 2, p99]),
+    ]
+    rows += [
+        {"type": "timeline", "t": 0.1 * i, "ready": True,
+         "breakers_open": 0}
+        for i in range(timeline_n)
+    ]
+    return rows
+
+
+def _report(path, *extra):
+    return subprocess.call(
+        [sys.executable, os.path.join(_TOOLS, "soak_report.py"),
+         path, *extra],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def test_soak_report_verdicts(tmp_path):
+    ok = str(tmp_path / "ok.jsonl")
+    _write_jsonl(ok, _verdict_rows())
+    assert _report(ok, "--p99-budget-ms", "200") == 0
+
+    # each violation flips the verdict on its own
+    cases = {
+        "unaccounted.jsonl": _verdict_rows(delivered=89),
+        "escape.jsonl": _verdict_rows(bad=3),
+        "orphan.jsonl": _verdict_rows(orphans=2),
+        "compile.jsonl": _verdict_rows(compiles=1),
+        "admission.jsonl": _verdict_rows(serve_requests=80),
+        "tail.jsonl": _verdict_rows(p99=5.0),
+    }
+    for name, rows in cases.items():
+        path = str(tmp_path / name)
+        _write_jsonl(path, rows)
+        assert _report(path, "--p99-budget-ms", "200") == 1, name
+
+    # a run that never recovered from a disruption is flagged
+    stuck = _verdict_rows()
+    stuck += [{"type": "timeline", "t": 9.0, "ready": True,
+               "breakers_open": 2}]
+    path = str(tmp_path / "stuck.jsonl")
+    _write_jsonl(path, stuck)
+    assert _report(path, "--p99-budget-ms", "200") == 1
+
+    # a disruption that CLOSED passes (and obeys --max-recovery-s)
+    healed = _verdict_rows()
+    healed += [
+        {"type": "timeline", "t": 9.0, "ready": True, "breakers_open": 2},
+        {"type": "timeline", "t": 9.2, "ready": True, "breakers_open": 0},
+    ]
+    path = str(tmp_path / "healed.jsonl")
+    _write_jsonl(path, healed)
+    assert _report(path, "--p99-budget-ms", "200") == 0
+    assert _report(path, "--p99-budget-ms", "200",
+                   "--max-recovery-s", "0.1") == 1
+
+    # not a soak JSONL -> unusable input, exit 2
+    empty = str(tmp_path / "empty.jsonl")
+    _write_jsonl(empty, [{"type": "meta", "schema": 1}])
+    assert _report(empty) == 2
+
+
+def test_soak_report_timeline_floor(tmp_path):
+    path = str(tmp_path / "thin.jsonl")
+    _write_jsonl(path, _verdict_rows(timeline_n=1))
+    assert _report(path, "--min-timeline-rows", "5") == 1
+    assert _report(path, "--min-timeline-rows", "1") == 0

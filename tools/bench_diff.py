@@ -15,7 +15,11 @@ and for the headline ``value``:
 * **rates** (higher is better): ``gflops``, ``requests_per_s`` — a
   candidate below ``baseline * (1 - --max-drop)`` is a regression;
 * **memory** (lower is better): ``peak_bytes`` — a candidate above
-  ``baseline * (1 + --max-mem-growth)`` is growth past threshold.
+  ``baseline * (1 + --max-mem-growth)`` is growth past threshold;
+* **latency** (lower is better): ``p99_s`` — a candidate above
+  ``baseline * (1 + --max-lat-growth)`` is a tail regression (the
+  ``soak_sustained`` entry's client-observed p99; in ``--floor`` mode
+  the baseline value is a hard ceiling).
 
 ``--floor`` switches to absolute-floor semantics: the baseline file's
 rate values are hard minimums and its ``peak_bytes`` values hard
@@ -38,6 +42,7 @@ import sys
 
 RATE_FIELDS = ("gflops", "requests_per_s")
 MEM_FIELDS = ("peak_bytes",)
+LAT_FIELDS = ("p99_s",)
 
 
 def load_bench(path):
@@ -92,6 +97,10 @@ def main(argv=None):
     ap.add_argument("--max-mem-growth", type=float, default=0.50,
                     help="allowed fractional peak-memory growth "
                          "(default 0.50)")
+    ap.add_argument("--max-lat-growth", type=float, default=1.00,
+                    help="allowed fractional p99 latency growth "
+                         "(default 1.00 — tails are noisy on shared "
+                         "CPU runners)")
     ap.add_argument("--floor", action="store_true",
                     help="baseline values are absolute floors "
                          "(rates) / ceilings (peak_bytes), no "
@@ -145,6 +154,21 @@ def main(argv=None):
                    else f"{old:.0f} + {args.max_mem_growth * 100:.0f}%")
             )
 
+    def check_lat(label, field, old, new):
+        compared[0] += 1
+        ceil = old if args.floor else old * (1.0 + args.max_lat_growth)
+        ok = new <= ceil
+        verdict = "ok" if ok else "LAT GROWTH"
+        delta = (new - old) / old * 100.0 if old else float("inf")
+        print(f"{label:40} {field:>14} {old:>12.4f} -> {new:>12.4f} "
+              f"({delta:+6.1f}%) {verdict}")
+        if not ok:
+            regress.append(
+                f"{label}.{field}: {new:.4f} above "
+                + (f"ceiling {ceil:.4f}" if args.floor
+                   else f"{old:.4f} + {args.max_lat_growth * 100:.0f}%")
+            )
+
     hdr = (f"{'entry':40} {'field':>14} {'baseline':>12}    "
            f"{'candidate':>12}")
     print(hdr)
@@ -189,6 +213,12 @@ def main(argv=None):
         for field in MEM_FIELDS:
             if field in be and field in ce:
                 check_mem(label, field, float(be[field]),
+                          float(ce[field]))
+        for field in LAT_FIELDS:
+            # p99_s is None when a run delivered nothing (all shed) —
+            # nothing to compare, not a crash
+            if be.get(field) is not None and ce.get(field) is not None:
+                check_lat(label, field, float(be[field]),
                           float(ce[field]))
 
     for n in notes:

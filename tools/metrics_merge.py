@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Merge several metrics JSONL dumps into one (multi-process soaks,
+sharded gates, replica-per-process runs).
+
+Every ``SLATE_TPU_METRICS`` dump is one process's registry; a soak
+that spans processes (sharded serve, subprocess drivers) leaves N
+dumps that no report can read together.  This tool folds them into a
+single dump with the SAME schema, so every ``tools/*_report.py``
+judge runs unchanged on the merged view:
+
+* **counters** sum — they are monotonic totals per process.
+* **gauges** last-wins in argument order — point-in-time snapshots,
+  same rule the loaders apply to re-dumped lines within one file.
+* **timers** merge exactly: count/total sum, min/max envelope.
+* **histograms** merge bucket-wise: every dump's ``[le, count]`` rows
+  sit on the one shared ``HIST_EDGES`` lattice (1e-6s..1000s, 10
+  buckets/decade — aux/metrics.py), so merging is per-edge count
+  addition, then p50/p95/p99 re-rank from the merged counts with the
+  library's own geometric in-bucket interpolation, replicated here.
+  An edge not on the lattice is a schema violation and fails loudly.
+* **timeline** rows pass through (tagged ``"src"`` with the dump's
+  basename) and re-sort by ``t`` — N health timelines interleave into
+  one.
+* **event** rows are dropped: per-process debug traces do not
+  interleave meaningfully across unsynchronized clocks.
+* **cost** rows last-wins per executable name (cumulative snapshots).
+
+Usage:
+    python tools/metrics_merge.py a.jsonl b.jsonl > merged.jsonl
+    python tools/metrics_merge.py shard*.jsonl -o merged.jsonl
+    python tools/soak_report.py merged.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+# the shared histogram lattice, replicated from slate_tpu/aux/metrics.py
+# (this tool is stdlib-only by contract — reports must work when the
+# library itself is broken)
+HIST_PER_DECADE = 10
+HIST_LO_S = 1e-6
+HIST_EDGES = tuple(
+    HIST_LO_S * 10.0 ** (i / HIST_PER_DECADE)
+    for i in range(9 * HIST_PER_DECADE + 1)
+)
+#: wire-format edge labels, exactly as Histogram.bucket_rows writes them
+_EDGE_INDEX = {
+    float(f"{e:.9g}"): i for i, e in enumerate(HIST_EDGES)
+}
+_OVERFLOW = len(HIST_EDGES)
+
+
+def percentile_from(counts: List[int], p: float,
+                    lo: Optional[float] = None,
+                    hi: Optional[float] = None) -> Optional[float]:
+    """aux/metrics.Histogram.percentile_from, replicated: rank into
+    the lattice, geometric interpolation inside the landing bucket,
+    clamped to the observed [min, max] envelope."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = max(1, math.ceil(p / 100.0 * total))
+    cum = 0
+    for i, k in enumerate(counts):
+        cum += k
+        if cum >= rank:
+            if i == 0:
+                est = lo if lo is not None else HIST_LO_S
+            elif i >= len(HIST_EDGES):
+                est = hi if hi is not None else HIST_EDGES[-1]
+            else:
+                b_lo, b_hi = HIST_EDGES[i - 1], HIST_EDGES[i]
+                frac = (rank - (cum - k)) / max(k, 1)
+                est = b_lo * (b_hi / b_lo) ** frac
+            if lo is not None:
+                est = max(est, lo)
+            if hi is not None:
+                est = min(est, hi)
+            return est
+    return None
+
+
+class _MergedHist:
+    def __init__(self) -> None:
+        self.counts = [0] * (_OVERFLOW + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = 0.0
+
+    def fold(self, row: dict, path: str) -> None:
+        for le, k in row.get("buckets", ()):
+            if le == "inf":
+                i = _OVERFLOW
+            else:
+                i = _EDGE_INDEX.get(float(le))
+                if i is None:
+                    raise SystemExit(
+                        f"metrics_merge: {path}: hist {row['name']!r} "
+                        f"bucket edge {le!r} is not on the shared "
+                        "HIST_EDGES lattice — refusing to merge "
+                        "mismatched schemas"
+                    )
+            self.counts[i] += int(k)
+        self.count += int(row.get("count", 0))
+        self.total += float(row.get("total_s", 0.0))
+        mn = row.get("min_s")
+        if mn is not None and int(row.get("count", 0)) > 0:
+            self.min = min(self.min, float(mn))
+        self.max = max(self.max, float(row.get("max_s", 0.0)))
+
+    def row(self, name: str) -> dict:
+        lo = self.min if self.count else None
+        hi = self.max if self.count else None
+        return {
+            "type": "hist", "name": name,
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "min_s": round(self.min, 6) if self.count else 0.0,
+            "max_s": round(self.max, 6),
+            "p50": round(percentile_from(self.counts, 50, lo, hi) or 0.0, 6),
+            "p95": round(percentile_from(self.counts, 95, lo, hi) or 0.0, 6),
+            "p99": round(percentile_from(self.counts, 99, lo, hi) or 0.0, 6),
+            "buckets": [
+                [
+                    "inf" if i >= _OVERFLOW
+                    else float(f"{HIST_EDGES[i]:.9g}"),
+                    k,
+                ]
+                for i, k in enumerate(self.counts) if k
+            ],
+        }
+
+
+def merge(paths: List[str]) -> List[dict]:
+    """All merged rows in dump order: meta, timeline, counter, gauge,
+    timer, hist, cost."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, object] = {}
+    timers: Dict[str, list] = {}
+    hists: Dict[str, _MergedHist] = {}
+    costs: Dict[str, dict] = {}
+    timeline: List[dict] = []
+    schema = None
+    for path in paths:
+        src = os.path.basename(path)
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                r = json.loads(line)
+                t = r.get("type")
+                if t == "counter":
+                    counters[r["name"]] = (
+                        counters.get(r["name"], 0.0) + float(r["value"])
+                    )
+                elif t == "gauge":
+                    gauges[r["name"]] = r["value"]
+                elif t == "timer":
+                    cur = timers.get(r["name"])
+                    if cur is None:
+                        timers[r["name"]] = [
+                            int(r["count"]), float(r["total_s"]),
+                            float(r["min_s"]), float(r["max_s"]),
+                        ]
+                    else:
+                        cur[0] += int(r["count"])
+                        cur[1] += float(r["total_s"])
+                        cur[2] = min(cur[2], float(r["min_s"]))
+                        cur[3] = max(cur[3], float(r["max_s"]))
+                elif t == "hist":
+                    hists.setdefault(r["name"], _MergedHist()).fold(r, path)
+                elif t == "timeline":
+                    row = dict(r)
+                    row["src"] = src
+                    timeline.append(row)
+                elif t == "cost":
+                    costs[r["name"]] = r
+                elif t == "meta":
+                    if schema is None:
+                        schema = r.get("schema")
+                # event rows: dropped (module docstring)
+    timeline.sort(key=lambda r: float(r.get("t", 0.0)))
+    out: List[dict] = [{
+        "type": "meta", "schema": schema if schema is not None else 1,
+        "unix_time": time.time(),
+        "merged_from": [os.path.basename(p) for p in paths],
+    }]
+    out.extend(timeline)
+    out.extend(
+        {"type": "counter", "name": n, "value": counters[n]}
+        for n in sorted(counters)
+    )
+    out.extend(
+        {"type": "gauge", "name": n, "value": gauges[n]}
+        for n in sorted(gauges)
+    )
+    for n in sorted(timers):
+        cnt, total, mn, mx = timers[n]
+        out.append({
+            "type": "timer", "name": n, "count": cnt,
+            "total_s": round(total, 6), "min_s": round(mn, 6),
+            "max_s": round(mx, 6),
+        })
+    out.extend(hists[n].row(n) for n in sorted(hists))
+    out.extend(costs[n] for n in sorted(costs))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", nargs="+", help="metrics dumps to merge")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write here instead of stdout")
+    args = ap.parse_args(argv)
+    rows = merge(args.jsonl)
+    out = (
+        open(args.output, "w") if args.output else sys.stdout
+    )
+    try:
+        for r in rows:
+            out.write(json.dumps(r) + "\n")
+    finally:
+        if args.output:
+            out.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,442 @@
+#!/usr/bin/env python
+"""Unified soak verdict over a metrics JSONL from a ``soak.replay`` run.
+
+One tool joins every plane's end-of-run evidence into a single
+pass/fail, the way an operator would triage a soak: did every request
+come back (delivery completeness), did the books balance (counter
+reconciliation), did anything silently wrong reach a client
+(integrity escapes), did injected faults all land on a containment
+counter (the chaos join), did the tails stay inside budget, did the
+steady state stay compile-free, and did every disruption the health
+timeline saw recover.
+
+Checks, in verdict order:
+
+* ``soak.submitted`` present and nonzero — otherwise this is not a
+  soak JSONL and the tool exits 2 (unusable input, not a failure).
+* Delivery completeness: ``soak.submitted == soak.delivered +
+  soak.typed_errors + soak.refused`` — exact; every submission is
+  accounted for as a result, a typed error, or a synchronous
+  admission refusal.  A shortfall is a hang or a dropped future.
+* Admission reconciliation: ``serve.requests == soak.submitted -
+  soak.refused`` — exact when the replay engine drove all traffic
+  after a ``metrics.reset()`` (hedge twins and retries never count as
+  admissions).
+* Integrity escapes: ``soak.bad_results == 0`` — the replay engine
+  residual-checks every delivered X from the OUTSIDE; one escape
+  means a finite-but-wrong answer crossed the client boundary.
+* Orphan traces: ``soak.orphan_spans == 0`` (when the gauge is
+  present) — a trace with no completed request root is a leaked or
+  hung request the completeness sum cannot see.
+* Injected <= detected: every ``faults.injected.<site>`` counter
+  joins the containment counters from aux/faults.py's ``SiteSpec``
+  registry, exactly as ``tools/chaos_report.py`` does (the logic is
+  imported from it — one join, two tools).
+* Tail budgets: p99 (and optionally p95) of every per-bucket
+  ``serve.latency.<bucket>.total`` histogram vs ``--p99-budget-ms``;
+  per-tenant scopes get their own ``--tenant-p99-budget-ms``.
+* Steady state: ``jit.compilations <= --max-compiles`` (default 0 —
+  a warmed service must not compile mid-soak).
+* Timeline: at least ``--min-timeline-rows`` ``{"type": "timeline"}``
+  rows, and every disruption interval the timeline shows (breakers
+  open, lanes quarantined, service not ready) must CLOSE before the
+  run ends; ``--max-recovery-s`` optionally budgets the longest one.
+
+Usage:
+    python tools/soak_report.py /tmp/soak.jsonl
+    python tools/soak_report.py /tmp/soak.jsonl --p99-budget-ms 500
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+_LAT_RE = re.compile(
+    r"^serve\.latency\.(?P<scope>.+)\.(?P<split>queued|execute|total)$"
+)
+
+
+def _chaos():
+    """The sibling chaos_report module (site registry + injected/
+    recovered join), loaded by file path so this tool works no matter
+    how it was invoked."""
+    import importlib.util
+
+    name = "soak_report_chaos"
+    mod = sys.modules.get(name)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(_HERE, "chaos_report.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+def load(path: str) -> dict:
+    """Counters/gauges/hists (cumulative snapshots: last value wins,
+    same as every sibling report) plus the timeline rows in order."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+    timeline: List[dict] = []
+    meta: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            t = r.get("type")
+            if t == "counter":
+                counters[r["name"]] = float(r.get("value", 0))
+            elif t == "gauge":
+                gauges[r["name"]] = r.get("value")
+            elif t == "hist":
+                hists[r["name"]] = r
+            elif t == "timeline":
+                timeline.append(r)
+            elif t == "meta":
+                meta = r
+    return {
+        "counters": counters, "gauges": gauges, "hists": hists,
+        "timeline": timeline, "meta": meta,
+    }
+
+
+def disruption_intervals(timeline: List[dict]) -> List[dict]:
+    """Contiguous intervals where a timeline signal shows the service
+    disrupted, with whether (and in how long) each one recovered.
+    Signals: ``breakers_open > 0``, ``quarantined > 0``,
+    ``ready == False``."""
+
+    def signals(row: dict) -> List[str]:
+        out = []
+        if row.get("breakers_open"):
+            out.append("breaker")
+        if row.get("quarantined"):
+            out.append("quarantine")
+        if row.get("ready") is False:
+            out.append("not_ready")
+        return out
+
+    intervals: List[dict] = []
+    open_at: Dict[str, float] = {}
+    for row in timeline:
+        t = float(row.get("t", 0.0))
+        active = set(signals(row))
+        for sig in list(open_at):
+            if sig not in active:
+                t0 = open_at.pop(sig)
+                intervals.append({
+                    "signal": sig, "t_start": t0, "t_end": t,
+                    "recovered": True, "duration_s": round(t - t0, 3),
+                })
+        for sig in active:
+            open_at.setdefault(sig, t)
+    t_last = float(timeline[-1].get("t", 0.0)) if timeline else 0.0
+    for sig, t0 in sorted(open_at.items()):
+        intervals.append({
+            "signal": sig, "t_start": t0, "t_end": t_last,
+            "recovered": False,
+            "duration_s": round(t_last - t0, 3),
+        })
+    intervals.sort(key=lambda iv: iv["t_start"])
+    return intervals
+
+
+def bucket_p99s(hists: Dict[str, dict]) -> Dict[str, Tuple[float, float]]:
+    """scope -> (p95, p99) of ``serve.latency.<scope>.total`` for
+    per-bucket scopes (tenant./replica. aggregates are judged under
+    their own flags)."""
+    out: Dict[str, Tuple[float, float]] = {}
+    for name, h in hists.items():
+        m = _LAT_RE.match(name)
+        if not m or m.group("split") != "total":
+            continue
+        scope = m.group("scope")
+        if scope.startswith(("replica.", "tenant.")):
+            continue
+        out[scope] = (float(h.get("p95", 0.0)), float(h.get("p99", 0.0)))
+    return out
+
+
+def tenant_p99s(hists: Dict[str, dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for name, h in hists.items():
+        m = _LAT_RE.match(name)
+        if not m or m.group("split") != "total":
+            continue
+        scope = m.group("scope")
+        if scope.startswith("tenant."):
+            out[scope[len("tenant."):]] = float(h.get("p99", 0.0))
+    return out
+
+
+def analyze(path: str, p99_budget_ms: Optional[float] = None,
+            p95_budget_ms: Optional[float] = None,
+            tenant_p99_budget_ms: Optional[float] = None,
+            max_compiles: int = 0, min_timeline_rows: int = 2,
+            min_delivered: int = 1,
+            max_recovery_s: Optional[float] = None) -> dict:
+    """All verdict rows for one soak JSONL.  Each row:
+    ``{check, ok, detail}``; ``usable`` False means exit 2."""
+    data = load(path)
+    c = data["counters"]
+    g = data["gauges"]
+    submitted = int(c.get("soak.submitted", 0))
+    if submitted <= 0:
+        return {"usable": False, "rows": [], "data": data}
+    delivered = int(c.get("soak.delivered", 0))
+    typed = int(c.get("soak.typed_errors", 0))
+    refused = int(c.get("soak.refused", 0))
+    bad = int(c.get("soak.bad_results", 0))
+    rows: List[dict] = []
+
+    acct = delivered + typed + refused
+    rows.append({
+        "check": "delivery completeness", "ok": acct == submitted,
+        "detail": (
+            f"submitted={submitted} == delivered={delivered} + "
+            f"typed={typed} + refused={refused}"
+            if acct == submitted else
+            f"submitted={submitted} != delivered+typed+refused={acct} "
+            f"({submitted - acct:+d} unaccounted)"
+        ),
+    })
+    rows.append({
+        "check": "delivered volume", "ok": delivered >= min_delivered,
+        "detail": f"delivered={delivered} (floor {min_delivered})",
+    })
+    serve_req = c.get("serve.requests")
+    admitted = submitted - refused
+    if serve_req is None:
+        rows.append({
+            "check": "admission reconciliation", "ok": False,
+            "detail": "the serve.requests counter is missing from the dump",
+        })
+    else:
+        rows.append({
+            "check": "admission reconciliation",
+            "ok": int(serve_req) == admitted,
+            "detail": (
+                f"admitted serve.requests={int(serve_req)} == "
+                f"submitted-refused={admitted}"
+                if int(serve_req) == admitted else
+                f"admitted serve.requests={int(serve_req)} != "
+                f"submitted-refused={admitted}"
+            ),
+        })
+    rows.append({
+        "check": "integrity escapes", "ok": bad == 0,
+        "detail": (
+            "zero soak.bad_results (no wrong answer crossed the client "
+            "boundary)" if bad == 0 else
+            f"escapes soak.bad_results={bad}: finite-but-wrong X delivered"
+        ),
+    })
+    orphans = g.get("soak.orphan_spans")
+    if orphans is not None:
+        rows.append({
+            "check": "orphan traces", "ok": int(orphans) == 0,
+            "detail": f"gauge soak.orphan_spans={int(orphans)}",
+        })
+
+    # injected <= detected: chaos_report's registry join, verbatim
+    try:
+        chaos_rows = _chaos().analyze(path)
+    except Exception as e:  # registry unreadable: a loud verdict row
+        chaos_rows = None
+        rows.append({
+            "check": "fault containment", "ok": False,
+            "detail": f"site registry join failed: {e}",
+        })
+    if chaos_rows is not None:
+        flagged = [r for r in chaos_rows if r["flagged"]]
+        injected_total = sum(r["injected"] for r in chaos_rows)
+        rows.append({
+            "check": "fault containment", "ok": not flagged,
+            "detail": (
+                f"{len(chaos_rows)} site(s), {injected_total} injected, "
+                "all joined to recovery signals" if not flagged else
+                "no recovery signal from: "
+                + ", ".join(
+                    f"{r['site']} (injected={r['injected']})"
+                    for r in flagged
+                )
+            ),
+        })
+
+    compiles = int(c.get("jit.compilations", 0))
+    rows.append({
+        "check": "steady-state compiles", "ok": compiles <= max_compiles,
+        "detail": f"counted jit.compilations={compiles} (budget {max_compiles})",
+    })
+
+    scopes = bucket_p99s(data["hists"])
+    if p99_budget_ms is not None:
+        over = {
+            s: p99 for s, (_p95, p99) in scopes.items()
+            if p99 * 1e3 > p99_budget_ms
+        }
+        rows.append({
+            "check": f"bucket p99 <= {p99_budget_ms:g}ms",
+            "ok": not over,
+            "detail": (
+                f"{len(scopes)} bucket scope(s) inside budget"
+                if not over else ", ".join(
+                    f"{s}: p99={p99 * 1e3:.1f}ms"
+                    for s, p99 in sorted(over.items())
+                )
+            ),
+        })
+    if p95_budget_ms is not None:
+        over = {
+            s: p95 for s, (p95, _p99) in scopes.items()
+            if p95 * 1e3 > p95_budget_ms
+        }
+        rows.append({
+            "check": f"bucket p95 <= {p95_budget_ms:g}ms",
+            "ok": not over,
+            "detail": (
+                f"{len(scopes)} bucket scope(s) inside budget"
+                if not over else ", ".join(
+                    f"{s}: p95={p95 * 1e3:.1f}ms"
+                    for s, p95 in sorted(over.items())
+                )
+            ),
+        })
+    if tenant_p99_budget_ms is not None:
+        tp = tenant_p99s(data["hists"])
+        over = {
+            t: p99 for t, p99 in tp.items()
+            if p99 * 1e3 > tenant_p99_budget_ms
+        }
+        rows.append({
+            "check": f"tenant p99 <= {tenant_p99_budget_ms:g}ms",
+            "ok": not over,
+            "detail": (
+                f"{len(tp)} tenant(s) inside budget" if not over
+                else ", ".join(
+                    f"{t}: p99={p99 * 1e3:.1f}ms"
+                    for t, p99 in sorted(over.items())
+                )
+            ),
+        })
+
+    tl = data["timeline"]
+    rows.append({
+        "check": "health timeline", "ok": len(tl) >= min_timeline_rows,
+        "detail": f"{len(tl)} timeline row(s) (floor {min_timeline_rows})",
+    })
+    intervals = disruption_intervals(tl)
+    unrecovered = [iv for iv in intervals if not iv["recovered"]]
+    if intervals:
+        worst = max(iv["duration_s"] for iv in intervals)
+        ok = not unrecovered and (
+            max_recovery_s is None or worst <= max_recovery_s
+        )
+        rows.append({
+            "check": "disruption recovery", "ok": ok,
+            "detail": (
+                f"{len(intervals)} disruption interval(s), all recovered, "
+                f"longest {worst:.3f}s"
+                + (f" (budget {max_recovery_s:g}s)"
+                   if max_recovery_s is not None else "")
+                if ok else
+                (", ".join(
+                    f"{iv['signal']} open at end "
+                    f"(since t={iv['t_start']:.2f}s)"
+                    for iv in unrecovered
+                ) if unrecovered else
+                 f"longest recovery {worst:.3f}s > "
+                 f"budget {max_recovery_s:g}s")
+            ),
+        })
+
+    return {
+        "usable": True, "rows": rows, "data": data,
+        "intervals": intervals, "scopes": scopes,
+        "tenants": tenant_p99s(data["hists"]),
+        "tally": {
+            "submitted": submitted, "delivered": delivered,
+            "typed_errors": typed, "refused": refused,
+            "bad_results": bad,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("jsonl", help="metrics JSONL from a soak replay")
+    ap.add_argument("--p99-budget-ms", type=float, default=None,
+                    help="per-bucket p99 latency budget (total split)")
+    ap.add_argument("--p95-budget-ms", type=float, default=None,
+                    help="per-bucket p95 latency budget")
+    ap.add_argument("--tenant-p99-budget-ms", type=float, default=None,
+                    help="per-tenant p99 latency budget")
+    ap.add_argument("--max-compiles", type=int, default=0,
+                    help="allowed jit.compilations mid-soak (default 0)")
+    ap.add_argument("--min-timeline-rows", type=int, default=2,
+                    help="minimum {'type':'timeline'} rows (default 2)")
+    ap.add_argument("--min-delivered", type=int, default=1,
+                    help="minimum delivered results (default 1)")
+    ap.add_argument("--max-recovery-s", type=float, default=None,
+                    help="budget for the longest disruption interval")
+    args = ap.parse_args(argv)
+
+    res = analyze(
+        args.jsonl, p99_budget_ms=args.p99_budget_ms,
+        p95_budget_ms=args.p95_budget_ms,
+        tenant_p99_budget_ms=args.tenant_p99_budget_ms,
+        max_compiles=args.max_compiles,
+        min_timeline_rows=args.min_timeline_rows,
+        min_delivered=args.min_delivered,
+        max_recovery_s=args.max_recovery_s,
+    )
+    if not res["usable"]:
+        print(f"{args.jsonl}: no soak.submitted counter — not a soak "
+              "run's JSONL (replay not driven, or metrics off)",
+              file=sys.stderr)
+        return 2
+
+    t = res["tally"]
+    print(f"soak verdict: {args.jsonl}")
+    print(f"  submitted={t['submitted']} delivered={t['delivered']} "
+          f"typed={t['typed_errors']} refused={t['refused']} "
+          f"bad={t['bad_results']}")
+    if res["scopes"]:
+        print("  bucket tails (total split):")
+        for s, (p95, p99) in sorted(res["scopes"].items()):
+            print(f"    {s:40} p95={p95 * 1e3:8.1f}ms p99={p99 * 1e3:8.1f}ms")
+    if res["tenants"]:
+        print("  tenant tails: " + "  ".join(
+            f"{k}={v * 1e3:.1f}ms" for k, v in sorted(res["tenants"].items())
+        ))
+    print()
+    failed = 0
+    for row in res["rows"]:
+        mark = "ok  " if row["ok"] else "FAIL"
+        if not row["ok"]:
+            failed += 1
+        print(f"  [{mark}] {row['check']}: {row['detail']}")
+    print()
+    if failed:
+        print(f"{failed} check(s) failed")
+        return 1
+    print("all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
