@@ -1,0 +1,129 @@
+"""ex25: elastic capacity — a bursty stream against an autoscaling
+SolverService (README "Elastic capacity").
+
+One service starts with a single replica lane and ``SLATE_TPU_SCALE``
+armed.  A recorded-shape bursty trace (quiet 30 req/s baseline, a
+2 s step to 120 req/s) replays open-loop while a fixed per-dispatch
+latency fault stands in for real solve weight on CPU; the capacity
+plane must:
+
+  * see the burst in its pressure signals and grow the fleet
+    (scale_up decisions, every one carrying its driving snapshot);
+  * warm each new lane inside ``add_replica`` BEFORE it takes
+    traffic — the only compiles in the measured stream are the
+    counted pre-traffic device primes (``serve.device_primes``);
+    no request dispatch ever compiles, scale-ups included;
+  * give the lanes back once the burst passes (scale_down on the
+    quiet tail, fleet ends back at min_replicas), with the removed
+    lanes still visible as terminal rows in ``health()``.
+
+Run: python ex25_autoscale.py
+"""
+
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# arm the capacity plane BEFORE the service is constructed: with the
+# env unset the service never builds a scaler at all (zero overhead)
+os.environ["SLATE_TPU_SCALE"] = (
+    "min=1,max=3,up=1.0,down=0.2,up_cooldown=0.25,"
+    "down_cooldown=2.0,step=2,period=0.05"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from _common import np  # noqa: E402
+
+from slate_tpu.aux import faults, metrics  # noqa: E402
+from slate_tpu.serve import buckets as bk  # noqa: E402
+from slate_tpu.serve.cache import ExecutableCache  # noqa: E402
+from slate_tpu.serve.factor_cache import FactorCache  # noqa: E402
+from slate_tpu.serve.service import SolverService  # noqa: E402
+from slate_tpu.soak import replay  # noqa: E402
+
+metrics.on()
+art = tempfile.mkdtemp(prefix="ex25_artifacts_")
+
+svc = SolverService(
+    cache=ExecutableCache(manifest_path=None, artifact_dir=art),
+    batch_max=1, batch_window_s=0.0005, dim_floor=16, nrhs_floor=4,
+    replicas=1, factor_cache=FactorCache(max_entries=16),
+)
+assert svc._scaler is not None, "SLATE_TPU_SCALE should arm the scaler"
+k = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=16, nrhs_floor=4)
+svc.cache.ensure_manifest(k, (1,))
+svc.cache.ensure_manifest(k.solve_sibling(), (1,))
+# warmup compiles once and exports to the artifact store — that store
+# is what lets add_replica bring a NEW lane live without compiling
+svc.warmup()
+
+spec = replay.gen_burst(400, seed=25, base_rps=30, burst_rps=120,
+                        burst_start_s=1.0, burst_len_s=2.0,
+                        n=12, nrhs=2, distinct=4)
+replay.replay(svc, replay.warm_spec(spec), speed=1.0, seed=0)
+metrics.reset()
+
+# a fixed 12 ms latency tax per dispatch: one lane saturates near
+# 60 req/s, so the 120 req/s burst genuinely needs more lanes
+faults.configure("latency:every=1,ms=12")
+faults.on()
+with metrics.deltas() as d:
+    res = replay.replay(svc, spec, speed=1.0, seed=0)
+    faults.reset()
+    # quiet tail: the scaler must give the burst capacity back
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with svc._cond:
+            fleet = len(svc._replicas)
+        if fleet == 1:
+            break
+        time.sleep(0.05)
+    compiles = int(d.get("jit.compilations"))
+    primes = int(d.get("serve.device_primes"))
+
+print(f"replayed {res['submitted']} requests: "
+      f"{res['delivered']} delivered, p99={(res['p99_s'] or 0) * 1e3:.0f}ms")
+assert res["delivered"] == res["submitted"], res
+assert res["bad_results"] == 0, res
+
+print("decision timeline:")
+for dec in svc._scaler.decisions:
+    s = dec.snapshot
+    print(f"  t={s.t:10.3f}s {dec.action:4} delta={dec.delta} "
+          f"replicas={s.replicas} pressure={s.pressure:.2f} "
+          f"({dec.reason})")
+
+ups = sum(1 for dec in svc._scaler.decisions if dec.action == "up")
+downs = sum(1 for dec in svc._scaler.decisions if dec.action == "down")
+assert ups >= 1, "the burst never drove a scale-up"
+assert downs >= 1, "the quiet tail never gave capacity back"
+assert fleet == 1, f"fleet should end at min_replicas, got {fleet}"
+# the zero-steady-state-compiles contract: every compile in the
+# measured window is a pre-traffic lane prime inside add_replica
+# (serve.device_primes — counted cold-start budget, never hidden);
+# the dispatch path itself compiled NOTHING
+assert primes >= 1, "scale-up never primed its lane"
+assert compiles == primes, (
+    f"steady state must be compile-free: {compiles} compiles but only "
+    f"{primes} pre-traffic lane primes")
+
+h = svc.health()
+cap = h["capacity"]
+print(f"capacity: fleet back to {fleet} lane(s), "
+      f"{cap['decisions']} applied decisions, "
+      f"terminal lanes {cap['terminal_lanes']}")
+for row in h["replicas"]:
+    print(f"  lane {row['name']}: state={row['state']} "
+          f"dispatched={row.get('dispatched', 0)}")
+assert any(r["state"] == "removed" for r in h["replicas"]), (
+    "removed lanes must stay visible as terminal rows")
+svc.stop()
+print(f"ex25 done: burst absorbed elastically, {ups} up / {downs} down, "
+      f"{primes} pre-traffic lane prime(s), 0 steady-state compiles")

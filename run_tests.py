@@ -1785,6 +1785,16 @@ print(f"soak main: {res['submitted']} submitted, "
       f"{res['requests_per_s']} req/s, "
       f"p99={(res['p99_s'] or 0) * 1e3:.1f}ms")
 
+# ---- elastic lifecycle under the instrumented sync runtime ---------
+# grow the fleet by one lane (phase 2 traffic rides on 3 replicas),
+# shrink it back after the determinism runs: the add/remove paths run
+# inside the same SLATE_TPU_SYNC_CHECK net as the rest of the drill
+added = svc.add_replica()
+with svc._cond:
+    fleet = len(svc._replicas)
+assert fleet == 3, fleet
+print(f"soak: replica {added} added, fleet={fleet}")
+
 # ---- phase 2: record -> replay round trip + determinism ------------
 rec = record.Recorder().attach()
 rt_res = replay.replay(svc, rt_spec, speed=1.0, seed=0)
@@ -1829,6 +1839,19 @@ assert abs(ra["delivered"] - rb["delivered"]) <= tol, (ra, rb)
 print(f"round trip: {len(recorded)} recorded, mixes agree; "
       f"determinism: {ra['delivered']} vs {rb['delivered']} delivered")
 
+# drain the added lane back out mid-traffic-history: every queued
+# request it held must re-home (none dropped — the books below still
+# reconcile) and health must show the lane as a terminal row
+removed = svc.remove_replica(added, drain_timeout=120)
+h = svc.health()
+states = {l["name"]: l.get("state") for l in h["replicas"]}
+assert states.get(removed) == "removed", states
+assert removed in (h["capacity"] or {}).get("terminal_lanes", [removed]), h
+with svc._cond:
+    fleet = len(svc._replicas)
+assert fleet == 2, fleet
+print(f"soak: replica {removed} drained + removed, fleet={fleet}")
+
 pressure = spans.pressure()
 if pressure["evicted"] == 0:
     replay.orphan_spans()  # publishes the soak.orphan_spans gauge
@@ -1840,8 +1863,15 @@ svc.stop(drain=True, drain_timeout=300)
 c = metrics.counters()
 assert c["serve.requests"] == c["soak.submitted"] - c["soak.refused"], (
     c["serve.requests"], c["soak.submitted"], c["soak.refused"])
+# the gate armed SLATE_TPU_SYNC_CHECK: the whole drill (replica
+# add/remove included) ran under the lockset/inversion checker, and a
+# single recorded violation fails the soak right here
+from slate_tpu.aux import sync
+assert sync.is_on(), "SLATE_TPU_SYNC_CHECK must arm the runtime"
+v = sync.violations()
+assert not v, ("sync checker flagged the drill", v[:3])
 metrics.dump()
-print("soak driver: all phases complete, books reconcile")
+print("soak driver: all phases complete, books reconcile, sync clean")
 """
 
 # Negative leg: the SAME SDC corruption with the integrity plane AND
@@ -1917,7 +1947,13 @@ def soak_gate(full: bool = False) -> int:
                     "SLATE_TPU_ARTIFACTS"):
             env.pop(var, None)
         jsonl = os.path.join(td, "soak.jsonl")
-        denv = dict(env, SLATE_TPU_METRICS=jsonl)
+        # the drill runs under the instrumented sync runtime: every
+        # lock acquisition in the replay (including the add/remove
+        # replica lifecycle it now exercises) is order-checked against
+        # LOCK_ORDER.json, so a lock-order regression fails the soak
+        # even before the race gate runs
+        denv = dict(env, SLATE_TPU_METRICS=jsonl,
+                    SLATE_TPU_SYNC_CHECK="1")
         if full:
             denv["SLATE_SOAK_SCALE"] = "full"
         rc = subprocess.call(
@@ -1953,6 +1989,181 @@ def soak_gate(full: bool = False) -> int:
                   "SDC escape")
             return 1
     return 0
+
+
+# Elastic-capacity driver: one recorded bursty trace (gen_burst ->
+# record.save -> record.load, so the measured workload IS a spec file)
+# replayed twice under a fixed per-dispatch latency tax that saturates
+# a single lane at ~60 req/s.  Leg 1: a static replicas=1 fleet eats
+# the 120 req/s burst and blows its tail budget.  Leg 2: the SAME
+# trace with SLATE_TPU_SCALE armed — the autoscaler must grow the
+# fleet through the burst (artifact-warmed lanes, zero compiles),
+# hold the budget, and give every lane back.  The driver only
+# publishes the evidence (scale.gate.* gauges + the decision
+# timeline); tools/capacity_report.py renders the verdict.
+_SCALE_DRIVER = """
+import os
+import sys
+import threading
+import time
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.serve import buckets as bk
+from slate_tpu.scale import gate
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import FactorCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.soak import record, replay
+
+art, trace = sys.argv[1], sys.argv[2]
+BUDGET_S = 1.0
+POLICY = ("min=1,max=3,up=1.0,down=0.2,up_cooldown=0.25,"
+          "down_cooldown=2.0,step=2,period=0.05")
+
+metrics.on()
+metrics.reset()
+spans.on(ring=65536)
+
+spec = replay.gen_burst(500, seed=9, base_rps=30, burst_rps=120,
+                        burst_start_s=1.0, burst_len_s=2.0,
+                        n=12, nrhs=2, distinct=4)
+record.save(spec, trace, source="gen_burst")
+rows = record.load(trace)
+
+def build():
+    svc = SolverService(
+        cache=ExecutableCache(manifest_path=None, artifact_dir=art),
+        batch_max=1, batch_window_s=0.0005, dim_floor=16,
+        nrhs_floor=4, replicas=1,
+        factor_cache=FactorCache(max_entries=16),
+    )
+    k = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=16,
+                      nrhs_floor=4)
+    svc.cache.ensure_manifest(k, (1,))
+    svc.cache.ensure_manifest(k.solve_sibling(), (1,))
+    svc.warmup()
+    # factor-pool warm with the replay's seed: the measured legs hit
+    replay.replay(svc, replay.warm_spec(rows), speed=1.0, seed=0)
+    return svc
+
+# fixed latency tax on every dispatch: capacity is lanes, not luck
+faults.configure("latency:every=1,ms=12")
+
+# ---- leg 1: static fleet (replicas=1, scaler unarmed) --------------
+os.environ.pop("SLATE_TPU_SCALE", None)
+svc = build()
+assert svc._scaler is None, "scaler armed without SLATE_TPU_SCALE"
+faults.on()
+res_static = replay.replay(svc, rows, speed=1.0, seed=0)
+faults.off()  # off, not reset: leg 2 re-arms the SAME latency tax
+svc.stop(drain=True, drain_timeout=120)
+print(f"static leg: p99={(res_static['p99_s'] or 0) * 1e3:.1f}ms "
+      f"over {res_static['submitted']} requests")
+
+# ---- leg 2: elastic fleet, same trace, same faults -----------------
+os.environ["SLATE_TPU_SCALE"] = POLICY
+svc = build()
+assert svc._scaler is not None, "SLATE_TPU_SCALE failed to arm"
+metrics.reset()  # evidence window: the measured replay only
+
+peak = {"n": 1}
+watch_stop = threading.Event()
+def _watch():
+    while not watch_stop.is_set():
+        with svc._cond:
+            n = len(svc._replicas)
+        peak["n"] = max(peak["n"], n)
+        time.sleep(0.02)
+watcher = threading.Thread(target=_watch, daemon=True)
+watcher.start()
+
+faults.on()
+res_elastic = replay.replay(svc, rows, speed=1.0, seed=0)
+faults.reset()  # teardown proper: the tail drain runs untaxed
+# quiet tail: the scaler must give the burst capacity back on its own
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    with svc._cond:
+        n_end = len(svc._replicas)
+    if n_end == 1:
+        break
+    time.sleep(0.05)
+watch_stop.set()
+watcher.join(2)
+compiles = int(metrics.counters().get("jit.compilations", 0))
+# zero-steady-state-compiles accounting: a scale-up lane's device
+# prime inside add_replica IS a counted backend compile
+# (serve.device_primes — cold-start budget, pre-traffic).  The gate
+# claim is about the DISPATCH path: every compile in the window must
+# be such a prime, so steady-state compiles = total - primes.
+primes = int(metrics.counters().get("serve.device_primes", 0))
+
+gate.publish({
+    "static_p99_s": res_static["p99_s"] or 0.0,
+    "elastic_p99_s": res_elastic["p99_s"] or 0.0,
+    "budget_s": BUDGET_S,
+    "replica_peak": peak["n"],
+    "replicas_end": n_end,
+    "min_replicas": 1,
+    "max_replicas": 3,
+    "up_threshold": 1.0,
+    "new_lane_compiles": compiles - primes,
+    "device_primes": primes,
+})
+svc.stop(drain=True, drain_timeout=120)
+metrics.dump()
+print(f"elastic leg: p99={(res_elastic['p99_s'] or 0) * 1e3:.1f}ms, "
+      f"peak={peak['n']} lanes, end={n_end}, "
+      f"steady-state compiles={compiles - primes} "
+      f"({primes} pre-traffic lane primes)")
+"""
+
+
+def scale_gate() -> int:
+    """Elastic-capacity gate, two legs: (1) the scale suite (pure
+    controller/aggregator/warmup-plan units plus the live add/remove
+    lifecycle tests); (2) the burst drill — one recorded bursty trace
+    replayed against a static fleet (must MISS its p99 budget) and an
+    elastic fleet (must HOLD it inside max_replicas, warm every new
+    lane from artifacts with zero compiles, and return to
+    min_replicas) — judged by tools/capacity_report.py."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_scale.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_scale_") as td:
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        for var in ("SLATE_TPU_FAULTS", "SLATE_TPU_FACTOR_CACHE",
+                    "SLATE_TPU_TENANTS", "SLATE_TPU_ADAPTIVE",
+                    "SLATE_TPU_INTEGRITY", "SLATE_TPU_WARMUP",
+                    "SLATE_TPU_ARTIFACTS", "SLATE_TPU_SCALE"):
+            env.pop(var, None)
+        jsonl = os.path.join(td, "scale.jsonl")
+        art = os.path.join(td, "artifacts")
+        trace = os.path.join(td, "burst.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _SCALE_DRIVER, art, trace],
+            env=dict(env, SLATE_TPU_METRICS=jsonl), cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "capacity_report.py"),
+             jsonl],
+            cwd=here,
+        )
+    return rc
 
 
 def main() -> int:
@@ -2031,6 +2242,12 @@ def main() -> int:
     ap.add_argument("--full", action="store_true",
                     help="with --soak: scale the drill to ~10^6 "
                          "requests (tens of minutes)")
+    ap.add_argument("--scale", action="store_true",
+                    help="run the elastic-capacity gate: the scale "
+                         "suite + one recorded bursty trace replayed "
+                         "static (misses p99) then elastic (holds it, "
+                         "artifact-warmed lanes, fleet returns to "
+                         "min), judged by tools/capacity_report.py")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -2067,6 +2284,8 @@ def main() -> int:
         return race_gate()
     if args.soak:
         return soak_gate(full=args.full)
+    if args.scale:
+        return scale_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

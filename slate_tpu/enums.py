@@ -361,6 +361,7 @@ class Option(enum.Enum):
     ServeLatencyBudget = "serve_latency_budget"  # p99 budget, s (0 = off)
     ServeIntegrity = "serve_integrity"  # SDC certification policy (integrity/)
     ServeDrainTimeout = "serve_drain_timeout"  # stop(drain=True) bound, s
+    ServeScale = "serve_scale"  # elastic capacity policy (scale/ grammar)
     Faults = "faults"  # fault-injection spec string (aux/faults grammar)
 
 

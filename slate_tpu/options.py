@@ -85,6 +85,10 @@ _DEFAULTS = {
     # stop(drain=True) completes already-admitted requests for at most
     # this many seconds before abandoning the rest (rolling restarts)
     Option.ServeDrainTimeout: 30.0,
+    # elastic capacity plane (scale/): "" = plane off — zero-overhead
+    # default, the service never constructs a scaler (SLATE_TPU_SCALE
+    # env overrides; grammar on|min=<n>,max=<n>,up=<p>,down=<p>,...)
+    Option.ServeScale: "",
     Option.Faults: "",  # empty = no injection (aux/faults spec grammar)
 }
 

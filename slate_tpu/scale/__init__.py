@@ -1,0 +1,74 @@
+"""Elastic capacity plane: the fourth control loop, from observed
+pressure to replica count.
+
+The serve tier already *measures* everything that matters — the
+admission plane's budget-burn EWMA and overload level (PR12), hedge
+and pad-waste counters, per-lane queue depth and head-of-line age,
+devmon HBM headroom — and PR16's workload recorder made the traffic
+itself replayable.  What was missing is the actuator: capacity stayed
+whatever ``replicas=N`` said at construction (ROADMAP item 3; Clipper
+shows the adaptive-serving control shape, and Dean & Barroso's
+tail-at-scale argument makes p99 misses a *fleet-sizing* signal, not
+just a hedging one — PAPERS.md).
+
+Three modules close the loop:
+
+* :mod:`slate_tpu.scale.signals` — capacity-signal aggregator: one
+  clock, every pressure source, smoothed into a deterministic
+  :class:`~slate_tpu.scale.signals.PressureSnapshot` with a single
+  composite ``pressure`` scalar (1.0 = at capacity).
+* :mod:`slate_tpu.scale.controller` — hysteresis policy
+  (min/max replicas, separate up/down thresholds and cool-downs,
+  AIMD step sizing) driving the service's new ``add_replica()`` /
+  ``remove_replica()`` hooks.  A scale-up lane comes live warm: the
+  artifact store + ``_bring_live`` device priming mean its first
+  steady-state request compiles nothing.  Scale-down quiesces
+  through the drain path and re-homes lane-affine factor-cache
+  entries before teardown.
+* :mod:`slate_tpu.scale.warmup_plan` — predictive warmup: replay a
+  recorded trace offline into a warmup manifest subset + factor
+  preload ranked by traffic-weighted compile cost.
+
+``tools/capacity_report.py`` judges the decision record;
+``run_tests.py --scale`` is the gate.  Zero overhead off, like every
+other plane: with ``SLATE_TPU_SCALE`` unset the service never
+constructs a scaler and the hot path is byte-identical to before.
+"""
+
+from . import controller, signals, warmup_plan  # noqa: F401
+from .controller import (  # noqa: F401
+    AutoScaler,
+    ScaleController,
+    ScaleDecision,
+    ScalePolicy,
+    parse_spec,
+    policy_from_options,
+)
+from .signals import PressureSnapshot, SignalAggregator  # noqa: F401
+from .warmup_plan import WarmupPlan, plan_from_trace  # noqa: F401
+
+__all__ = [
+    "AutoScaler", "ScaleController", "ScaleDecision", "ScalePolicy",
+    "PressureSnapshot", "SignalAggregator", "WarmupPlan",
+    "parse_spec", "policy_from_options", "plan_from_trace",
+    "controller", "signals", "warmup_plan",
+]
+
+# `slate_tpu` exports the aux *routine* `scale` (A *= numer/denom,
+# reference src/scale.cc) at top level; importing this subpackage
+# rebinds the `slate_tpu.scale` attribute to the module, which would
+# silently break `slate_tpu.scale(2.0, 1.0, A)` callers.  Keep the
+# routine reachable through the module by making the module callable —
+# both worlds work, whichever import happened first.
+import sys as _sys
+import types as _types
+
+
+class _CallableScaleModule(_types.ModuleType):
+    def __call__(self, numer, denom, A, opts=None):
+        from ..drivers.aux import scale as _scale_routine
+
+        return _scale_routine(numer, denom, A, opts)
+
+
+_sys.modules[__name__].__class__ = _CallableScaleModule

@@ -1,0 +1,58 @@
+"""Capacity-gate gauge publication — the one emitter of the
+``scale.gate.*`` family.
+
+The ``run_tests.py --scale`` gate replays the same recorded burst
+against a static fleet and an elastic one, then publishes the verdict
+inputs here so :mod:`tools/capacity_report` can judge the run from the
+metrics JSONL alone (the report process never touches the service).
+Keeping the emitter inside ``slate_tpu/`` — with every name a literal
+— is what lets the metric-drift lint hold the gate driver and the
+report to the same spelling.
+"""
+
+from typing import Dict
+
+from ..aux import metrics
+
+#: every gauge the capacity gate publishes; tools/capacity_report.py
+#: joins exactly these names.  static/elastic_p99_s are the two legs'
+#: tail latencies, budget_s the SLO both are judged against,
+#: replica_peak/replicas_end the fleet's high-water mark and final
+#: size, min/max_replicas + up_threshold the policy bounds the verdict
+#: checks them against, and new_lane_compiles the steady-state compile
+#: count (total jit.compilations minus the counted pre-traffic
+#: device_primes inside add_replica).
+GATE_GAUGES = (
+    "scale.gate.static_p99_s",
+    "scale.gate.elastic_p99_s",
+    "scale.gate.budget_s",
+    "scale.gate.replica_peak",
+    "scale.gate.replicas_end",
+    "scale.gate.min_replicas",
+    "scale.gate.max_replicas",
+    "scale.gate.up_threshold",
+    "scale.gate.new_lane_compiles",
+    "scale.gate.device_primes",
+)
+
+_PREFIX = "scale.gate."
+
+
+def publish(values: Dict[str, float]) -> None:
+    """Publish the gate verdict inputs as ``scale.gate.*`` gauges.
+
+    ``values`` keys are the un-prefixed gauge names (``"budget_s"``,
+    not ``"scale.gate.budget_s"``).  Every known gauge must be present
+    and no unknown key is accepted — a silently dropped or misspelled
+    column is exactly the drift that would make the capacity report
+    judge a different run than the one that happened."""
+    want = {g[len(_PREFIX):] for g in GATE_GAUGES}
+    missing = want - set(values)
+    extra = set(values) - want
+    if missing or extra:
+        raise KeyError(
+            f"capacity gate gauges: missing={sorted(missing)} "
+            f"unknown={sorted(extra)}"
+        )
+    for name in GATE_GAUGES:
+        metrics.gauge(name, float(values[name[len(_PREFIX):]]))

@@ -902,3 +902,60 @@ class ExecutableCache:
         metrics.inc("serve.restore_restored", out["restored"])
         metrics.inc("serve.restore_compiled", out["compiled"])
         return out
+
+    def prime(
+        self,
+        entries=None,
+        devices=None,
+        batch_max: Optional[int] = None,
+        verbose: bool = False,
+        stop_check: Optional[Callable[[], bool]] = None,
+        tag: str = "prime",
+    ) -> Dict[str, int]:
+        """Partial :meth:`_bring_live` by plan: bring a CALLER-ORDERED
+        ``(key, batch)`` subset live, artifact-first, priming each
+        entry's per-``devices`` dispatch variants.  This is the
+        scale-up lane's warm path (``SolverService.add_replica``) and
+        the applicator of a predictive
+        :class:`~slate_tpu.scale.warmup_plan.WarmupPlan` — the caller's
+        order IS the ranking, so a priming deadline truncates from the
+        plan's bottom, not alphabetically.
+
+        ``entries=None`` walks the full live manifest (restore
+        semantics over the whole working set).  Explicit entries are
+        registered into the manifest first — a planned bucket this
+        process has never dispatched still warms, and a later
+        restart's restore pass inherits it.
+
+        Failures are counted and skipped, never raised (a scale-up
+        lane degrades to compile-on-traffic; it does not abort the
+        scale-up).  Returns ``{"entries", "restored", "compiled",
+        "failed", "skipped"}``."""
+        if entries is None:
+            todo, _unfit = self._live_todo(batch_max=batch_max)
+        else:
+            todo = []
+            for key, batch in entries:
+                batch = int(batch)
+                if (batch_max is not None and not key.mesh
+                        and batch > batch_max):
+                    continue
+                self.ensure_manifest(key, (batch,))
+                todo.append((key, batch))
+        out = {
+            "entries": 0, "restored": 0, "compiled": 0, "failed": 0,
+            "skipped": 0,
+        }
+
+        def on_error(key, batch, exc):
+            metrics.inc("serve.prime_failed")
+
+        with metrics.phase("serve.prime", always=True) as ph:
+            for _k, _b, outcome, _origin in self._bring_live(
+                todo, devices=devices, on_error=on_error,
+                stop_check=stop_check, verbose=verbose, tag=tag,
+            ):
+                out["entries"] += 1
+                out[outcome] += 1
+        metrics.gauge("serve.prime_s", ph.seconds)
+        return out

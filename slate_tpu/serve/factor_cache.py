@@ -410,6 +410,26 @@ class FactorCache:
             self._gauges_locked()
             return n
 
+    def rehome(self, old_replica: str,
+               new_replica: Optional[str]) -> int:
+        """Reassign every entry homed on ``old_replica`` to
+        ``new_replica`` (scale-down: a removed lane's factors keep
+        serving hits from a surviving lane instead of forcing counted
+        refactors; LRU positions are untouched — re-homing is not a
+        use).  ``new_replica=None`` un-pins them (any lane may serve
+        the hit's solve dispatch on its own device).  Returns the
+        count moved."""
+        moved = 0
+        with self._lock:
+            sync.guarded(self, "_entries")  # race-plane probe (no-op off)
+            for entry in self._entries.values():
+                if entry.replica == old_replica:
+                    entry.replica = new_replica
+                    moved += 1
+        if moved:
+            record("rehome", n=moved)
+        return moved
+
     # -- rank-k up/downdate ------------------------------------------------
 
     def update(

@@ -137,6 +137,21 @@ class PlacementPolicy:
         state stays compile-free on EVERY replica, not just the first."""
         return [self.device_for(i) for i in range(self.replicas)]
 
+    def set_replicas(self, n: int) -> int:
+        """Resize the replica set (elastic capacity plane: the service
+        calls this from ``add_replica``/``remove_replica`` so
+        :meth:`device_for` / :meth:`replica_devices` track the LIVE
+        lane count, not the construction-time one).  Clamped to >= 1;
+        returns the applied count.
+
+        Note the 1 -> 2 asymmetry: the original single replica was
+        placed with ``device_for() -> None`` (default-device dispatch)
+        and keeps that placement — re-pinning a live lane would
+        invalidate its primed per-device executables mid-traffic.  New
+        lanes get real pins from the grown pool."""
+        self.replicas = max(int(n), 1)
+        return self.replicas
+
     # -- routing -------------------------------------------------------------
 
     def mesh_for(

@@ -158,10 +158,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Union
@@ -219,6 +220,29 @@ BACKOFF_CAP_S = 2.0
 PHASE_COLD = "cold"
 PHASE_RESTORING = "restoring"
 PHASE_READY = "ready"
+
+#: terminal lane states (health()["lanes"] / ["terminal_lanes"]):
+#: draining = remove_replica() is quiescing the lane; removed = gone.
+#: Live lanes report state "live" — a vanished row would make
+#: scale-down indistinguishable from a crash
+LANE_LIVE = "live"
+LANE_DRAINING = "draining"
+LANE_REMOVED = "removed"
+
+
+def _scale_policy_armed() -> bool:
+    """Cheap pre-check for the elastic capacity plane: is a non-off
+    ``SLATE_TPU_SCALE`` / ``Option.ServeScale`` spec present?  Kept
+    separate from the real parser so the off path never imports the
+    scale/ package at all (zero-overhead-off contract)."""
+    from ..enums import Option
+    from ..options import get_option
+
+    spec = os.environ.get("SLATE_TPU_SCALE")
+    if spec is None:
+        spec = str(get_option(None, Option.ServeScale) or "")
+    spec = spec.strip().lower()
+    return bool(spec) and spec not in ("0", "off", "false", "no")
 
 
 def decorrelated_backoff(
@@ -342,6 +366,9 @@ class _Replica:
         self.q: Deque[_Request] = deque()  # guarded by: _cond
         self.inflight: List[_Request] = []  # guarded by: _cond
         self.breakers: Dict[_bk.BucketKey, _bk.Breaker] = {}  # guarded by: _cond
+        # scale-down drain flag: remove_replica() sets it, the worker
+        # loop exits on it (re-homing any stragglers first)
+        self.stopping = False  # guarded by: _cond
         self.thread: Optional[threading.Thread] = None
         self.restarts = 0
         self.dispatched = 0  # requests this lane executed (incl. direct)
@@ -352,6 +379,7 @@ class _Replica:
         self.oldest_gauge = f"serve.replica.{name}.oldest_queued_s"
         self.quar_counter = f"serve.replica.{name}.quarantined"
         self.unquar_counter = f"serve.replica.{name}.unquarantined"
+        self.removed_counter = f"serve.replica.{name}.removed"
         self.lat_hist = f"serve.latency.replica.{name}.total"
         self.lane = f"replica-{name}"  # span lane label (one Perfetto row)
 
@@ -657,6 +685,23 @@ class SolverService:
         # latency-histogram labels this service has dispatched (the SLO
         # surface health() reports percentiles for)
         self._seen_labels: set = set()
+        # elastic capacity plane (scale/): replica lifecycle state —
+        # lane names are MONOTONIC ordinals (never reused: a reused
+        # name would merge a dead lane's per-lane metric series with
+        # its successor's), and removed/draining lanes keep a terminal
+        # row so health() can tell scale-down from a crash
+        self._next_replica = len(self._replicas)  # guarded by: _cond
+        self._terminal: "OrderedDict[str, dict]" = OrderedDict()  # guarded by: _cond
+        # the scaler itself (None unless configured — the zero-overhead
+        # contract: with SLATE_TPU_SCALE unset the scale/ package is
+        # never even imported and the hot path is byte-identical)
+        self._scaler = None
+        if _scale_policy_armed():
+            from ..scale.controller import AutoScaler, policy_from_options
+
+            policy = policy_from_options()
+            if policy is not None:
+                self._scaler = AutoScaler(self, policy)
         self._t_started = time.monotonic()
         if start:
             self.start()
@@ -709,6 +754,8 @@ class SolverService:
         for rep in self._lanes:
             self._spawn_worker(rep)
         self._begin_restore()
+        if self._scaler is not None:
+            self._scaler.start()
         return self
 
     def _begin_restore(self) -> None:
@@ -829,6 +876,10 @@ class SolverService:
         ``serve.drained``; ones still pending at the bound count
         ``serve.drain_abandoned`` and resolve Rejected like any other
         leftover."""
+        # the scaler first: a control loop adding/removing lanes while
+        # the teardown below snapshots self._lanes would race it
+        if self._scaler is not None:
+            self._scaler.stop()
         if drain:
             if drain_timeout is None:
                 from ..enums import Option
@@ -898,6 +949,199 @@ class SolverService:
     def __exit__(self, *exc) -> bool:
         self.stop()
         return False
+
+    # -- elastic capacity (scale/) -----------------------------------------
+
+    def _rehome_queue_locked(self, rep: _Replica) -> int:
+        """Move every request queued on ``rep`` to surviving lanes
+        (caller holds ``_cond``; ``rep`` is already out of
+        ``self._replicas`` so the picker cannot choose it).  Returns
+        the count moved."""
+        pending = list(rep.q)
+        if not pending:
+            return 0
+        rep.q.clear()
+        for r in pending:
+            tgt = self._pick_replica_locked(r.key)
+            sync.guarded(tgt, "q")
+            tgt.q.append(r)
+        metrics.inc("scale.requests_rehomed", len(pending))
+        metrics.gauge(rep.q_gauge, 0)
+        self._gauge_queues_locked()
+        self._cond.notify_all()
+        return len(pending)
+
+    def _prime_lane(self, rep: _Replica, plan=None) -> Dict[str, int]:
+        """Artifact-first warm of one joining lane's device BEFORE it
+        takes traffic — the scale-up half of the zero-steady-state-
+        compiles contract (``ExecutableCache.prime``: export artifacts
+        load where the store has them, per-device dispatch variants
+        prime either way).  ``plan`` narrows the walk to a predictive
+        :class:`~slate_tpu.scale.warmup_plan.WarmupPlan` (or a raw
+        ``(key, batch)`` iterable); None warms the whole live
+        manifest."""
+        devices = [rep.device] if rep.device is not None else None
+        entries = (
+            plan.pairs() if hasattr(plan, "pairs")
+            else list(plan) if plan is not None else None
+        )
+
+        def stop_check() -> bool:
+            return self._stopped
+
+        counts = self.cache.prime(
+            entries, devices=devices, batch_max=self.batch_max,
+            stop_check=stop_check, tag="scale_warm",
+        )
+        if metrics.is_on():
+            for k in ("restored", "compiled", "failed", "skipped"):
+                if counts.get(k):
+                    metrics.inc(f"scale.prime_{k}", counts[k])
+        return counts
+
+    def add_replica(self, warm: bool = True, plan=None) -> str:
+        """Bring one NEW serving lane live (elastic scale-up).
+
+        The lane joins warm: its device is primed through the artifact
+        store + the cache's partial bring-live walk before the worker
+        spawns, so the lane's first steady-state request compiles
+        nothing.  Lane names are monotonic ordinals and never reused —
+        a reused name would splice a dead lane's per-lane metric
+        series onto its successor's.  ``plan`` optionally narrows the
+        warm walk (predictive warmup).  Returns the new lane's name.
+        Raises RuntimeError when the service is not running."""
+        with self._cond:
+            if self._stopped or not self._running:
+                raise RuntimeError("add_replica: service is not running")
+            name = str(self._next_replica)
+            self._next_replica += 1
+            idx = len(self._replicas)
+            # grow the placement domain first: device_for(idx) answers
+            # against the NEW count (1 -> 2 starts real pinning)
+            self.placement.set_replicas(idx + 1)
+            device = self.placement.device_for(idx)
+        rep = _Replica(name, device)
+        warmed: Dict[str, int] = {}
+        if warm:
+            # outside _cond: priming compiles/loads executables —
+            # seconds of work the serving lanes must not stall behind
+            warmed = self._prime_lane(rep, plan)
+        with self._cond:
+            if self._stopped or not self._running:
+                self.placement.set_replicas(len(self._replicas))
+                raise RuntimeError(
+                    "add_replica: service stopped while priming"
+                )
+            if self._admission is not None:
+                rep.q = self._admission.new_queue()
+            if self._integrity is not None:
+                rep.score = self._integrity.new_score()
+            self._replicas.append(rep)
+            self.placement.set_replicas(len(self._replicas))
+            fleet = len(self._replicas)
+            self._cond.notify_all()
+        self._spawn_worker(rep)
+        metrics.inc("scale.replicas_added")
+        metrics.gauge("scale.fleet", fleet)
+        if spans.is_on():
+            spans.event(
+                "replica_added", lane=rep.lane,
+                restored=warmed.get("restored", 0),
+                compiled=warmed.get("compiled", 0),
+            )
+        return name
+
+    def remove_replica(
+        self, name: Optional[str] = None, drain_timeout: float = 30.0
+    ) -> str:
+        """Quiesce and remove one lane (elastic scale-down); default
+        victim is the newest (highest-ordinal) lane.
+
+        The lane leaves the admission pool immediately and its queue
+        re-homes to surviving lanes (every admitted future stays owned
+        by a live worker); the worker finishes any in-flight batch and
+        exits via its drain branch, bounded by ``drain_timeout``.
+        Lane-affine factor-cache entries then re-home to a survivor —
+        repeat-A traffic keeps hitting instead of paying counted
+        refactors.  The lane's health row does NOT vanish: it moves to
+        the terminal table (state draining -> removed), so scale-down
+        stays distinguishable from a crash.  Raises ValueError for the
+        last lane or an unknown name."""
+        with self._cond:
+            if len(self._replicas) <= 1:
+                raise ValueError(
+                    "remove_replica: cannot remove the last lane"
+                )
+            if name is None:
+                rep = self._replicas[-1]
+            else:
+                rep = next(
+                    (r for r in self._replicas if r.name == name), None
+                )
+                if rep is None:
+                    raise ValueError(
+                        f"remove_replica: no lane named {name!r}"
+                    )
+            self._replicas.remove(rep)
+            self.placement.set_replicas(len(self._replicas))
+            sync.guarded(rep, "stopping")
+            rep.stopping = True
+            self._terminal[rep.name] = {
+                "name": rep.name, "state": LANE_DRAINING,
+                "device": (
+                    str(rep.device) if rep.device is not None else None
+                ),
+                "dispatched": rep.dispatched,
+                "restarts": rep.restarts,
+            }
+            moved = self._rehome_queue_locked(rep)
+            self._cond.notify_all()
+            t = rep.thread
+            survivor = self._replicas[0]
+        if spans.is_on():
+            spans.event("drain", lane=rep.lane, rehomed=moved)
+        if t is not None:
+            t.join(max(float(drain_timeout), 0.0))
+        # factor re-homing OUTSIDE _cond: FactorCache is self-locked
+        # and LOCK_ORDER.json keeps service._cond out of its edges —
+        # nesting here would mint a cond -> factor-cache edge for no
+        # gain
+        refactored = 0
+        if self.factor_cache is not None:
+            refactored = self.factor_cache.rehome(
+                rep.name, survivor.name
+            )
+        with self._cond:
+            # the worker exits through its drain branch; anything that
+            # STILL landed here (a requeue racing the join bound)
+            # moves too
+            self._rehome_queue_locked(rep)
+            if rep.thread is t:
+                rep.thread = None
+            row = self._terminal.get(rep.name, {"name": rep.name})
+            row.update({
+                "state": LANE_REMOVED, "dispatched": rep.dispatched,
+                "restarts": rep.restarts,
+                "factor_rehomed": refactored,
+                "drain_timed_out": bool(t is not None and t.is_alive()),
+            })
+            self._terminal[rep.name] = row
+            while len(self._terminal) > 64:  # bounded terminal table
+                self._terminal.popitem(last=False)
+            fleet = len(self._replicas)
+        metrics.inc("scale.replicas_removed")
+        metrics.inc(rep.removed_counter)
+        metrics.gauge("scale.fleet", fleet)
+        metrics.gauge(rep.q_gauge, 0)
+        metrics.gauge(rep.oldest_gauge, 0.0)
+        if refactored:
+            metrics.inc("scale.factors_rehomed", refactored)
+        if spans.is_on():
+            spans.event(
+                "replica_removed", lane=rep.lane,
+                factor_rehomed=refactored,
+            )
+        return rep.name
 
     # -- admission ---------------------------------------------------------
 
@@ -1234,12 +1478,40 @@ class SolverService:
                     )
                     if own is not None:
                         b = own.breakers.get(key)
+                        own_load = len(own.q) + len(own.inflight)
+                        alt_load = len(rep.q) + len(rep.inflight)
                         if b is not None and b.cooling_down(
                             time.monotonic(), self.breaker_cooldown_s
                         ):
                             _fc_record(
                                 "spill", fp=fp, label=full_key.label
                             )
+                            req.key = key = full_key
+                            req.factor_miss = True
+                        elif (
+                            self._scaler is not None
+                            and own is not rep
+                            and own_load > 2 * self.batch_max
+                            and own_load >= 4 * (alt_load + 1)
+                        ):
+                            # elastic affinity spill: factor affinity
+                            # would funnel a repeat-heavy burst onto the
+                            # owning lane no matter how many lanes the
+                            # capacity plane adds — a scale-up that
+                            # nobody routes to is dead weight.  When the
+                            # owner is drowning (queue+inflight past the
+                            # batch window AND 4x the least-loaded lane)
+                            # pay ONE counted refactor on the idle lane
+                            # instead of queueing behind the backlog;
+                            # the refactor re-pins the fingerprint there
+                            # (fc.put in the worker), so affinity
+                            # migrates and later hits follow.  Armed
+                            # only with the scaler: the env-off service
+                            # routes byte-identically.
+                            _fc_record(
+                                "spill", fp=fp, label=full_key.label
+                            )
+                            metrics.inc("scale.affinity_spills")
                             req.key = key = full_key
                             req.factor_miss = True
                         else:
@@ -1370,6 +1642,7 @@ class SolverService:
                         merged[lbl] = st
                 lanes.append({
                     "name": rep.name,
+                    "state": LANE_LIVE,
                     "device": str(rep.device) if rep.device is not None
                     else None,
                     "queue_depth": len(rep.q),
@@ -1385,6 +1658,10 @@ class SolverService:
                     "dispatched": rep.dispatched,
                     "breakers": states,
                 })
+            # terminal lanes (scale-down): draining/removed rows stay
+            # in the table — a vanished row would make scale-down
+            # indistinguishable from a crash
+            terminal = [dict(row) for row in self._terminal.values()]
             recent = [t for t in self._recent_fail if now - t <= window_s]
             phase = self._phase
             restore_result = (
@@ -1402,6 +1679,20 @@ class SolverService:
         shard_lane = lanes.pop() if self._shard_rep is not None else None
         if shard_lane is not None:
             shard_lane["mesh"] = self.placement.mesh
+        # terminal rows ride in the same per-replica table, normalized
+        # to its shape (zero queue, dead worker) with their terminal
+        # state — AFTER the shard pop so the pop stays positional
+        for row in terminal:
+            lanes.append({
+                "queue_depth": 0, "inflight": 0, "oldest_queued_s": 0.0,
+                "worker_alive": False, "breakers": {}, **row,
+            })
+        # the elastic capacity plane (None when off — the key is
+        # always present, like integrity/tenants/admission)
+        capacity = None
+        if self._scaler is not None:
+            capacity = self._scaler.describe()
+            capacity["terminal_lanes"] = [r["name"] for r in terminal]
         # restore-stuck surfacing (satellite): a phase that has sat in
         # "restoring" past restore_stuck_after_s reports its age, so a
         # wait_ready(timeout=) caller that got False can tell a wedged
@@ -1512,6 +1803,7 @@ class SolverService:
                 self._admission.snapshot()
                 if self._admission is not None else None
             ),
+            "capacity": capacity,
             "failures_60s": len(recent),
             "failure_rate_60s": len(recent) / window_s,
             "uptime_s": now - self._t_started,
@@ -1540,12 +1832,17 @@ class SolverService:
             inflight, rep.inflight = rep.inflight, []
             rep.restarts += 1
             self._restarts += 1
-            respawn = self._running
+            # a draining lane (scale-down) is never respawned — but its
+            # retry-budgeted in-flight work still requeues: the lane's
+            # queue is re-homed to survivors by remove_replica's final
+            # sweep once this thread exits
+            respawn = self._running and not rep.stopping
+            requeue_ok = self._running
         self._note_failure()
         for r in inflight:
             if r.future.done():
                 continue  # _execute resolved it before the death
-            if respawn and r.retries > 0:
+            if requeue_ok and r.retries > 0:
                 self._requeue_with_backoff(rep, r)
             else:
                 # no worker will ever pop a re-enqueued request once
@@ -1600,7 +1897,7 @@ class SolverService:
         expired: List[_Request] = []
         with self._cond:
             first: Optional[_Request] = None
-            while self._running:
+            while self._running and not rep.stopping:
                 now = time.monotonic()
                 # deadline sweep over the whole queue before eligibility:
                 # a request that is backing off (not_before in the
@@ -1636,6 +1933,13 @@ class SolverService:
                     self._cond.wait(min(max(wake, 0.001), 0.05))
                 else:
                     self._cond.wait(0.05)
+            if rep.stopping and self._running:
+                # scale-down drain: this lane is leaving the fleet but
+                # the SERVICE is still up — stragglers (a supervisor
+                # requeue, a hedge clone landed after the drain sweep)
+                # re-home to surviving lanes instead of failing
+                self._rehome_queue_locked(rep)
+                return None
             if not self._running:
                 # resolve anything the failure path re-enqueued after
                 # stop() drained the queue — futures must never strand

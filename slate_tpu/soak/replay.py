@@ -331,6 +331,36 @@ def gen_deadline_storm(requests: int = 100, seed: int = 0, *,
     return rows
 
 
+def gen_burst(requests: int = 300, seed: int = 0, *,
+              base_rps: float = 40.0, burst_rps: float = 400.0,
+              burst_start_s: float = 1.0, burst_len_s: float = 1.5,
+              n: int = 12, nrhs: int = 2, distinct: int = 4,
+              routine: str = "gesv") -> List[dict]:
+    """A quiet baseline stream with one hard traffic step in the
+    middle — the elastic capacity plane's canonical input.  Arrivals
+    run at ``base_rps`` until ``burst_start_s``, jump to ``burst_rps``
+    for ``burst_len_s``, then fall back to ``base_rps`` until the
+    request budget is spent.  A static fleet sized for the baseline
+    builds queue (and misses its tail budget) inside the burst; an
+    elastic fleet must scale up through it and give the lanes back
+    after — ``run_tests.py --scale`` replays exactly this shape twice.
+
+    Rows draw from a ``distinct``-matrix pool with fresh right-hand
+    sides (bursts of same-A traffic, the factor cache's steady state),
+    so burst latency measures dispatch capacity, not factorization."""
+    rng = random.Random(seed)
+    rows = []
+    t = 0.0
+    for k in range(requests):
+        in_burst = burst_start_s <= t < burst_start_s + burst_len_s
+        rate = burst_rps if in_burst else base_rps
+        fp = f"burst-{seed}-{k % max(distinct, 1)}"
+        rows.append(_row(round(t, 6), routine, n, nrhs, "gold", "normal",
+                         _seed_of(fp), k, repeat_fp=fp))
+        t += rng.expovariate(rate)
+    return rows
+
+
 def warm_spec(rows: List[dict], gap_s: float = 0.025) -> List[dict]:
     """A pool-warming prelude for ``rows``: the first row of every
     ``repeat_fp`` group, re-paced serially ``gap_s`` apart.  Replaying
@@ -368,4 +398,5 @@ GENERATORS: Dict[str, object] = {
     "repeated_a": gen_repeated_a,
     "adversarial_flood": gen_adversarial_flood,
     "deadline_storm": gen_deadline_storm,
+    "burst": gen_burst,
 }

@@ -1,0 +1,486 @@
+"""Elastic capacity plane tests: the SLATE_TPU_SCALE grammar, the
+pure hysteresis controller (seeded determinism, no-flap), the signal
+aggregator's pure fold, predictive warmup planning from recorded
+traces, and the live add/remove replica lifecycle (drain with
+inflight work, factor re-homing, terminal health rows).
+
+The service-backed tests share one module-scoped ExecutableCache (the
+test_serve pattern) so each (bucket, batch) executable compiles once
+for the file; controller/aggregator/plan tests are pure and never
+touch jax.
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import metrics
+from slate_tpu.scale import controller as ctl
+from slate_tpu.scale import signals as sig
+from slate_tpu.scale import warmup_plan as wp
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import FactorCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.soak import replay
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _service(shared_cache, **kw):
+    cfg = dict(
+        cache=shared_cache, batch_max=1, batch_window_s=0.0005,
+        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR, replicas=1,
+        factor_cache=FactorCache(max_entries=64),
+    )
+    cfg.update(kw)
+    svc = SolverService(**cfg)
+    k = bk.bucket_for("gesv", 12, 12, 2, np.float64, floor=FLOOR,
+                      nrhs_floor=NRHS_FLOOR)
+    svc.cache.ensure_manifest(k, (1,))
+    svc.cache.ensure_manifest(k.solve_sibling(), (1,))
+    svc.warmup()
+    return svc
+
+
+def _ops(rng, n=12, nrhs=2):
+    A = rng.standard_normal((n, n)) + n * np.eye(n)
+    B = rng.standard_normal((n, nrhs))
+    return A, B
+
+
+# ---------------------------------------------------------------------------
+# SLATE_TPU_SCALE grammar + policy validation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_off_tokens():
+    for spec in ("", "0", "off", "OFF", "false", "no"):
+        assert ctl.parse_spec(spec) is None
+
+
+def test_parse_spec_defaults_and_kv():
+    assert ctl.parse_spec("on") == ctl.ScalePolicy()
+    assert ctl.parse_spec("1") == ctl.ScalePolicy()
+    p = ctl.parse_spec("min=2,max=6,up=1.5,down=0.1,step=3,period=0.5")
+    assert (p.min_replicas, p.max_replicas) == (2, 6)
+    assert (p.up_threshold, p.down_threshold) == (1.5, 0.1)
+    assert (p.step_max, p.period_s) == (3, 0.5)
+
+
+def test_parse_spec_rejects_unknown_keys():
+    with pytest.raises(ValueError):
+        ctl.parse_spec("replicas=3")
+    with pytest.raises(ValueError):
+        ctl.parse_spec("min")  # bare token, not k=v
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        ctl.ScalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        ctl.ScalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        ctl.ScalePolicy(up_threshold=0.5, down_threshold=0.5)
+
+
+# ---------------------------------------------------------------------------
+# controller: hysteresis, cooldowns, AIMD, determinism
+# ---------------------------------------------------------------------------
+
+
+def _snap(t, pressure, replicas):
+    return sig.PressureSnapshot(
+        t=t, replicas=replicas, queue_depth=0, inflight=0,
+        queue_per_replica=0.0, oldest_queued_s=0.0, burn_ewma=0.0,
+        overload_level=0, request_rate=0.0, hedge_rate=0.0,
+        pad_waste_rate=0.0, hbm_headroom_frac=None, pressure=pressure,
+    )
+
+
+def test_controller_aimd_up_and_single_step_down():
+    pol = ctl.ScalePolicy(min_replicas=1, max_replicas=8,
+                          up_cooldown_s=1.0, down_cooldown_s=2.0,
+                          step_max=4)
+    c = ctl.ScaleController(pol)
+    d1 = c.decide(_snap(0.0, 2.0, 1))
+    assert (d1.action, d1.delta) == (ctl.UP, 1)
+    # inside the up cooldown: hold, whatever the pressure says
+    assert c.decide(_snap(0.5, 3.0, 2)).action == ctl.HOLD
+    # sustained saturation: the step doubles (1 -> 2 -> 4, capped)
+    d2 = c.decide(_snap(1.1, 2.0, 2))
+    assert (d2.action, d2.delta) == (ctl.UP, 2)
+    d3 = c.decide(_snap(2.2, 2.0, 4))
+    assert (d3.action, d3.delta) == (ctl.UP, 4)
+    # scale-down is additive: one lane, after the longer cooldown
+    assert c.decide(_snap(3.0, 0.0, 8)).action == ctl.HOLD
+    d4 = c.decide(_snap(4.3, 0.0, 8))
+    assert (d4.action, d4.delta) == (ctl.DOWN, 1)
+
+
+def test_controller_bound_holds():
+    c = ctl.ScaleController(ctl.ScalePolicy(min_replicas=1,
+                                            max_replicas=2))
+    assert c.decide(_snap(0.0, 5.0, 2)).reason == "at max_replicas"
+    assert c.decide(_snap(1.0, 0.0, 1)).reason == "at min_replicas"
+    assert c.decide(_snap(2.0, 0.5, 1)).reason == "in hysteresis band"
+
+
+def _raw_stream():
+    """A deterministic synthetic observation stream: quiet, a queue
+    burst, quiet again.  Plain dicts — exactly what read_raw returns —
+    so the fold is exercised end to end without a service."""
+    rows = []
+    reqs = 0.0
+    for i in range(60):
+        burst = 10 <= i < 30
+        reqs += 4.0 if burst else 1.0
+        rows.append({
+            # the fleet grows mid-stream (as the actuator would have
+            # made it): the quiet tail must produce scale-DOWNs
+            "t": i * 0.05, "replicas": 2.0 if i >= 30 else 1.0,
+            "queue_depth": 9.0 if burst else 0.0,
+            "inflight": 1.0,
+            "oldest_queued_s": 0.8 if burst else 0.0,
+            "burn_ewma": 0.3 if burst else 0.0,
+            "overload_level": 0.0, "requests": reqs,
+            "hedges": 0.0, "pad_rows": 0.0,
+            "hbm_headroom_frac": None,
+        })
+    return rows
+
+
+def test_controller_seeded_determinism():
+    def run():
+        agg = sig.SignalAggregator()
+        c = ctl.ScaleController(ctl.ScalePolicy(
+            up_cooldown_s=0.3, down_cooldown_s=0.5))
+        return [c.decide(agg.update(raw)) for raw in _raw_stream()]
+
+    a, b = run(), run()
+    # frozen dataclasses all the way down: == compares the full
+    # decision record including the driving snapshot
+    assert a == b
+    assert any(d.action == ctl.UP for d in a)
+    assert any(d.action == ctl.DOWN for d in a)
+
+
+def test_no_flap_under_oscillating_pressure():
+    """Pressure square-waves across both thresholds every sample; the
+    cooldowns must keep the fleet from ping-ponging."""
+    pol = ctl.ScalePolicy(min_replicas=1, max_replicas=3,
+                          up_cooldown_s=0.5, down_cooldown_s=1.0)
+    c = ctl.ScaleController(pol)
+    n = 1
+    changes = []
+    for i in range(100):
+        t = i * 0.05
+        p = 2.0 if i % 2 == 0 else 0.0
+        d = c.decide(_snap(t, p, n))
+        if d.action == ctl.UP:
+            n += d.delta
+            changes.append((t, d.action))
+        elif d.action == ctl.DOWN:
+            n -= d.delta
+            changes.append((t, d.action))
+        assert pol.min_replicas <= n <= pol.max_replicas
+    # 50 threshold crossings each way, but every applied change must
+    # clear the cooldown of its direction from the PREVIOUS change
+    for (t0, _a0), (t1, a1) in zip(changes, changes[1:]):
+        floor = (pol.up_cooldown_s if a1 == ctl.UP
+                 else pol.down_cooldown_s)
+        assert t1 - t0 >= floor - 1e-9, changes
+    assert len(changes) <= 8, changes
+
+
+def test_aggregator_pure_fold_and_reset():
+    agg = sig.SignalAggregator()
+    snaps = [agg.update(r) for r in _raw_stream()]
+    agg.reset()
+    again = [agg.update(r) for r in _raw_stream()]
+    assert snaps == again
+    # the burst must push the composite past 1.0 and decay after
+    assert max(s.pressure for s in snaps) > 1.0
+    assert snaps[-1].pressure < 0.25
+    # rates derive from counter deltas: quiet tail ~= 20 req/s
+    assert snaps[-1].request_rate == pytest.approx(20.0, rel=0.5)
+
+
+# ---------------------------------------------------------------------------
+# predictive warmup planning
+# ---------------------------------------------------------------------------
+
+
+def _trace_rows():
+    rows = []
+    # hot small bucket: 3 repeat groups x 20 rows, bursty arrivals
+    for g in range(3):
+        for i in range(20):
+            rows.append({
+                "t_offset": g * 1.0 + (i // 4) * 0.1 + (i % 4) * 1e-4,
+                "routine": "gesv", "bucket_shape": [12, 12, 2],
+                "dtype": "float64", "repeat_fp": f"hot-{g}",
+                "matrix_seed": g, "rhs_seed": i,
+            })
+    # rare large bucket: 4 singleton rows (no repeats, no bursts)
+    for i in range(4):
+        rows.append({
+            "t_offset": 10.0 + i, "routine": "gesv",
+            "bucket_shape": [48, 48, 2], "dtype": "float64",
+            "repeat_fp": None, "matrix_seed": 100 + i,
+            "rhs_seed": i,
+        })
+    return rows
+
+
+def test_plan_ranking_traffic_times_cost():
+    plan = wp.plan_from_trace(_trace_rows(), batch_max=4,
+                              batch_window_s=0.005, dim_floor=FLOOR,
+                              nrhs_floor=NRHS_FLOOR)
+    assert plan.total_rows == 64
+    scores = [e.score for e in plan.entries]
+    assert scores == sorted(scores, reverse=True)
+    labels = {(e.key.label, e.key.phase, e.batch)
+              for e in plan.entries}
+    # the bursty hot bucket plans its coalesced batch point too
+    hot = [e for e in plan.entries
+           if e.key.n == 16 and e.key.phase == "full"]
+    assert {e.batch for e in hot} == {1, 4}
+    # repeat groups dispatch the solve sibling on a warm factor
+    # cache: the trsm-only family must be in the plan
+    assert any(ph == "solve" for (_l, ph, _b) in labels)
+    # the rare-but-huge bucket outranks the hot-but-tiny one:
+    # 4/64 x flops(64) beats 60/64 x flops(16)
+    big = next(e for e in plan.entries if e.key.n == 64)
+    small_b1 = next(e for e in hot if e.batch == 1)
+    assert big.score > small_b1.score
+
+
+def test_plan_preload_ranks_by_bought_hits():
+    plan = wp.plan_from_trace(_trace_rows(), dim_floor=FLOOR,
+                              nrhs_floor=NRHS_FLOOR)
+    assert [p.repeat_fp for p in plan.preload] == [
+        "hot-0", "hot-1", "hot-2"]
+    assert all(p.rows == 20 for p in plan.preload)
+    # singletons buy no hits: never preloaded
+    assert all(p.repeat_fp.startswith("hot-") for p in plan.preload)
+
+
+def test_plan_save_load_round_trip(tmp_path):
+    plan = wp.plan_from_trace(_trace_rows(), dim_floor=FLOOR,
+                              nrhs_floor=NRHS_FLOOR)
+    path = plan.save(str(tmp_path / "plan.jsonl"))
+    back = wp.WarmupPlan.load(path)
+    assert back.total_rows == plan.total_rows
+    assert back.entries == plan.entries
+    assert back.preload == plan.preload
+    assert back.pairs(2) == plan.pairs(2)
+
+
+def test_plan_from_generated_burst_trace():
+    rows = replay.gen_burst(200, seed=3, base_rps=50, burst_rps=500,
+                            burst_start_s=0.5, burst_len_s=0.5)
+    plan = wp.plan_from_trace(rows, batch_max=8, dim_floor=FLOOR,
+                              nrhs_floor=NRHS_FLOOR)
+    assert plan.total_rows == 200
+    assert plan.entries and plan.preload
+    # the burst coalesces: some batch point above 1 is planned
+    assert max(e.batch for e in plan.entries) > 1
+
+
+def test_gen_burst_shape():
+    rows = replay.gen_burst(400, seed=1, base_rps=30, burst_rps=300,
+                            burst_start_s=1.0, burst_len_s=1.0)
+    in_burst = [r for r in rows if 1.0 <= r["t_offset"] < 2.0]
+    before = [r for r in rows if r["t_offset"] < 1.0]
+    # ~30 arrivals in the first second, ~300 in the burst second
+    assert len(before) < len(in_burst) / 3
+    assert rows == sorted(rows, key=lambda r: r["t_offset"])
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead-off + env arming + callable-module compatibility
+# ---------------------------------------------------------------------------
+
+
+def test_scaler_off_by_default(shared_cache, monkeypatch):
+    monkeypatch.delenv(ctl.SCALE_ENV, raising=False)
+    svc = _service(shared_cache)
+    try:
+        assert svc._scaler is None
+        h = svc.health()
+        assert h["capacity"] is None
+        assert all(l["state"] == "live" for l in h["replicas"])
+    finally:
+        svc.stop()
+
+
+def test_env_arms_scaler(shared_cache, monkeypatch):
+    monkeypatch.setenv(ctl.SCALE_ENV, "min=1,max=2,period=30")
+    svc = _service(shared_cache)
+    try:
+        assert svc._scaler is not None
+        assert svc._scaler.policy.max_replicas == 2
+        dec = svc._scaler.step()  # idle fleet at min: hold
+        assert dec.action == ctl.HOLD
+        cap = svc.health()["capacity"]
+        assert cap["policy"]["max_replicas"] == 2
+        assert cap["last_action"] == ctl.HOLD
+        assert metrics.counters().get("scale.decisions") == 1
+    finally:
+        svc.stop()
+    assert svc._scaler._thread is None  # stop() stops the sampler
+
+
+def test_scale_module_still_callable_as_aux_driver():
+    # slate_tpu.scale was the aux scaling routine long before it was
+    # a package; importing the package must not break callers
+    import slate_tpu as st
+    import slate_tpu.scale as scale_pkg
+    from slate_tpu.matrix.matrix import Matrix
+
+    assert scale_pkg.ScalePolicy is ctl.ScalePolicy
+    A0 = np.arange(16.0).reshape(4, 4)
+    A2 = st.scale(3.0, 2.0, Matrix.from_global(A0.copy(), 4))
+    np.testing.assert_allclose(np.asarray(A2.to_global()), A0 * 1.5)
+
+
+# ---------------------------------------------------------------------------
+# live lifecycle: add / remove / drain / re-home
+# ---------------------------------------------------------------------------
+
+
+def test_add_replica_then_steady_state_compile_free(shared_cache):
+    svc = _service(shared_cache)
+    rng = np.random.default_rng(0)
+    try:
+        A, B = _ops(rng)
+        for f in [svc.submit("gesv", A, B) for _ in range(8)]:
+            f.result(30)
+        name = svc.add_replica()
+        with svc._cond:
+            assert len(svc._replicas) == 2
+        # the new lane was primed inside add_replica: steady-state
+        # traffic afterwards compiles nothing
+        with metrics.deltas() as d:
+            futs = [svc.submit("gesv", A, B) for _ in range(16)]
+            for f in futs:
+                f.result(30)
+            assert d.get("jit.compilations") == 0
+        h = svc.health()
+        states = {l["name"]: l["state"] for l in h["replicas"]}
+        assert states[name] == "live"
+        assert metrics.counters().get("scale.replicas_added") == 1
+    finally:
+        svc.stop()
+
+
+def test_remove_replica_drains_and_rehomes(shared_cache):
+    svc = _service(shared_cache, replicas=2)
+    rng = np.random.default_rng(1)
+    try:
+        # distinct matrices fill the factor cache with entries homed
+        # on both lanes
+        ops = [_ops(rng) for _ in range(24)]
+        for f in [svc.submit("gesv", A, B) for A, B in ops]:
+            f.result(30)
+        pre = sum(1 for e in svc.factor_cache._entries.values()
+                  if e.replica == "1")
+        # repeat traffic (factor hits) in flight while lane 1 drains
+        futs = [svc.submit("gesv", A, B) for A, B in ops]
+        removed = svc.remove_replica("1", drain_timeout=60)
+        assert removed == "1"
+        for f in futs:  # every inflight/queued future still resolves
+            np.asarray(f.result(60))
+        with svc._cond:
+            assert len(svc._replicas) == 1
+        # no factor entry left homed on the dead lane
+        assert not any(e.replica == "1"
+                       for e in svc.factor_cache._entries.values())
+        c = metrics.counters()
+        if pre:
+            assert c.get("scale.factors_rehomed", 0) >= pre
+            assert c.get("serve.factor_cache.rehome", 0) >= pre
+        assert c.get("serve.replica.1.removed") == 1
+        # the lane stays visible as a terminal row, not a vanished one
+        h = svc.health()
+        states = {l["name"]: l["state"] for l in h["replicas"]}
+        assert states["1"] == "removed"
+        row = next(l for l in h["replicas"] if l["name"] == "1")
+        assert row["worker_alive"] is False
+        # and the survivor still serves
+        A, B = ops[0]
+        np.asarray(svc.submit("gesv", A, B).result(30))
+    finally:
+        svc.stop()
+
+
+def test_remove_last_lane_refused(shared_cache):
+    svc = _service(shared_cache)
+    try:
+        with pytest.raises(ValueError):
+            svc.remove_replica()
+        with pytest.raises(ValueError):
+            svc.remove_replica("no-such-lane")
+    finally:
+        svc.stop()
+
+
+def test_add_replica_after_stop_refused(shared_cache):
+    svc = _service(shared_cache)
+    svc.stop()
+    with pytest.raises(RuntimeError):
+        svc.add_replica()
+
+
+def test_add_replica_with_plan(shared_cache):
+    """A recorded-trace plan drives the new lane's priming order."""
+    svc = _service(shared_cache)
+    rng = np.random.default_rng(2)
+    try:
+        rows = [{
+            "t_offset": i * 0.001, "routine": "gesv",
+            "bucket_shape": [12, 12, 2], "dtype": "float64",
+            "repeat_fp": "p0", "matrix_seed": 0, "rhs_seed": i,
+        } for i in range(10)]
+        plan = wp.plan_from_trace(rows, batch_max=1, dim_floor=FLOOR,
+                                  nrhs_floor=NRHS_FLOOR)
+        name = svc.add_replica(plan=plan)
+        c = metrics.counters()
+        primed = sum(v for k, v in c.items()
+                     if k.startswith("scale.prime_"))
+        assert primed >= 1
+        A, B = _ops(rng)
+        np.asarray(svc.submit("gesv", A, B).result(30))
+        with svc._cond:
+            assert [r.name for r in svc._replicas] == ["0", name]
+    finally:
+        svc.stop()
+
+
+def test_read_raw_live_service(shared_cache):
+    svc = _service(shared_cache, replicas=2)
+    try:
+        raw = sig.read_raw(svc)
+        assert raw["replicas"] == 2.0
+        assert raw["queue_depth"] >= 0.0
+        snap = sig.SignalAggregator().update(raw)
+        assert snap.replicas == 2
+        assert snap.pressure >= 0.0
+    finally:
+        svc.stop()

@@ -1,0 +1,267 @@
+#!/usr/bin/env python
+"""Elastic-capacity verdict over a metrics JSONL from a ``--scale``
+gate run (or any run with the autoscaler armed).
+
+The capacity plane's claim is narrow and checkable: under a traffic
+burst a static fleet misses its tail budget, an elastic fleet holds
+it, never exceeds ``max_replicas``, hands the lanes back when the
+burst passes, and every scale-up it performed was *driven* — the
+decision's own recorded snapshot shows the pressure that forced it.
+This tool re-derives all of that from the dump alone:
+
+* **Decision timeline** — every ``{"kind": "scale"}`` timeline row
+  (the AutoScaler records one per APPLIED decision, snapshot riding
+  along), printed in order so an operator can replay the controller's
+  reasoning.
+* **p99 before/after** — the gate driver replays the same recorded
+  burst trace twice (static replicas=1, then elastic) and publishes
+  both tails plus the budget as ``scale.gate.*`` gauges; the verdict
+  requires the static leg to MISS (otherwise the run proves nothing)
+  and the elastic leg to HOLD.
+* **Fleet discipline** — peak replicas <= ``max_replicas``, end-of-run
+  replicas == ``min_replicas`` (capacity was given back), and
+  ``scale.gate.new_lane_compiles == 0`` — steady-state compiles,
+  i.e. total ``jit.compilations`` minus the counted pre-traffic lane
+  primes (``serve.device_primes``): every scale-up lane was warmed
+  inside ``add_replica`` before it took traffic, and no request
+  dispatch ever compiled.
+* **Driven decisions** — a scale-up row whose snapshot shows
+  sub-threshold pressure (or no reason at all) is a flapping
+  controller; each one fails the verdict.
+* **Over-provision ratio** (informational) — replica-seconds actually
+  held / replica-seconds a min-sized fleet would have held over the
+  same window: how much capacity elasticity cost beyond the floor.
+
+Exit status: 0 all checks pass, 1 any check failed, 2 unusable input
+(no scale evidence in the JSONL at all).
+
+Usage:
+    python tools/capacity_report.py /tmp/scale.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def load(path: str) -> dict:
+    """Counters/gauges (cumulative: last value wins, same as every
+    sibling report) plus ``kind=scale`` timeline rows in file order."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, object] = {}
+    decisions: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            t = r.get("type")
+            if t == "counter":
+                counters[r["name"]] = float(r.get("value", 0))
+            elif t == "gauge":
+                gauges[r["name"]] = r.get("value")
+            elif t == "timeline" and r.get("kind") == "scale":
+                decisions.append(r)
+    decisions.sort(key=lambda r: float(r.get("t_mono", 0.0)))
+    return {"counters": counters, "gauges": gauges, "decisions": decisions}
+
+
+def replica_seconds(decisions: List[dict],
+                    t_end: Optional[float] = None) -> Optional[dict]:
+    """Integrate the fleet size over the decision timeline.  Each row
+    records ``replicas`` (the size the snapshot SAW, i.e. before the
+    action) and ``delta`` applied; the level between two decisions is
+    the post-action size of the earlier one.  Returns None with fewer
+    than two timeline points (no window to integrate)."""
+    if not decisions:
+        return None
+    pts = []
+    for d in decisions:
+        t = float(d.get("t_mono", 0.0))
+        before = int(d.get("replicas", 1))
+        delta = int(d.get("delta", 0))
+        after = before + delta if d.get("action") == "up" else (
+            before - delta if d.get("action") == "down" else before
+        )
+        pts.append((t, after))
+    if t_end is None:
+        t_end = pts[-1][0]
+    t0 = pts[0][0]
+    if t_end <= t0:
+        return None
+    area = 0.0
+    for (t, level), (t_next, _l2) in zip(pts, pts[1:] + [(t_end, 0)]):
+        area += level * max(0.0, min(t_next, t_end) - t)
+    return {"replica_s": area, "window_s": t_end - t0}
+
+
+def analyze(path: str) -> dict:
+    """Verdict rows (``{check, ok, detail}``) for one capacity JSONL;
+    ``usable`` False means no scale evidence at all (exit 2)."""
+    data = load(path)
+    c, g, decisions = data["counters"], data["gauges"], data["decisions"]
+    have_gate = any(k.startswith("scale.gate.") for k in g)
+    if not have_gate and not decisions and "scale.decisions" not in c:
+        return {"usable": False, "rows": [], "data": data}
+    rows: List[dict] = []
+
+    def fget(name: str) -> Optional[float]:
+        v = g.get(name)
+        return None if v is None else float(v)
+
+    budget = fget("scale.gate.budget_s")
+    static_p99 = fget("scale.gate.static_p99_s")
+    elastic_p99 = fget("scale.gate.elastic_p99_s")
+    if budget is not None:
+        if static_p99 is not None:
+            rows.append({
+                "check": "static leg misses budget",
+                "ok": static_p99 > budget,
+                "detail": (
+                    f"static p99={static_p99 * 1e3:.1f}ms vs budget "
+                    f"{budget * 1e3:.1f}ms"
+                    + ("" if static_p99 > budget else
+                       " — the control leg held; the burst proves nothing")
+                ),
+            })
+        if elastic_p99 is not None:
+            rows.append({
+                "check": "elastic leg holds budget",
+                "ok": elastic_p99 <= budget,
+                "detail": (
+                    f"elastic p99={elastic_p99 * 1e3:.1f}ms vs budget "
+                    f"{budget * 1e3:.1f}ms"
+                ),
+            })
+
+    ups = int(c.get("scale.up", 0))
+    downs = int(c.get("scale.down", 0))
+    rows.append({
+        "check": "elasticity engaged", "ok": ups >= 1,
+        "detail": f"applied: scale.up={ups} scale.down={downs}",
+    })
+
+    peak = fget("scale.gate.replica_peak")
+    pmax = fget("scale.gate.max_replicas")
+    if peak is not None and pmax is not None:
+        rows.append({
+            "check": "peak <= max_replicas", "ok": peak <= pmax,
+            "detail": f"peak={int(peak)} max_replicas={int(pmax)}",
+        })
+    end = fget("scale.gate.replicas_end")
+    pmin = fget("scale.gate.min_replicas")
+    if end is not None and pmin is not None:
+        rows.append({
+            "check": "fleet returned to min", "ok": end == pmin,
+            "detail": (
+                f"replicas_end={int(end)} min_replicas={int(pmin)}"
+                + ("" if end == pmin else " — capacity never given back")
+            ),
+        })
+    nlc = fget("scale.gate.new_lane_compiles")
+    if nlc is not None:
+        rows.append({
+            "check": "scale-up lanes compile-free", "ok": nlc == 0,
+            "detail": (
+                f"steady_state_compiles={int(nlc)}"
+                + (f" (pre-traffic primes={int(primes)})"
+                   if (primes := fget("scale.gate.device_primes"))
+                   is not None else "")
+                + ("" if nlc == 0 else
+                   " — a request dispatch compiled against live "
+                   "traffic instead of riding a pre-traffic lane "
+                   "prime")
+            ),
+        })
+
+    # every applied scale-up must carry its driving evidence
+    up_thresh = fget("scale.gate.up_threshold")
+    undriven = []
+    for d in decisions:
+        if d.get("action") != "up":
+            continue
+        p = float(d.get("pressure", 0.0))
+        reason = str(d.get("reason") or "")
+        floor = up_thresh if up_thresh is not None else 0.0
+        if not reason or p <= floor:
+            undriven.append(d)
+    rows.append({
+        "check": "scale-ups driven by signal", "ok": not undriven,
+        "detail": (
+            f"{sum(1 for d in decisions if d.get('action') == 'up')} "
+            "up decision(s), every snapshot above threshold"
+            if not undriven else ", ".join(
+                f"t={float(d.get('t_mono', 0)):.2f}s pressure="
+                f"{float(d.get('pressure', 0)):.3f} "
+                f"reason={str(d.get('reason') or '')!r}"
+                for d in undriven
+            )
+        ),
+    })
+
+    over = None
+    rs = replica_seconds(decisions)
+    if rs is not None and pmin:
+        over = rs["replica_s"] / (pmin * rs["window_s"])
+    return {
+        "usable": True, "rows": rows, "data": data,
+        "decisions": decisions, "overprovision": over,
+        "gate": {k: v for k, v in g.items()
+                 if k.startswith("scale.gate.")},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="metrics JSONL from a --scale gate run")
+    args = ap.parse_args(argv)
+
+    res = analyze(args.jsonl)
+    if not res["usable"]:
+        print(f"{args.jsonl}: no scale.* evidence — not an elastic-"
+              "capacity run's JSONL (scaler never armed, or metrics off)",
+              file=sys.stderr)
+        return 2
+
+    print(f"capacity verdict: {args.jsonl}")
+    if res["gate"]:
+        print("  gate gauges: " + "  ".join(
+            f"{k.split('scale.gate.')[1]}={v}"
+            for k, v in sorted(res["gate"].items())
+        ))
+    if res["overprovision"] is not None:
+        print(f"  over-provision ratio: {res['overprovision']:.2f}x "
+              "(replica-seconds held / min-fleet replica-seconds)")
+    if res["decisions"]:
+        print("  decision timeline:")
+        for d in res["decisions"]:
+            print(
+                f"    t={float(d.get('t_mono', 0)):10.3f}s "
+                f"{d.get('action', '?'):4} delta={d.get('delta', 0)} "
+                f"replicas={d.get('replicas', '?')} "
+                f"pressure={float(d.get('pressure', 0)):.3f} "
+                f"qd={d.get('queue_depth', '?')} "
+                f"burn={d.get('burn_ewma', '?')} "
+                f"({d.get('reason', '')})"
+            )
+    print()
+    failed = 0
+    for row in res["rows"]:
+        mark = "ok  " if row["ok"] else "FAIL"
+        if not row["ok"]:
+            failed += 1
+        print(f"  [{mark}] {row['check']}: {row['detail']}")
+    print()
+    if failed:
+        print(f"{failed} check(s) failed")
+        return 1
+    print("all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,7 @@ import sys
 
 _REPLICA_RE = re.compile(
     r"^serve\.replica\.(?P<name>[^.]+)\.(?P<field>dispatched|queue_depth"
-    r"|breaker_open|breaker_closed)$"
+    r"|breaker_open|breaker_closed|removed)$"
 )
 
 
@@ -61,7 +61,7 @@ def replica_rows(counters, gauges):
                 continue
             row = rows.setdefault(m.group("name"), {
                 "dispatched": 0, "queue_depth": 0,
-                "breaker_open": 0, "breaker_closed": 0,
+                "breaker_open": 0, "breaker_closed": 0, "removed": 0,
             })
             row[m.group("field")] = int(value)
     return rows
@@ -87,23 +87,34 @@ def main(argv=None):
               "stream go through a SolverService?)")
         return 0
 
-    hdr = (f"{'replica':>8} {'dispatched':>11} {'queue_depth':>12} "
-           f"{'breaker_open':>13} {'breaker_closed':>15}")
+    hdr = (f"{'replica':>8} {'state':>8} {'dispatched':>11} "
+           f"{'queue_depth':>12} {'breaker_open':>13} "
+           f"{'breaker_closed':>15}")
     print(hdr)
     print("-" * len(hdr))
     for name in sorted(rows, key=_order):
         r = rows[name]
-        print(f"{name:>8} {r['dispatched']:11d} {r['queue_depth']:12d} "
-              f"{r['breaker_open']:13d} {r['breaker_closed']:15d}")
+        # an elastically removed lane stays a (terminal) row: its
+        # dispatch history is part of the run's story, it just stops
+        # counting toward live-fleet verdicts
+        state = "removed" if r["removed"] else "live"
+        print(f"{name:>8} {state:>8} {r['dispatched']:11d} "
+              f"{r['queue_depth']:12d} {r['breaker_open']:13d} "
+              f"{r['breaker_closed']:15d}")
 
     replicated = int(counters.get("serve.replicated_dispatch", 0))
     sharded = int(counters.get("serve.routed_sharded", 0))
     print(f"\nrouting: {replicated} replicated, {sharded} sharded "
           f"(serve.replicated_dispatch / serve.routed_sharded)")
 
-    # the scale-out verdict: a replica lane that dispatched nothing
-    # while the tier worked is starved
-    lanes = {n: r for n, r in rows.items() if n.isdigit()}
+    # the scale-out verdict: a LIVE replica lane that dispatched
+    # nothing while the tier worked is starved (a removed lane is a
+    # terminal state, not a starving one — a short-lived burst lane
+    # legitimately ends with few or zero dispatches)
+    lanes = {
+        n: r for n, r in rows.items()
+        if n.isdigit() and not r["removed"]
+    }
     total = sum(r["dispatched"] for r in lanes.values())
     rc = 0
     if len(lanes) > 1 and total >= args.min_requests:
