@@ -199,6 +199,41 @@ def bench_geqrf(jax, jnp, n, nb, trials, schedule="auto"):
     return _gflops(name, 4.0 * n**3 / 3.0, best), best
 
 
+def bench_trsm(jax, jnp, routine, n, nrhs, trials, schedule="auto"):
+    """The solve-phase trsm pair behind the serve ``phase="solve"``
+    buckets — the factor cache's top-traffic hit path.  ``posv`` times
+    potrs_from_global (lower + transposed-lower sweep against a clean
+    Cholesky factor), ``gesv`` times getrs_from_global (unit-lower +
+    upper sweep against a packed LU) — both triangles covered between
+    the two.  ``schedule="pallas"`` routes both sweeps through the
+    fused Pallas trsm kernels (interpret mode off-TPU)."""
+    from jax import lax
+
+    from slate_tpu.drivers.chol import potrs_from_global
+    from slate_tpu.drivers.lu import getrs_from_global
+
+    key = jax.random.PRNGKey(5)
+    kf, kr = jax.random.split(key)
+    G = jax.random.normal(kf, (n, n), jnp.float64) / np.sqrt(n)
+    B = jax.random.normal(kr, (n, nrhs), jnp.float64)
+    if routine == "posv":
+        S = G @ G.T + 2.0 * jnp.eye(n, dtype=jnp.float64)
+        F = jnp.linalg.cholesky(S)
+        solve = potrs_from_global
+    else:
+        F, _piv, _perm = lax.linalg.lu(G + jnp.eye(n, dtype=jnp.float64))
+        solve = getrs_from_global
+
+    @jax.jit
+    def step(F, B, t):
+        return solve(F, B + t * 1e-12, schedule).sum()
+
+    name = f"bench.trsm_{routine}_n{n}_{schedule}"
+    best = _bench(step, (F, B), trials, name=name)
+    # two O(n^2 nrhs) triangular sweeps per solve
+    return _gflops(name, 2.0 * n * n * nrhs, best), best
+
+
 def bench_solve_mixed(jax, jnp, routine, n, nb, trials):
     """Mixed-precision solve vs the plain f64 direct driver: wall
     seconds for both (eager best-of — the mixed drivers run the host
@@ -464,6 +499,27 @@ def main(argv=None):
     factor_entry("dgetrf_recursive", _getrf, nfac, nbfac, "recursive")
     factor_entry("dgeqrf", _geqrf, nfac, nbfac, "flat")
     factor_entry("dgeqrf_recursive", _geqrf, nfac, nbfac, "recursive")
+
+    # -- solve-phase trsm pair (the serve factor cache's top-traffic
+    # hit path — phase="solve" buckets).  Both triangles between the
+    # two routines, vendor vs fused-Pallas schedule variants -----------
+    ntr = (8192 if args.full else 4096) if on_tpu else 256
+    nrhs_tr = 512 if on_tpu else 64
+
+    def trsm_entry(label, routine, schedule):
+        def run():
+            rep, sec = bench_trsm(
+                jax, jnp, routine, ntr, nrhs_tr, trials, schedule
+            )
+            return {"n": ntr, "nrhs": nrhs_tr, "schedule": schedule,
+                    **rep, "seconds": round(sec, 4)}
+
+        return run_entry(label, run)
+
+    trsm_entry("dtrsm_posv", "posv", "auto")
+    trsm_entry("dtrsm_posv_pallas", "posv", "pallas")
+    trsm_entry("dtrsm_gesv", "gesv", "auto")
+    trsm_entry("dtrsm_gesv_pallas", "gesv", "pallas")
 
     # -- mixed-precision solves (refine/): f32-factor IR vs plain f64.
     # speedup_vs_plain is the headline the subsystem exists for: on the

@@ -1035,14 +1035,91 @@ svc.stop()
 """
 
 
+# Interpret-mode Pallas leg: CPU CI runs every panel kernel of the
+# ``pallas`` schedule family through pl.pallas_call(..., interpret=True)
+# against its jnp reference twin — the family is gated without real
+# chips (the compiled Mosaic path shares the SAME kernel bodies).
+_PALLAS_PANEL_DRIVER = """
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from slate_tpu.ops.pallas import panel_kernels as pk
+from slate_tpu.ops.qr_fast import _qr_panel_strips
+from slate_tpu.ops.householder import materialize_v
+
+rng = np.random.default_rng(0)
+checked = 0
+for dt in (np.float32, np.float64, np.complex64, np.complex128):
+    tol = 5e3 * np.finfo(np.dtype(dt)).eps
+
+    def rand(shape):
+        x = rng.standard_normal(shape)
+        if np.issubdtype(dt, np.complexfloating):
+            x = x + 1j * rng.standard_normal(shape)
+        return jnp.asarray(x, dt)
+
+    def close(a, b, exact=False):
+        global checked
+        checked += 1
+        err = float(jnp.max(jnp.abs(a - b)))
+        ref = max(float(jnp.max(jnp.abs(b))), 1.0)
+        lim = 0.0 if exact else tol * ref
+        assert err <= lim, (np.dtype(dt).name, checked, err, lim)
+
+    b = 64
+    A = rand((b, b)); G = A @ jnp.conj(A).T + b * jnp.eye(b, dtype=dt)
+    close(jnp.tril(pk.chol_base_pallas(G, interpret=True)),
+          jnp.tril(pk.chol_base_reference(G)))
+    for M, w, act in ((96, 32, None), (96, 32, 80), (160, 24, None)):
+        P = rand((M, w))
+        lu_p, p_p = pk.panel_lu_pallas(P, act=act, interpret=True)
+        lu_r, p_r = pk.panel_lu_reference(P, act=act)
+        close(lu_p, lu_r, exact=True)
+        assert bool(jnp.all(p_p == p_r)), "pivot order drifted"
+    Pn = rand((96, 32))
+    Vp, taus = _qr_panel_strips(Pn, 16)
+    V = materialize_v(Vp)
+    close(pk.larft_pallas(V, taus, interpret=True),
+          pk.larft_reference(V, taus), exact=True)
+    C = rand((48, 48)); Aa = rand((48, 24))
+    close(pk.syrk_diag_pallas(C, Aa, interpret=True),
+          pk.syrk_diag_reference(C, Aa), exact=True)
+    C2 = rand((48, 40)); Bb = rand((40, 24))
+    close(pk.gemm_sub_pallas(C2, Aa, Bb, interpret=True),
+          pk.gemm_sub_reference(C2, Aa, Bb), exact=True)
+    n, nrhs = 128, 16
+    B = rand((n, nrhs))
+    L = jnp.tril(rand((n, n)), -1) * 0.3 + jnp.diag(
+        jnp.asarray(2.0 + rng.random(n), dt))
+    close(pk.trsm_lower_pallas(L, B, interpret=True),
+          pk.trsm_lower_reference(L, B))
+    Lu = jnp.tril(rand((n, n)), -1) * 0.3 + jnp.eye(n, dtype=dt)
+    close(pk.trsm_lower_pallas(Lu, B, unit=True, interpret=True),
+          pk.trsm_lower_reference(Lu, B, unit=True))
+    U = jnp.triu(rand((n, n)), 1) * 0.3 + jnp.diag(
+        jnp.asarray(2.0 + rng.random(n), dt))
+    close(pk.trsm_upper_pallas(U, B, interpret=True),
+          pk.trsm_upper_reference(U, B))
+print(f"pallas interpret leg: {checked} kernel/dtype parity checks green")
+"""
+
+
 def perf_gate() -> int:
-    """Perf gate, four legs: (1) the devmon suite; (2) the regression
-    sentinel on the checked-in trajectory — the true BENCH_r03 ->
-    BENCH_r04 pair passes while a synthetically-regressed copy of r04
-    exits nonzero; (3) an env-activated devmon serve stream whose
-    JSONL tools/roofline_report.py must classify (nonzero on any
-    unclassifiable warmed bucket); (4) a quick warmed bench leg diffed
-    ``--floor`` against the checked-in BENCH_FLOOR_CPU.json."""
+    """Perf gate, five legs: (1) the devmon suite; (2) the interpret-
+    mode Pallas leg — every panel kernel of the ``pallas`` schedule
+    family runs via ``pl.pallas_call(..., interpret=True)`` against its
+    jnp twin on CPU (f32/f64/c64/c128, act-masked + non-pow2 panels,
+    exact pivot order); (3) the regression sentinel on the checked-in
+    trajectory — the true BENCH_r03 -> BENCH_r04 pair passes while a
+    synthetically-regressed copy of r04 exits nonzero; (4) an
+    env-activated devmon serve stream whose JSONL
+    tools/roofline_report.py must classify (nonzero on any
+    unclassifiable warmed bucket — the warmed solve buckets included);
+    (5) a quick warmed bench leg diffed ``--floor`` against the
+    checked-in BENCH_FLOOR_CPU.json (dtrsm solve-phase entries
+    included)."""
     import json
     import tempfile
 
@@ -1070,6 +1147,12 @@ def perf_gate() -> int:
         env=tenv, cwd=here,
     )
     if rc != 0:
+        return rc
+    rc = subprocess.call(
+        [sys.executable, "-c", _PALLAS_PANEL_DRIVER], env=tenv, cwd=here,
+    )
+    if rc != 0:
+        print("perf gate: pallas interpret leg failed")
         return rc
     bench_diff = os.path.join("tools", "bench_diff.py")
     with tempfile.TemporaryDirectory(prefix="slate_perf_") as td:
@@ -1972,11 +2055,16 @@ def soak_gate(full: bool = False) -> int:
         if rc != 0:
             return rc
         # escape leg: defenses off, same SDC — the report MUST flag
-        # the run (a verdict tool that cannot fail proves nothing)
+        # the run (a verdict tool that cannot fail proves nothing).
+        # "defenses off" means the DELIVERY defenses: the instrumented
+        # sync runtime stays armed so a lock-order regression on the
+        # escape path cannot hide behind the expected nonzero verdict
         esc = os.path.join(td, "escape.jsonl")
         rc = subprocess.call(
             [sys.executable, "-c", _SOAK_ESCAPE_DRIVER],
-            env=dict(env, SLATE_TPU_METRICS=esc), cwd=here,
+            env=dict(env, SLATE_TPU_METRICS=esc,
+                     SLATE_TPU_SYNC_CHECK="1"),
+            cwd=here,
         )
         if rc != 0:
             return rc
@@ -2152,9 +2240,14 @@ def scale_gate() -> int:
         jsonl = os.path.join(td, "scale.jsonl")
         art = os.path.join(td, "artifacts")
         trace = os.path.join(td, "burst.jsonl")
+        # the burst drill runs under the instrumented sync runtime too:
+        # the add/remove replica lifecycle is the lock-heaviest path in
+        # the tree (same arming as the soak drill)
         rc = subprocess.call(
             [sys.executable, "-c", _SCALE_DRIVER, art, trace],
-            env=dict(env, SLATE_TPU_METRICS=jsonl), cwd=here,
+            env=dict(env, SLATE_TPU_METRICS=jsonl,
+                     SLATE_TPU_SYNC_CHECK="1"),
+            cwd=here,
         )
         if rc != 0:
             return rc

@@ -131,13 +131,39 @@ def potrs(
     return X
 
 
-def potrs_from_global(Lg: jnp.ndarray, Bg: jnp.ndarray) -> jnp.ndarray:
+def _solve_trsm_route(n: int, schedule: str) -> str:
+    """Schedule routing for the solve-phase trsm pair: explicit
+    ``pallas`` is honored everywhere (interpret mode off-TPU); ``auto``
+    prefers the Pallas pair on accelerators above the same crossover as
+    the factor schedules, the vendor solve otherwise."""
+    if schedule == "pallas":
+        return "pallas"
+    if (
+        schedule == "auto"
+        and jax.default_backend() != "cpu"
+        and n >= chol_kernels.RECURSIVE_MIN_N
+    ):
+        return "pallas"
+    return "vendor"
+
+
+def potrs_from_global(
+    Lg: jnp.ndarray, Bg: jnp.ndarray, schedule: str = "auto"
+) -> jnp.ndarray:
     """potrs-style solve-only entry point over global arrays: solve
     L L^H X = B by two trsm sweeps against a clean lower-triangular
     factor.  The O(n^2) steady-state kernel of the serve factor
     cache's trsm-only (``phase="solve"``) bucket family; fully
-    traceable (jit/vmap)."""
+    traceable (jit/vmap).  ``schedule="pallas"`` (or ``auto`` on an
+    accelerator above the crossover) runs both sweeps through the
+    fused Pallas trsm pair (ops/pallas/panel_kernels.py)."""
     cplx = jnp.iscomplexobj(Lg)
+    if _solve_trsm_route(Lg.shape[0], schedule) == "pallas":
+        from ..ops.pallas import panel_kernels as pk
+
+        Y = pk.trsm_lower(Lg, Bg)
+        U = jnp.conj(Lg).T if cplx else Lg.T
+        return pk.trsm_upper(U, Y)
     Y = lax.linalg.triangular_solve(Lg, Bg, left_side=True, lower=True)
     return lax.linalg.triangular_solve(
         Lg, Y, left_side=True, lower=True, transpose_a=True,

@@ -290,7 +290,9 @@ def getrs_nopiv(LU: Matrix, B: Matrix, opts=None) -> Matrix:
     return getrs(LU, None, B, opts)
 
 
-def getrs_from_global(LUg: jnp.ndarray, Bg: jnp.ndarray) -> jnp.ndarray:
+def getrs_from_global(
+    LUg: jnp.ndarray, Bg: jnp.ndarray, schedule: str = "auto"
+) -> jnp.ndarray:
     """getrs-style solve-only entry point over global arrays: two trsm
     sweeps against a packed LU (unit-lower L below the diagonal, U on
     and above), B already row-permuted (P B).  This is the O(n^2)
@@ -298,7 +300,18 @@ def getrs_from_global(LUg: jnp.ndarray, Bg: jnp.ndarray) -> jnp.ndarray:
     (``phase="solve"``) bucket family — the factorization's row
     permutation is a host-side gather, so the traced program is pure
     triangular algebra and exports custom-call-free under the
-    recursive schedule's jax lowering.  Fully traceable (jit/vmap)."""
+    recursive schedule's jax lowering.  Fully traceable (jit/vmap).
+    ``schedule="pallas"`` (or ``auto`` on an accelerator above the
+    crossover) runs both sweeps through the fused Pallas trsm pair —
+    the kernels read only their own triangle, so the packed storage
+    needs no unpacking."""
+    from .chol import _solve_trsm_route
+
+    if _solve_trsm_route(LUg.shape[0], schedule) == "pallas":
+        from ..ops.pallas import panel_kernels as pk
+
+        Y = pk.trsm_lower(LUg, Bg, unit=True)
+        return pk.trsm_upper(LUg, Y)
     Y = lax.linalg.triangular_solve(
         LUg, Bg, left_side=True, lower=True, unit_diagonal=True
     )

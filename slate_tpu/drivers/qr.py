@@ -62,6 +62,11 @@ _geqrf_flat_kernel = metrics.gated_jit(
     _qr_fast.geqrf_flat, "geqrf.kernel_flat", donate_argnums=(0,)
 )
 
+_geqrf_pallas_kernel = metrics.gated_jit(
+    _qr_fast.geqrf_pallas, "geqrf.kernel_pallas",
+    static_argnums=(1,), donate_argnums=(0,),
+)
+
 
 def _padded_global_splice(A: BaseMatrix) -> jnp.ndarray:
     lay = A.layout
@@ -109,7 +114,9 @@ def geqrf(
                 m_true=lay.m, n_true=lay.n,
             ),
         )
-    if route == "recursive":
+    if route == "pallas":
+        vr, taus = _geqrf_pallas_kernel(Gp, nb_switch)
+    elif route == "recursive":
         vr, taus = _geqrf_recursive_kernel(Gp, nb_switch)
     elif route == "flat" and sched == "flat":
         # explicit flat runs the native schedule on every backend (the

@@ -270,19 +270,31 @@ class Schedule(_StrParseMixin, enum.Enum):
       (``chol_recursive`` / ``getrf_recursive`` / ``geqrf_recursive``):
       exact statically-shrinking shapes, O(log n) distinct compile
       units, near-model FLOPs.
+    * ``Pallas``    — the recursive lattice with the panel/base-case
+      layer swapped for fused Pallas kernels
+      (``ops/pallas/panel_kernels.py``): in-register panel LU pivot
+      search, fused unblocked Cholesky, compact-WY T assembly,
+      triangle-aware syrk diagonal blocks.  Compiled Mosaic on TPU for
+      eligible operands; the identical kernel bodies run in interpret
+      mode (plain XLA lowering) everywhere else, so the family is
+      portable and artifacts stay custom-call-free.
     * ``Auto``      — backend dispatch: vendor kernel on CPU (LAPACK is
-      already optimal), recursive above the crossover on accelerators,
+      already optimal), pallas above the crossover on accelerators,
       flat/blocked below it.
     """
 
     Auto = "auto"
     Flat = "flat"
     Recursive = "recursive"
+    Pallas = "pallas"
 
     def aliases(self):
-        return {"auto": ("*",), "flat": (), "recursive": ("rec", "dc")}[
-            self.value
-        ]
+        return {
+            "auto": ("*",),
+            "flat": (),
+            "recursive": ("rec", "dc"),
+            "pallas": ("panel",),
+        }[self.value]
 
 
 # ---------------------------------------------------------------------------

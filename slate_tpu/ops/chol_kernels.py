@@ -327,14 +327,40 @@ def _trsm_right_lh(L: jnp.ndarray, A: jnp.ndarray, nb: int) -> jnp.ndarray:
     return jnp.concatenate([X1, X2], axis=1)
 
 
-def _syrk_lower(C: jnp.ndarray, A: jnp.ndarray, nb: int) -> jnp.ndarray:
+def _base_chol(G: jnp.ndarray, family: str) -> jnp.ndarray:
+    """Base-case dispatch: the ib-strip chol_unblocked (recursive
+    family) or the fused Pallas kernel (pallas family)."""
+    if family == "pallas":
+        from .pallas import panel_kernels as pk
+
+        return pk.chol_base(G)
+    return chol_unblocked(G)
+
+
+def _syrk_lower(
+    C: jnp.ndarray, A: jnp.ndarray, nb: int, family: str = "recursive"
+) -> jnp.ndarray:
     """Lower triangle of C - A A^H by triangle recursion: only the
     diagonal nb-blocks pay the full-square gemm, the off-diagonal
     blocks are plain exact-shape gemms — executed FLOPs t^2 h + O(nb t h)
     against the t^2 h syrk model, killing the 2x a full-square gemm
     would cost.  Entries above the diagonal pass through untouched
-    (callers only consume the lower triangle)."""
+    (callers only consume the lower triangle).  The pallas family fuses
+    the diagonal-block triangle mask and the off-diagonal
+    multiply-subtract into single kernels at identical shapes/FLOPs."""
     t = C.shape[0]
+    if family == "pallas":
+        from .pallas import panel_kernels as pk
+
+        if t <= nb:
+            return pk.syrk_diag(C, A)
+        s = split_point(t)
+        C11 = _syrk_lower(C[:s, :s], A[:s], nb, family)
+        C21 = pk.gemm_sub(C[s:, :s], A[s:], A[:s])
+        C22 = _syrk_lower(C[s:, s:], A[s:], nb, family)
+        top = jnp.concatenate([C11, C[:s, s:]], axis=1)
+        bot = jnp.concatenate([C21, C22], axis=1)
+        return jnp.concatenate([top, bot], axis=0)
     if t <= nb:
         return C - _dot(A, _conj(A).T)
     s = split_point(t)
@@ -346,21 +372,22 @@ def _syrk_lower(C: jnp.ndarray, A: jnp.ndarray, nb: int) -> jnp.ndarray:
     return jnp.concatenate([top, bot], axis=0)
 
 
-def _chol_rec(G: jnp.ndarray, nb: int) -> jnp.ndarray:
+def _chol_rec(G: jnp.ndarray, nb: int, family: str = "recursive") -> jnp.ndarray:
     n = G.shape[0]
     if n <= nb:
-        return chol_unblocked(G)
+        return _base_chol(G, family)
     s = split_point(n)
-    L11 = _chol_rec(G[:s, :s], nb)
+    L11 = _chol_rec(G[:s, :s], nb, family)
     L21 = _trsm_right_lh(L11, G[s:, :s], nb)
-    L22 = _chol_rec(_syrk_lower(G[s:, s:], L21, nb), nb)
+    L22 = _chol_rec(_syrk_lower(G[s:, s:], L21, nb, family), nb, family)
     top = jnp.concatenate([L11, jnp.zeros((s, n - s), G.dtype)], axis=1)
     bot = jnp.concatenate([L21, L22], axis=1)
     return jnp.concatenate([top, bot], axis=0)
 
 
 def chol_recursive(
-    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1
+    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1,
+    family: str = "recursive",
 ) -> jnp.ndarray:
     """Divide & conquer Cholesky factor L (lower) of an SPD (n, n) array.
 
@@ -377,25 +404,29 @@ def chol_recursive(
     the baseline pipeline): k > 1 peels k-1 eager ``nb_switch``-wide
     panels ahead of the halving split at the top level, each with
     exact-shape trsm + syrk updates (Option.Lookahead wiring).
+
+    ``family`` selects the base-case/update kernels on the same
+    lattice: ``"recursive"`` (the jnp strip kernels) or ``"pallas"``
+    (the fused panel kernels in ops/pallas/panel_kernels.py).
     """
     n = G.shape[0]
     if n <= nb_switch:
-        return jnp.tril(chol_unblocked(G))
+        return jnp.tril(_base_chol(G, family))
     cols = []
     T = G
     k0 = 0
     peel = max(int(lookahead) - 1, 0)
     while peel > 0 and (n - k0) > 2 * nb_switch:
         w = nb_switch
-        D = chol_unblocked(T[:w, :w])
+        D = _base_chol(T[:w, :w], family)
         L21 = _trsm_right_lh(D, T[w:, :w], nb_switch)
-        T = _syrk_lower(T[w:, w:], L21, nb_switch)
+        T = _syrk_lower(T[w:, w:], L21, nb_switch, family)
         cols.append(
             jnp.concatenate([jnp.zeros((k0, w), G.dtype), D, L21], axis=0)
         )
         k0 += w
         peel -= 1
-    Lr = _chol_rec(T, nb_switch)
+    Lr = _chol_rec(T, nb_switch, family)
     if not cols:
         return jnp.tril(Lr)
     Lr = jnp.concatenate(
@@ -500,6 +531,15 @@ def _chol_unblocked_flops(b: int, ib: int = 16):
     }
 
 
+def _chol_base_flops(b: int, family: str = "recursive"):
+    if family == "pallas":
+        # fused column loop: b masked rank-1 trailing updates on the
+        # (b, b) block — no per-strip overhead, strictly below the
+        # ib-strip count
+        return 2.0 * float(b) ** 3, {("pallas_chol_base", b)}
+    return _chol_unblocked_flops(b)
+
+
 def _trsm_flops(t: int, h: int, nb: int):
     """Executed FLOPs of _trsm_right_lh / the unit-lower left variant in
     lu_kernels (identical split structure): exactly the t h^2 model."""
@@ -511,25 +551,27 @@ def _trsm_flops(t: int, h: int, nb: int):
     return f1 + f2 + 2.0 * t * s * (h - s), u1 | u2 | {("gemm", t, s, h - s)}
 
 
-def _syrk_flops(t: int, h: int, nb: int):
+def _syrk_flops(t: int, h: int, nb: int, family: str = "recursive"):
+    diag = "pallas_syrk" if family == "pallas" else "gemm"
+    offd = "pallas_gemm" if family == "pallas" else "gemm"
     if t <= nb:
-        return 2.0 * t * t * h, {("gemm", t, h, t)}
+        return 2.0 * t * t * h, {(diag, t, h, t)}
     s = split_point(t)
-    f1, u1 = _syrk_flops(s, h, nb)
-    f2, u2 = _syrk_flops(t - s, h, nb)
+    f1, u1 = _syrk_flops(s, h, nb, family)
+    f2, u2 = _syrk_flops(t - s, h, nb, family)
     return f1 + f2 + 2.0 * (t - s) * h * s, u1 | u2 | {
-        ("gemm", t - s, h, s)
+        (offd, t - s, h, s)
     }
 
 
-def _chol_rec_flops(n: int, nb: int):
+def _chol_rec_flops(n: int, nb: int, family: str = "recursive"):
     if n <= nb:
-        return _chol_unblocked_flops(n)
+        return _chol_base_flops(n, family)
     s = split_point(n)
-    f1, u1 = _chol_rec_flops(s, nb)
+    f1, u1 = _chol_rec_flops(s, nb, family)
     ft, ut = _trsm_flops(n - s, s, nb)
-    fs, us = _syrk_flops(n - s, s, nb)
-    f2, u2 = _chol_rec_flops(n - s, nb)
+    fs, us = _syrk_flops(n - s, s, nb, family)
+    f2, u2 = _chol_rec_flops(n - s, nb, family)
     return f1 + ft + fs + f2, u1 | ut | us | u2
 
 
@@ -610,21 +652,22 @@ def chol_schedule_flops(
     elif schedule == "flat_fori":
         ex, units = _chol_fori_flops(npad, nb if npad % nb == 0 else 128)
     else:
+        fam = "pallas" if schedule == "pallas" else "recursive"
         ex, units = 0.0, set()
         k0, peel = 0, max(int(lookahead) - 1, 0)
         if npad <= nb_switch:
-            ex, units = _chol_unblocked_flops(npad)
+            ex, units = _chol_base_flops(npad, fam)
         else:
             while peel > 0 and (npad - k0) > 2 * nb_switch:
                 w = nb_switch
-                fb, ub = _chol_unblocked_flops(w)
+                fb, ub = _chol_base_flops(w, fam)
                 ft, ut = _trsm_flops(npad - k0 - w, w, nb_switch)
-                fs, us = _syrk_flops(npad - k0 - w, w, nb_switch)
+                fs, us = _syrk_flops(npad - k0 - w, w, nb_switch, fam)
                 ex += fb + ft + fs
                 units |= ub | ut | us
                 k0 += w
                 peel -= 1
-            fr, ur = _chol_rec_flops(npad - k0, nb_switch)
+            fr, ur = _chol_rec_flops(npad - k0, nb_switch, fam)
             ex += fr
             units |= ur
     return {"model": model, "exec": ex, "units": units}
@@ -632,15 +675,16 @@ def chol_schedule_flops(
 
 def resolve_schedule(n: int, schedule: str = "auto") -> str:
     """Resolve an ``auto`` schedule request against the backend and
-    size: vendor LAPACK on CPU, recursive above the crossover on
-    accelerators, the flat/blocked schedule below it.  Explicit
-    ``flat``/``recursive`` are honored on every backend (tests exercise
-    the native schedules on CPU)."""
-    if schedule in ("flat", "recursive"):
+    size: vendor LAPACK on CPU, the pallas panel-kernel family above
+    the crossover on accelerators, the flat/blocked schedule below it.
+    Explicit ``flat``/``recursive``/``pallas`` are honored on every
+    backend (tests exercise the native schedules on CPU — pallas runs
+    its kernels in interpret mode there)."""
+    if schedule in ("flat", "recursive", "pallas"):
         return schedule
     if jax.default_backend() == "cpu":
         return "vendor"
-    return "recursive" if n >= RECURSIVE_MIN_N else "flat"
+    return "pallas" if n >= RECURSIVE_MIN_N else "flat"
 
 
 def cholesky(
@@ -669,9 +713,9 @@ def cholesky(
         idx = jnp.arange(npad)
         splice = jnp.where(idx >= n, 1.0, 0.0).astype(G.dtype)
         Gp = Gp.at[idx, idx].add(splice)
-        if route == "recursive":
-            return chol_recursive(Gp, nb_switch, lookahead)[:n, :n]
+        if route in ("recursive", "pallas"):
+            return chol_recursive(Gp, nb_switch, lookahead, route)[:n, :n]
         return blocked_potrf(Gp, nb)[:n, :n]
-    if route == "recursive":
-        return chol_recursive(G, nb_switch, lookahead)
+    if route in ("recursive", "pallas"):
+        return chol_recursive(G, nb_switch, lookahead, route)
     return blocked_potrf(G, nb)

@@ -282,7 +282,8 @@ def _trsm_left_unit(L: jnp.ndarray, B: jnp.ndarray, nb: int) -> jnp.ndarray:
 
 
 def getrf_recursive(
-    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1
+    G: jnp.ndarray, nb_switch: int = 256, lookahead: int = 1,
+    family: str = "recursive",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Recursive blocked LU with partial pivoting of an (m, n) array,
     m >= n.  Returns (LU, perm): LU = (L\\U) of G[perm], the
@@ -298,9 +299,19 @@ def getrf_recursive(
     ``lookahead`` follows the reference getrf convention (1 = baseline
     pipeline): k > 1 peels k-1 eager nb_switch-wide panels ahead of the
     halving split at the top level (Option.Lookahead wiring).
+
+    ``family`` selects the panel base case: ``"recursive"`` (the jnp
+    fori_loop ``panel_lu``) or ``"pallas"`` (the fused in-register
+    pivot-search kernel — identical arithmetic, identical pivot order).
     """
     m, n = G.shape
     assert m >= n, f"getrf_recursive requires m >= n, got {(m, n)}"
+    if family == "pallas":
+        from .pallas import panel_kernels as pk
+
+        _panel = pk.panel_lu
+    else:
+        _panel = panel_lu
 
     def canon(X, act):
         """Snap X's height to the canonical ``_lat_height(act)``:
@@ -333,7 +344,7 @@ def getrf_recursive(
         # invariant: rows >= act of G are exact zeros (never pivotable)
         M, n = G.shape
         if n <= nb_switch:
-            return panel_lu(G, act=None if act >= M else act)
+            return _panel(G, act=None if act >= M else act)
         s = split_point(n)
         LU1, p1 = rec(G[:, :s], act)
         R = G[:, s:][p1]
@@ -350,13 +361,13 @@ def getrf_recursive(
         return jnp.concatenate([top, bot], axis=0), perm
 
     if n <= nb_switch:
-        return panel_lu(G)
+        return _panel(G)
     peel = max(int(lookahead) - 1, 0)
     frames = []  # (top_row_block, L_below, step perm), outermost first
     T, act = G, m
     while peel > 0 and (T.shape[1]) > 2 * nb_switch:
         w = nb_switch
-        LU1, p1 = panel_lu(T[:, :w], act=None if act >= T.shape[0] else act)
+        LU1, p1 = _panel(T[:, :w], act=None if act >= T.shape[0] else act)
         R = T[:, w:][p1]
         U12 = _trsm_left_unit(LU1[:w, :w], R[:w], nb_switch)
         S = R[w:] - LU1[w:, :w] @ U12
@@ -398,11 +409,14 @@ def getrf_schedule_flops(
 
     mt, nt_ = (m_true or m), (n_true or n)
     model = float(nt_) * nt_ * (mt - nt_ / 3.0)
+    # pallas panel kernel replicates panel_lu's arithmetic exactly, so
+    # the executed count is identical — only the compile unit differs
+    panel_unit = "pallas_lu_panel" if schedule == "pallas" else "lu_panel"
 
     def panel_flops(M, b):
         # panel_lu: per eliminated column one full-height rank-1 on the
         # whole (M, b) panel
-        return 2.0 * M * b * min(M, b), {("lu_panel", M, b)}
+        return 2.0 * M * b * min(M, b), {(panel_unit, M, b)}
 
     if schedule == "vendor":
         # the vendor kernel still runs on the PADDED array
@@ -504,14 +518,14 @@ def resolve_lu_schedule(m: int, n: int, dtype, schedule: str = "auto") -> str:
     (``flat_fast``), the single-level ``blocked_getrf`` otherwise."""
     import jax
 
-    if schedule == "recursive" and m >= n:
-        return "recursive"
-    if schedule in ("flat", "recursive"):
+    if schedule in ("recursive", "pallas") and m >= n:
+        return schedule
+    if schedule in ("flat", "recursive", "pallas"):
         if m == n and n >= 2048 and _lu_fast_nb(n):
             return "flat_fast"
         return "flat"
     if jax.default_backend() != "cpu" and m == n and n >= RECURSIVE_MIN_N:
-        return "recursive"
+        return "pallas"
     if lu_supported(dtype):
         return "vendor"
     return "flat"
@@ -539,8 +553,8 @@ def lu_global(
     one.
     """
     route = resolve_lu_schedule(*Gp.shape, Gp.dtype, schedule)
-    if route == "recursive":
-        return getrf_recursive(Gp, nb_switch, lookahead)
+    if route in ("recursive", "pallas"):
+        return getrf_recursive(Gp, nb_switch, lookahead, route)
     if route == "vendor":
         lu2d, _, perm = lax.linalg.lu(Gp)
         return lu2d, perm.astype(jnp.int32)

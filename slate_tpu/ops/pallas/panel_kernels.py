@@ -1,0 +1,539 @@
+"""Pallas panel kernels for the factorization schedules (the third
+``Option.Schedule`` family, ``pallas``).
+
+The recursive schedules in ops/chol_kernels.py, ops/lu_kernels.py and
+ops/qr_fast.py bottom out in panel/small-tile base cases below
+``nb_switch`` — exactly the layer the reference delegates to hand-tuned
+device tile kernels and that Elmroth & Gustavson identify as the bound
+on recursive factorizations.  This module re-implements those base
+cases as fused Pallas kernels, following the norm/RBT/transpose pattern
+of ops/pallas/kernels.py: every kernel is a single GRID-FREE
+``pl.pallas_call`` (gridded pallas_call aborts this toolchain's
+compiler — see kernels.py), has a jnp reference twin, and takes an
+``interpret`` flag so the CPU CI runs the identical kernel bodies via
+``pl.pallas_call(..., interpret=True)``.
+
+Kernel families:
+
+* ``chol_base``   — fused unblocked Cholesky of one diagonal block:
+  in-register column loop (sqrt, scale, masked rank-1 trailing update)
+  in one VMEM pass, replacing the ib-strip ``chol_unblocked``.
+* ``panel_lu``    — fused unblocked partial-pivot LU of one (M, nb)
+  panel with the in-register pivot search and act-masked eligibility of
+  ``ops/lu_kernels.panel_lu`` (identical arithmetic, so the pivot
+  order matches ``lax.linalg.lu`` exactly).
+* ``larft``       — compact-WY T assembly for the QR panel base case:
+  the Gram matrix V^H V, strict-upper extraction, and the
+  diag(1/tau)-with-big-limit splice fused into one kernel building
+  T^{-1}; the small (<= nb) triangular inverse stays on the vendor
+  solve, the same convention as the recursive trsm's <= nb diagonal
+  blocks.
+* ``syrk_diag`` / ``gemm_sub`` — triangle-aware syrk pieces for the
+  Cholesky trailing update: only diagonal nb-blocks pay the
+  full-square gemm in-kernel (masked to the lower triangle in the same
+  VMEM pass); off-diagonal blocks are fused multiply-subtract gemms.
+* ``trsm_lower`` / ``trsm_upper`` — the solve-phase trsm pair behind
+  the serve ``phase="solve"`` buckets (the factor cache's top-traffic
+  hit family): blocked forward/backward substitution in one kernel,
+  diagonal KB-blocks inverted by an exact Newton iteration (the
+  residual is strictly-triangular nilpotent, so ceil(log2(KB)) steps
+  reproduce the substitution result exactly in exact arithmetic).
+
+Compiled (non-interpret) dispatch is gated like the norm kernels:
+TPU platform + pltpu import + f32 + (8, 128)-aligned operands.  On any
+other backend/dtype the SAME kernel body runs in interpret mode, which
+lowers to plain XLA ops — this is how ``schedule="pallas"`` reaches
+driver parity on CPU and how artifacts stay custom-call-free.
+
+Toolchain caveats: besides the gridded-pallas_call abort, this jax
+build's interpret mode cannot initialize COMPLEX pallas outputs
+(``primitives.uninitialized_value`` only handles float/int), so the
+``_run_kernel`` adapter below writes complex results as exact
+real/imag pairs inside the kernel and recombines them outside —
+lossless, and the compiled Mosaic path (f32-only) never sees it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .kernels import _HAS_PLTPU, on_tpu
+
+_HIGHEST = lax.Precision.HIGHEST
+
+
+def _conj(x):
+    return jnp.conj(x) if jnp.iscomplexobj(x) else x
+
+
+def _mxu_dot(a, b):
+    """In-kernel matmul at HIGHEST precision, accumulating at the
+    operand dtype (the ops-layer ``hdot`` convention)."""
+    return jnp.dot(a, b, precision=_HIGHEST)
+
+
+def pallas_panel_ok(*arrays) -> bool:
+    """Whether the compiled (non-interpret) Mosaic path supports these
+    operands: f32 only (no f64/complex vector support), every 2-D dim
+    (8, 128)-aligned — the same constraint set as pallas_norm_ok."""
+    for a in arrays:
+        if a.dtype != jnp.float32:
+            return False
+        if a.ndim != 2:
+            return False
+        if a.shape[0] % 8 != 0 or a.shape[1] % 128 != 0:
+            return False
+    return True
+
+
+def _resolve_interpret(interpret: Optional[bool], *arrays) -> bool:
+    """None = auto: compiled Mosaic only on TPU with eligible operands,
+    interpret mode (plain XLA lowering) everywhere else."""
+    if interpret is not None:
+        return bool(interpret)
+    return not (on_tpu() and _HAS_PLTPU and pallas_panel_ok(*arrays))
+
+
+def _real_dtype(dt):
+    return jnp.float32 if dt == jnp.dtype(jnp.complex64) else jnp.float64
+
+
+def _run_kernel(body, out_shapes, args, interpret: bool):
+    """Grid-free pallas_call adapter: ``body`` maps input VALUES to
+    output VALUES; refs stay an implementation detail here.  Complex
+    outputs are written as exact real/imag pairs (see the module
+    docstring's toolchain caveat) and recombined outside the kernel."""
+    single = not isinstance(out_shapes, (tuple, list))
+    outs = [out_shapes] if single else list(out_shapes)
+    expanded = []  # ShapeDtypeStructs handed to pallas_call
+    plan = []  # per logical output: ("plain"|"cplx", first_slot, dtype)
+    for o in outs:
+        if jnp.issubdtype(o.dtype, jnp.complexfloating):
+            rt = _real_dtype(o.dtype)
+            plan.append(("cplx", len(expanded), o.dtype))
+            expanded.append(jax.ShapeDtypeStruct(o.shape, rt))
+            expanded.append(jax.ShapeDtypeStruct(o.shape, rt))
+        else:
+            plan.append(("plain", len(expanded), o.dtype))
+            expanded.append(o)
+
+    def kern(*refs):
+        in_refs = refs[: len(args)]
+        out_refs = refs[len(args):]
+        vals = body(*[r[...] for r in in_refs])
+        if not isinstance(vals, tuple):
+            vals = (vals,)
+        for (kind, i, _dt), v in zip(plan, vals):
+            if kind == "cplx":
+                out_refs[i][...] = jnp.real(v)
+                out_refs[i + 1][...] = jnp.imag(v)
+            else:
+                out_refs[i][...] = v
+
+    raw = pl.pallas_call(
+        kern, out_shape=tuple(expanded), interpret=interpret
+    )(*args)
+    if not isinstance(raw, (tuple, list)):
+        raw = (raw,)
+    results = []
+    for kind, i, dt in plan:
+        if kind == "cplx":
+            results.append(lax.complex(raw[i], raw[i + 1]).astype(dt))
+        else:
+            results.append(raw[i])
+    return results[0] if single else tuple(results)
+
+
+# ---------------------------------------------------------------------------
+# Fused unblocked Cholesky base case (chol_unblocked analogue)
+# ---------------------------------------------------------------------------
+
+
+def _chol_base_body(a):
+    b = a.shape[0]
+    rows = lax.broadcasted_iota(jnp.int32, (b, b), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (b, b), 1)
+
+    def body(j, a):
+        pv = jnp.sqrt(lax.dynamic_slice(a, (j, j), (1, 1))[0, 0])
+        col = lax.dynamic_slice(a, (0, j), (b, 1))
+        rvec = lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+        l = jnp.where(rvec > j, col / pv, jnp.zeros_like(col))
+        # masked rank-1 trailing update in the same pass
+        upd = _mxu_dot(l, _conj(l).T)
+        a = jnp.where((rows > j) & (cols > j), a - upd, a)
+        # write the factored column: pivot on the diagonal, l below;
+        # entries above the diagonal pass through (callers tril)
+        newcol = jnp.where(rvec == j, pv.astype(a.dtype), l)
+        return jnp.where((cols == j) & (rows >= j), newcol, a)
+
+    return lax.fori_loop(0, b, body, a)
+
+
+def chol_base_reference(G: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin: the ib-strip unblocked Cholesky the recursive schedule
+    uses (entries above the diagonal pass through untouched)."""
+    from ..chol_kernels import chol_unblocked
+
+    return chol_unblocked(G)
+
+
+def chol_base_pallas(G: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Fused unblocked Cholesky of a (b, b) block, one VMEM pass."""
+    return _run_kernel(
+        _chol_base_body,
+        jax.ShapeDtypeStruct(G.shape, G.dtype),
+        (G,),
+        interpret,
+    )
+
+
+def chol_base(G: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
+    return chol_base_pallas(G, interpret=_resolve_interpret(interpret, G))
+
+
+# ---------------------------------------------------------------------------
+# Fused panel LU with in-register partial-pivot search (panel_lu analogue)
+# ---------------------------------------------------------------------------
+
+
+def _panel_lu_body(a, *, pivot: bool, act):
+    """Arithmetic replicates ops/lu_kernels.panel_lu exactly (same op
+    sequence -> identical pivot order, identical floats)."""
+    M, nb = a.shape
+    rows = jnp.arange(M)
+
+    def body(j, carry):
+        a, perm = carry
+        col = a[:, j]
+        if pivot:
+            elig = rows >= j if act is None else (rows >= j) & (rows < act)
+            mag = jnp.where(elig, jnp.abs(col), -jnp.inf)
+            piv = jnp.argmax(mag)
+        else:
+            piv = j
+        rj = a[j]
+        rp = a[piv]
+        a = a.at[j].set(rp).at[piv].set(rj)
+        pj = perm[j]
+        pp = perm[piv]
+        perm = perm.at[j].set(pp).at[piv].set(pj)
+        pv = a[j, j]
+        safe = jnp.where(pv == 0, jnp.ones_like(pv), pv)
+        l = jnp.where(
+            (rows > j) & (pv != 0), a[:, j] / safe, jnp.zeros(M, a.dtype)
+        )
+        a = a.at[:, j].set(jnp.where(rows > j, l, a[:, j]))
+        urow = jnp.where(jnp.arange(nb) > j, a[j], jnp.zeros(nb, a.dtype))
+        return a - jnp.outer(l, urow), perm
+
+    perm0 = jnp.arange(M, dtype=jnp.int32)
+    return lax.fori_loop(0, min(M, nb), body, (a, perm0))
+
+
+def panel_lu_reference(
+    panel: jnp.ndarray, pivot: bool = True, act: Optional[int] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """jnp twin: the fori_loop panel factor the recursive schedule uses."""
+    from ..lu_kernels import panel_lu as _panel_lu
+
+    return _panel_lu(panel, pivot=pivot, act=act)
+
+
+def panel_lu_pallas(
+    panel: jnp.ndarray,
+    pivot: bool = True,
+    act: Optional[int] = None,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused partial-pivot panel LU: per-column pivot search, row swap,
+    scale and rank-1 update all inside one kernel invocation.  ``act``
+    is static (the recursive schedule's canonical-height pad rows must
+    never pivot)."""
+    M, nb = panel.shape
+    return _run_kernel(
+        functools.partial(_panel_lu_body, pivot=pivot, act=act),
+        (
+            jax.ShapeDtypeStruct((M, nb), panel.dtype),
+            jax.ShapeDtypeStruct((M,), jnp.int32),
+        ),
+        (panel,),
+        interpret,
+    )
+
+
+def panel_lu(
+    panel: jnp.ndarray,
+    pivot: bool = True,
+    act: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return panel_lu_pallas(
+        panel, pivot=pivot, act=act,
+        interpret=_resolve_interpret(interpret, panel),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compact-WY T assembly (householder.larft analogue)
+# ---------------------------------------------------------------------------
+
+
+def _larft_tinv_body(V, taus):
+    """T^{-1} = strict_upper(V^H V) + diag(1/tau) fused in one pass
+    (the tau == 0 large-diagonal limit included)."""
+    nb = V.shape[1]
+    complex_t = jnp.issubdtype(V.dtype, jnp.complexfloating)
+    VhV = _mxu_dot(jnp.conj(V).T if complex_t else V.T, V)
+    rows = lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+    U = jnp.where(cols > rows, VhV, jnp.zeros_like(VhV))
+    big = jnp.asarray(1e30, V.dtype)
+    d = jnp.where(taus != 0, 1.0 / jnp.where(taus == 0, 1, taus), big)
+    return U + jnp.where(
+        rows == cols, d.astype(V.dtype)[None, :], jnp.zeros_like(U)
+    )
+
+
+def larft_reference(V: jnp.ndarray, taus: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin: the compact-WY identity in ops/householder.larft."""
+    from ..householder import larft as _larft
+
+    return _larft(V, taus)
+
+
+def larft_pallas(
+    V: jnp.ndarray, taus: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Compact-WY T for the QR panel base case: the Gram/assembly stage
+    (the MXU-heavy 2 M nb^2 part) fused in one kernel; the <= nb
+    triangular inverse stays on the vendor solve, the same convention
+    as the recursive schedules' <= nb diagonal trsm blocks."""
+    nb = V.shape[1]
+    if taus.shape[0] < nb:
+        taus = jnp.concatenate(
+            [taus, jnp.zeros((nb - taus.shape[0],), taus.dtype)]
+        )
+    Tinv = _run_kernel(
+        _larft_tinv_body,
+        jax.ShapeDtypeStruct((nb, nb), V.dtype),
+        (V, taus),
+        interpret,
+    )
+    T = lax.linalg.triangular_solve(
+        Tinv, jnp.eye(nb, dtype=V.dtype), left_side=True, lower=False
+    )
+    live = (taus != 0)[None, :] & (taus != 0)[:, None]
+    return jnp.where(live, T, jnp.zeros_like(T))
+
+
+def larft(
+    V: jnp.ndarray, taus: jnp.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    return larft_pallas(V, taus, interpret=_resolve_interpret(interpret, V))
+
+
+# ---------------------------------------------------------------------------
+# Triangle-aware syrk pieces (the Cholesky trailing update)
+# ---------------------------------------------------------------------------
+
+
+def _syrk_diag_body(C, A):
+    t = C.shape[0]
+    rows = lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    upd = _mxu_dot(A, _conj(A).T)
+    # entries above the diagonal pass through untouched (_syrk_lower's
+    # contract: callers only consume the lower triangle)
+    return jnp.where(rows >= cols, C - upd, C)
+
+
+def syrk_diag_reference(C: jnp.ndarray, A: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin of the diagonal-block base case of _syrk_lower."""
+    from ...internal.precision import hdot as _dot
+
+    t = C.shape[0]
+    rows = jnp.arange(t)[:, None]
+    cols = jnp.arange(t)[None, :]
+    return jnp.where(rows >= cols, C - _dot(A, _conj(A).T), C)
+
+
+def syrk_diag_pallas(
+    C: jnp.ndarray, A: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Diagonal nb-block of the trailing update: the one place that
+    pays a full-square gemm, fused with the lower-triangle mask in a
+    single VMEM pass."""
+    return _run_kernel(
+        _syrk_diag_body,
+        jax.ShapeDtypeStruct(C.shape, C.dtype),
+        (C, A),
+        interpret,
+    )
+
+
+def syrk_diag(
+    C: jnp.ndarray, A: jnp.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    return syrk_diag_pallas(
+        C, A, interpret=_resolve_interpret(interpret, C, A)
+    )
+
+
+def _gemm_sub_body(C, A, B):
+    return C - _mxu_dot(A, _conj(B).T)
+
+
+def gemm_sub_reference(
+    C: jnp.ndarray, A: jnp.ndarray, B: jnp.ndarray
+) -> jnp.ndarray:
+    """jnp twin: C - A B^H (the off-diagonal syrk block)."""
+    from ...internal.precision import hdot as _dot
+
+    return C - _dot(A, _conj(B).T)
+
+
+def gemm_sub_pallas(
+    C: jnp.ndarray, A: jnp.ndarray, B: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Off-diagonal syrk block: fused multiply-subtract C - A B^H."""
+    return _run_kernel(
+        _gemm_sub_body,
+        jax.ShapeDtypeStruct(C.shape, C.dtype),
+        (C, A, B),
+        interpret,
+    )
+
+
+def gemm_sub(
+    C: jnp.ndarray, A: jnp.ndarray, B: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    return gemm_sub_pallas(
+        C, A, B, interpret=_resolve_interpret(interpret, C, A, B)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The solve-phase trsm pair (serve phase="solve" buckets)
+# ---------------------------------------------------------------------------
+
+#: diagonal-block size of the in-kernel substitution; serve bucket
+#: sizes are multiples of 128 so 32 always divides them
+_TRSM_KB = 32
+
+
+def _trsm_kb(n: int) -> int:
+    for kb in (_TRSM_KB, 16, 8, 4, 2, 1):
+        if n % kb == 0:
+            return kb
+    return 1
+
+
+def _newton_tri_inv(D, rows, cols, lower: bool, unit: bool, kb: int):
+    """Exact inverse of a triangular (kb, kb) block by Newton iteration:
+    X0 = diag(1/diag), residual I - D X strictly triangular (nilpotent),
+    squared each step -> ceil(log2(kb)) iterations reach it exactly."""
+    keep = cols <= rows if lower else cols >= rows
+    D = jnp.where(keep, D, jnp.zeros_like(D))
+    if unit:
+        D = jnp.where(rows == cols, jnp.ones_like(D), D)
+        X = jnp.where(rows == cols, jnp.ones_like(D), jnp.zeros_like(D))
+    else:
+        dg = jnp.sum(
+            jnp.where(rows == cols, D, jnp.zeros_like(D)), axis=1,
+            keepdims=True,
+        )
+        X = jnp.where(rows == cols, 1.0 / dg, jnp.zeros_like(D))
+    eye2 = jnp.where(rows == cols, jnp.ones_like(D), jnp.zeros_like(D))
+    iters = int(math.ceil(math.log2(kb))) if kb > 1 else 0
+    for _ in range(iters):
+        X = _mxu_dot(X, 2.0 * eye2 - _mxu_dot(D, X))
+    return X
+
+
+def _trsm_body(L, B, *, lower: bool, unit: bool, kb: int):
+    n, nrhs = B.shape
+    nblk = n // kb
+    rows = lax.broadcasted_iota(jnp.int32, (kb, kb), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (kb, kb), 1)
+
+    def blk(i, X):
+        k = i if lower else nblk - 1 - i
+        r0 = k * kb
+        # full-width update: rows of X not yet solved are still zero,
+        # so the unsolved columns of this row block contribute nothing
+        # (packed-LU storage included: the other triangle multiplies
+        # zero rows)
+        Lrow = lax.dynamic_slice(L, (r0, 0), (kb, n))
+        rhs = lax.dynamic_slice(B, (r0, 0), (kb, nrhs)) - _mxu_dot(Lrow, X)
+        D = lax.dynamic_slice(L, (r0, r0), (kb, kb))
+        Dinv = _newton_tri_inv(D, rows, cols, lower, unit, kb)
+        return lax.dynamic_update_slice(X, _mxu_dot(Dinv, rhs), (r0, 0))
+
+    return lax.fori_loop(0, nblk, blk, jnp.zeros_like(B))
+
+
+def trsm_lower_reference(
+    L: jnp.ndarray, B: jnp.ndarray, unit: bool = False
+) -> jnp.ndarray:
+    """jnp twin: the vendor lower-triangular solve."""
+    return lax.linalg.triangular_solve(
+        L, B, left_side=True, lower=True, unit_diagonal=unit
+    )
+
+
+def trsm_upper_reference(U: jnp.ndarray, B: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin: the vendor upper-triangular solve."""
+    return lax.linalg.triangular_solve(U, B, left_side=True, lower=False)
+
+
+def _trsm_pallas_call(T, B, lower, unit, interpret):
+    body = functools.partial(
+        _trsm_body, lower=lower, unit=unit, kb=_trsm_kb(T.shape[0])
+    )
+    return _run_kernel(
+        body, jax.ShapeDtypeStruct(B.shape, B.dtype), (T, B), interpret
+    )
+
+
+def trsm_lower_pallas(
+    L: jnp.ndarray, B: jnp.ndarray, unit: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """L X = B by blocked forward substitution in one kernel (reads
+    only the lower triangle, so packed-LU storage is fine)."""
+    return _trsm_pallas_call(L, B, lower=True, unit=unit, interpret=interpret)
+
+
+def trsm_upper_pallas(
+    U: jnp.ndarray, B: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """U X = B by blocked backward substitution in one kernel (reads
+    only the upper triangle)."""
+    return _trsm_pallas_call(U, B, lower=False, unit=False,
+                             interpret=interpret)
+
+
+def trsm_lower(
+    L: jnp.ndarray, B: jnp.ndarray, unit: bool = False,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    return trsm_lower_pallas(
+        L, B, unit=unit, interpret=_resolve_interpret(interpret, L, B)
+    )
+
+
+def trsm_upper(
+    U: jnp.ndarray, B: jnp.ndarray, interpret: Optional[bool] = None
+) -> jnp.ndarray:
+    return trsm_upper_pallas(
+        U, B, interpret=_resolve_interpret(interpret, U, B)
+    )

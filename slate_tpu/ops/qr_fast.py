@@ -203,17 +203,24 @@ def _pick_ib(w: int, ib: int) -> int:
     return 1
 
 
-def _geqrf_rec(G, nb_switch, ib):
+def _geqrf_rec(G, nb_switch, ib, family="recursive"):
     """Returns (G_factored, taus, panels): panels = [(offset, w, T)]
     for each nb_switch-wide base panel, T its compact-WY factor in the
-    frame of G (reflector j of the panel eliminates row offset+j)."""
+    frame of G (reflector j of the panel eliminates row offset+j).
+    ``family="pallas"`` assembles T through the fused compact-WY kernel
+    (ops/pallas/panel_kernels.larft) instead of the jnp assembly."""
     m, n = G.shape
     if n <= nb_switch:
         P, taus = _qr_panel_strips(G, _pick_ib(n, ib))
-        T = larft(materialize_v(P), taus)
+        if family == "pallas":
+            from .pallas import panel_kernels as pk
+
+            T = pk.larft(materialize_v(P), taus)
+        else:
+            T = larft(materialize_v(P), taus)
         return P, taus, [(0, n, T)]
     s = split_point(n)
-    F1, t1, P1 = _geqrf_rec(G[:, :s], nb_switch, ib)
+    F1, t1, P1 = _geqrf_rec(G[:, :s], nb_switch, ib, family)
     # apply the left half's panels to the right half, oldest first
     # (Q^H C applies the leftmost panel's reflectors first).  V is kept
     # full height (zeros above the panel offset) so the gemm shapes stay
@@ -229,7 +236,7 @@ def _geqrf_rec(G, nb_switch, ib):
     C2 = C[s:]
     if mc > m - s:
         C2 = jnp.pad(C2, ((0, mc - (m - s)), (0, 0)))
-    F2, t2, P2 = _geqrf_rec(C2, nb_switch, ib)
+    F2, t2, P2 = _geqrf_rec(C2, nb_switch, ib, family)
     F2 = F2[: m - s]
     out = jnp.concatenate(
         [F1, jnp.concatenate([C[:s], F2], axis=0)], axis=1
@@ -239,7 +246,8 @@ def _geqrf_rec(G, nb_switch, ib):
 
 
 def geqrf_recursive(
-    G: jnp.ndarray, nb_switch: int = 256, ib: int = 32
+    G: jnp.ndarray, nb_switch: int = 256, ib: int = 32,
+    family: str = "recursive",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Divide & conquer blocked Householder QR of (m, n), m >= n, any n.
     Returns (G_factored, taus) in LAPACK geqrf layout — the drop-in
@@ -259,10 +267,19 @@ def geqrf_recursive(
         # zero pad rows: QR of [A; 0] has the same R and taus, reflector
         # entries in pad rows are exact zeros (larfg of a zero tail)
         Gp = jnp.pad(G, ((0, mc - m), (0, 0)))
-        F, taus, _ = _geqrf_rec(Gp, nb_switch, ib)
+        F, taus, _ = _geqrf_rec(Gp, nb_switch, ib, family)
         return F[:m], taus
-    F, taus, _ = _geqrf_rec(G, nb_switch, ib)
+    F, taus, _ = _geqrf_rec(G, nb_switch, ib, family)
     return F, taus
+
+
+def geqrf_pallas(
+    G: jnp.ndarray, nb_switch: int = 256, ib: int = 32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The recursive lattice with the compact-WY base case on the fused
+    Pallas kernel — a positional-only entry point the drivers can wrap
+    in a gated jit with static (nb_switch, ib)."""
+    return geqrf_recursive(G, nb_switch, ib, family="pallas")
 
 
 def flat_nb(n: int) -> int:
@@ -294,14 +311,14 @@ def resolve_qr_schedule(m: int, n: int, schedule: str = "auto") -> str:
 
     from .householder import _geqrf_xla
 
-    if schedule == "recursive" and m >= n:
-        return "recursive"
+    if schedule in ("recursive", "pallas") and m >= n:
+        return schedule
     tiled = m >= n and flat_nb(n) > 0
     if schedule == "flat" and tiled:
         return "flat"
     if schedule == "auto":
         if jax.default_backend() != "cpu" and m >= n and n >= RECURSIVE_MIN_N:
-            return "recursive"
+            return "pallas"
         if jax.default_backend() != "cpu" and n >= 1024 and tiled:
             return "flat"
     if _geqrf_xla is not None:
@@ -344,13 +361,17 @@ def geqrf_schedule_flops(
                 "exec": 2.0 * float(n) * n * (m - n / 3.0),
                 "units": {("vendor_qr", m, n)}}
 
+    # the pallas compact-WY kernel fuses the same Gram + assembly FLOPs
+    # (vendor solve stays at <= nb both ways) — only the unit differs
+    panel_unit = "pallas_qr_panel" if schedule == "pallas" else "qr_panel"
+
     def base_flops(M, w):
         ibb = _pick_ib(w, ib)
         strips = max(w // ibb, 1)
         # per strip: micro rank-1s + two full-panel-width masked WY gemms
         ex = strips * (2.0 * M * ibb * ibb + 4.0 * M * ibb * w)
         ex += 2.0 * M * w * w + w**3 / 3.0  # larft (VhV + solve)
-        return ex, {("qr_panel", M, w)}
+        return ex, {(panel_unit, M, w)}
 
     if schedule == "flat":
         # geqrf_fast at the dispatch's own block-size pick (flat_nb —

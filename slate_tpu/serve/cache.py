@@ -125,7 +125,7 @@ def _build_core(key: BucketKey) -> Callable:
         if key.routine == "gesv":
 
             def core(Fg, Bg):
-                X = _lu.getrs_from_global(Fg, Bg)
+                X = _lu.getrs_from_global(Fg, Bg, key.schedule)
                 return X, jnp.zeros((), jnp.int32)
 
             return core
@@ -133,7 +133,7 @@ def _build_core(key: BucketKey) -> Callable:
         if key.routine == "posv":
 
             def core(Fg, Bg):
-                X = _chol.potrs_from_global(Fg, Bg)
+                X = _chol.potrs_from_global(Fg, Bg, key.schedule)
                 return X, jnp.zeros((), jnp.int32)
 
             return core
