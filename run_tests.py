@@ -2259,6 +2259,326 @@ def scale_gate() -> int:
     return rc
 
 
+# Fleet drill: one router (this process) + two REAL spawned worker
+# processes on CPU.  host0 carries an SDC stream (sdc_solve:every=2),
+# host1 a latency tax — the router's full certification, quarantine,
+# re-dispatch and lifecycle planes must contain both.  Phases: SDC
+# quarantine + probe recovery, fleet-wide quota abuse, a real SIGKILL
+# host death (chaos site host_death) with respawn -> rejoin -> forced
+# probe, injected rpc timeouts + a partition, then the observability
+# fan-in (per-host dumps, stitched trace, orphan gauge).  Every
+# delivery is reference-checked client-side (note_bad_result) — the
+# drill only publishes evidence; tools/fleet_report.py is the judge.
+_FLEET_DRIVER = """
+import os
+import subprocess
+import sys
+import time
+import numpy as np
+from slate_tpu.aux import faults, metrics, spans
+from slate_tpu.exceptions import SlateError
+from slate_tpu.fleet.router import (
+    FleetRouter, note_bad_result, note_trace_orphans,
+)
+from slate_tpu.serve.service import Rejected
+
+outdir, repo = sys.argv[1], sys.argv[2]
+
+metrics.on()
+metrics.reset()
+spans.on(ring=65536)
+
+N = 12
+rng = np.random.default_rng(3)
+A = (rng.standard_normal((N, N)) + N * np.eye(N)).astype(np.float32)
+
+def prob(seed):
+    return np.random.default_rng(seed).standard_normal(
+        (N, 2)).astype(np.float32)
+
+base = {
+    "JAX_PLATFORMS": "cpu",
+    "SLATE_TPU_METRICS": "1",
+    "SLATE_TPU_TRACE_RING": "65536",
+    "SLATE_TPU_SYNC_CHECK": "1",
+    "SLATE_TPU_FAULTS": None,
+}
+host0 = dict(base, SLATE_TPU_FAULTS="sdc_solve:every=2")
+host1 = dict(base, SLATE_TPU_FAULTS="latency:every=3,ms=40")
+
+r = FleetRouter(
+    spawn=2, cert="full",
+    tenants="abuser:rate=4,burst=4;victim:rate=500,burst=100",
+    heartbeat_s=0.2, rpc_timeout_s=30.0, dead_after=2,
+    redispatch_max=2, hedge_s=1.0, respawn=True,
+    quarantine_cooldown_s=0.4, spawn_env=[host0, host1], seed=7,
+)
+r.start()
+
+checked = [0]
+
+def solve(tenant="victim", seed=0):
+    B = prob(seed)
+    try:
+        X = r.submit("gesv", A, B, deadline=60.0,
+                     tenant=tenant).result(timeout=120)
+    except Exception as e:
+        return e
+    # NaN-safe reference check: any non-finite or off-fence entry is a
+    # silent wrong answer the defenses let through
+    if not np.all(np.abs(A @ X - B) <= 1e-2):
+        note_bad_result()
+    checked[0] += 1
+    return None
+
+# ---- phase 1: SDC containment, quarantine + probe recovery ---------
+for i in range(120):
+    e = solve(seed=100 + i)
+    assert e is None, f"victim solve failed under SDC: {e!r}"
+    c = metrics.counters()
+    if (c.get("fleet.quarantined", 0) >= 1
+            and c.get("fleet.unquarantined", 0) >= 1):
+        break
+    time.sleep(0.01)
+c = metrics.counters()
+assert c.get("fleet.quarantined", 0) >= 1, "sdc host never quarantined"
+assert c.get("fleet.unquarantined", 0) >= 1, "quarantine never probed back"
+print(f"phase 1: quarantine engaged+recovered after {i + 1} solves")
+
+# ---- phase 2: fleet-wide quota (abuser refused, victim whole) ------
+rejected = 0
+for i in range(14):
+    e = solve(tenant="abuser", seed=200 + i)
+    if e is not None:
+        assert isinstance(e, Rejected), f"abuser got {e!r}, not Rejected"
+        rejected += 1
+assert rejected > 0, "abuser burst never hit the fleet-wide quota"
+for i in range(6):
+    e = solve(seed=300 + i)
+    assert e is None, f"victim starved during abuse: {e!r}"
+print(f"phase 2: abuser rejected {rejected}/14, victim served")
+
+# ---- phase 3: real host death (SIGKILL) + fail-fast re-dispatch ----
+# contract: every future RESOLVES — a correct re-dispatched answer or
+# a TYPED error (the sole survivor may be the SDC lane, whose cert
+# failures have no re-dispatch target until the respawn) — none hang,
+# none deliver garbage (solve() reference-checks every delivery)
+faults.configure("host_death:once")
+faults.on()
+delivered3 = 0
+for i in range(10):
+    e = solve(seed=400 + i)
+    if e is None:
+        delivered3 += 1
+    else:
+        assert isinstance(e, SlateError), f"untyped failure: {e!r}"
+# death is DECLARED by the liveness plane (heartbeat misses reaching
+# dead_after), not by the request path — the 10 solves above can
+# finish inside a single beat, so give the monitor a few beats
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline:
+    if metrics.counters().get("fleet.host_dead", 0) >= 1:
+        break
+    time.sleep(0.05)
+c = metrics.counters()
+assert c.get("fleet.host_dead", 0) >= 1, "death was never declared"
+assert c.get("fleet.redispatched", 0) >= 1, "no re-dispatch recovered it"
+assert delivered3 >= 1, "no request survived the host death"
+print(f"phase 3: host died, 10/10 futures resolved "
+      f"({delivered3} delivered)")
+
+# ---- phase 4: respawn -> rejoin -> forced certification probe ------
+# a rejoined host only turns live once one of its deliveries is
+# force-certified, so traffic must keep flowing while we wait (the
+# probe rides a routed solve — either picked directly or via the
+# re-dispatch of a cert failure on the SDC lane)
+deadline = time.monotonic() + 60
+states = {}
+while time.monotonic() < deadline:
+    states = {k: v["state"] for k, v in r.health()["hosts"].items()}
+    if all(s == "live" for s in states.values()):
+        break
+    e = solve(seed=510)
+    if e is not None:
+        assert isinstance(e, SlateError), f"untyped failure: {e!r}"
+    time.sleep(0.05)
+assert all(s == "live" for s in states.values()), (
+    f"dead host never rejoined live (states={states})")
+assert metrics.counters().get("fleet.host_respawned", 0) >= 1, (
+    "death was absorbed without a respawn")
+for i in range(12):
+    e = solve(seed=500 + i)
+    assert e is None, f"victim solve failed after rejoin: {e!r}"
+print("phase 4: host respawned, probe-certified, serving again")
+
+# ---- phase 5: rpc timeouts + a partition, absorbed by retry --------
+faults.configure("rpc_timeout:every=4;host_partition:once")
+delivered5 = 0
+for i in range(12):
+    e = solve(seed=600 + i)
+    if e is None:
+        delivered5 += 1
+    else:
+        assert isinstance(e, SlateError), f"untyped failure: {e!r}"
+faults.reset()
+assert delivered5 >= 9, (
+    f"timeouts/partition overwhelmed the fleet: {delivered5}/12")
+print(f"phase 5: timeouts/partition absorbed ({delivered5}/12 delivered)")
+
+# ---- fan-in: per-host dumps, stitched trace, orphan gauge ----------
+replies = r.dump_hosts(outdir)
+assert len(replies) == 2, f"expected both hosts to dump, got {replies}"
+router_trace = os.path.join(outdir, "router.trace.json")
+spans.export_chrome(router_trace, process_name="router")
+traces = [router_trace] + sorted(
+    os.path.join(outdir, f) for f in os.listdir(outdir)
+    if f.endswith(".trace.json") and not f.startswith("router")
+)
+out = subprocess.run(
+    [sys.executable, os.path.join(repo, "tools", "trace_stitch.py"),
+     "--allow-orphans",
+     "-o", os.path.join(outdir, "stitched.trace.json"), *traces],
+    capture_output=True, text=True,
+)
+assert out.returncode == 0, out.stdout + out.stderr
+line = out.stdout.strip().splitlines()[-1]
+note_trace_orphans(int(line.rpartition("orphans=")[2]))
+print(line)
+r.stop(drain=True)
+metrics.dump()
+print(f"fleet drill: {checked[0]} reference-checked deliveries")
+"""
+
+
+# Escape leg: the SAME SDC stream with certification off — corrupted
+# deliveries now reach the client, the reference check counts them
+# (fleet.bad_results), and tools/fleet_report.py MUST exit nonzero.
+_FLEET_ESCAPE_DRIVER = """
+import sys
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.fleet.router import FleetRouter, note_bad_result
+
+metrics.on()
+metrics.reset()
+
+N = 12
+rng = np.random.default_rng(3)
+A = (rng.standard_normal((N, N)) + N * np.eye(N)).astype(np.float32)
+
+host0 = {
+    "JAX_PLATFORMS": "cpu",
+    "SLATE_TPU_FAULTS": "sdc_solve:every=2",
+    "SLATE_TPU_METRICS": None,
+    "SLATE_TPU_TRACE_RING": None,
+}
+r = FleetRouter(spawn=1, cert="off", heartbeat_s=0.25,
+                rpc_timeout_s=30.0, spawn_env=[host0], seed=7)
+r.start()
+bad = 0
+for i in range(8):
+    B = np.random.default_rng(700 + i).standard_normal(
+        (N, 2)).astype(np.float32)
+    X = r.submit("gesv", A, B, deadline=60.0).result(timeout=120)
+    if not np.all(np.abs(A @ X - B) <= 1e-2):
+        note_bad_result()
+        bad += 1
+r.stop(drain=True)
+metrics.dump()
+print(f"escape leg: {bad} silent wrong answers delivered (cert off)")
+assert bad > 0, "sdc stream produced no corrupt delivery to flag"
+"""
+
+
+def fleet_gate() -> int:
+    """Cross-process defense gate, three legs: (1) the fleet suite
+    (wire framing, router edge cases — exactly-once under host death
+    with a hedge twin inflight, drain racing re-dispatch, stats-only
+    reports after death, forced rejoin probes — the worker front-end,
+    and the stitch/merge/report tools); (2) the 3-process CPU drill —
+    router + 2 spawned workers, host0 carrying an SDC stream and host1
+    a latency tax, driven through quota abuse, a real SIGKILL host
+    death with respawn/rejoin/probe, and injected rpc timeouts +
+    partition, its per-host dumps merged (``metrics_merge --tag``) and
+    traces stitched (``trace_stitch``), judged by
+    tools/fleet_report.py; (3) the escape proof: certification off,
+    the same SDC — the report MUST exit nonzero."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_fleet.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    with tempfile.TemporaryDirectory(prefix="slate_fleet_") as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        for var in ("SLATE_TPU_FAULTS", "SLATE_TPU_FACTOR_CACHE",
+                    "SLATE_TPU_TENANTS", "SLATE_TPU_ADAPTIVE",
+                    "SLATE_TPU_INTEGRITY", "SLATE_TPU_WARMUP",
+                    "SLATE_TPU_ARTIFACTS", "SLATE_TPU_SCALE",
+                    "SLATE_TPU_FLEET", "SLATE_TPU_FLEET_TENANTS"):
+            env.pop(var, None)
+        outdir = os.path.join(td, "dumps")
+        os.makedirs(outdir)
+        jsonl = os.path.join(td, "router.jsonl")
+        # the drill's router AND both workers run the instrumented
+        # sync runtime: every router<->host lock edge in the drill is
+        # order-checked against LOCK_ORDER.json
+        rc = subprocess.call(
+            [sys.executable, "-c", _FLEET_DRIVER, outdir, here],
+            env=dict(env, SLATE_TPU_METRICS=jsonl,
+                     SLATE_TPU_SYNC_CHECK="1"),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        host_dumps = sorted(
+            os.path.join(outdir, f) for f in os.listdir(outdir)
+            if f.endswith(".metrics.jsonl")
+        )
+        merged = os.path.join(td, "merged.jsonl")
+        cmd = [sys.executable, os.path.join("tools", "metrics_merge.py"),
+               "-o", merged]
+        for tag in ["router"] + [
+            os.path.basename(p).split(".")[0] for p in host_dumps
+        ]:
+            cmd += ["--tag", tag]
+        cmd += [jsonl] + host_dumps
+        rc = subprocess.call(cmd, cwd=here)
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "fleet_report.py"),
+             merged, "--victim", "victim", "--p99-budget", "15",
+             "--require-stitch"],
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        esc = os.path.join(td, "escape.jsonl")
+        rc = subprocess.call(
+            [sys.executable, "-c", _FLEET_ESCAPE_DRIVER],
+            env=dict(env, SLATE_TPU_METRICS=esc,
+                     SLATE_TPU_SYNC_CHECK="1"),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, os.path.join("tools", "fleet_report.py"),
+             esc],
+            cwd=here,
+        )
+        if rc == 0:
+            print("fleet gate: report failed to flag an undefended "
+                  "SDC escape across the fleet")
+            return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier1", action="store_true",
@@ -2341,6 +2661,14 @@ def main() -> int:
                          "static (misses p99) then elastic (holds it, "
                          "artifact-warmed lanes, fleet returns to "
                          "min), judged by tools/capacity_report.py")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the cross-process defense gate: the "
+                         "fleet suite + the 3-process drill (router + "
+                         "2 spawned workers under SDC/latency/host "
+                         "death/timeouts, per-host dumps merged and "
+                         "traces stitched) judged by "
+                         "tools/fleet_report.py, + the escape proof "
+                         "(certification off -> report nonzero)")
     ap.add_argument("routines", nargs="*", default=[])
     ap.add_argument("--size", default="quick", choices=sorted(PRESETS))
     ap.add_argument("--grid", default="1x1")
@@ -2379,6 +2707,8 @@ def main() -> int:
         return soak_gate(full=args.full)
     if args.scale:
         return scale_gate()
+    if args.fleet:
+        return fleet_gate()
 
     # virtual devices for multi-process grids (tests force the cpu
     # platform; the TPU plugin ignores JAX_PLATFORMS so set via config)

@@ -70,7 +70,10 @@ from .rules_concurrency import _under_lock, iter_attr_decls
 LOCK_GRAPH_NAME = "LOCK_ORDER.json"
 
 #: directories whose nested lock regions feed the lock-order graph
-LOCK_SCOPE = ("slate_tpu/serve/", "slate_tpu/integrity/", "slate_tpu/aux/")
+LOCK_SCOPE = (
+    "slate_tpu/serve/", "slate_tpu/integrity/", "slate_tpu/aux/",
+    "slate_tpu/fleet/",
+)
 
 #: constructors that declare a lock (threading primitives and their
 #: aux/sync drop-in wrappers)

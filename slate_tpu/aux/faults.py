@@ -74,6 +74,20 @@ Sites (:data:`SITES`) and where they are checked:
                        well-behaved tenants' SLO melting; joined by
                        tools/chaos_report.py against ``serve.shed`` /
                        ``serve.rejected``
+    ``host_death``     one fleet worker PROCESS is SIGKILLed with
+                       requests in flight (``fleet/router.py``
+                       dispatch; connect-mode hosts get the
+                       router-side signature of the same event) — the
+                       host lifecycle must fail-fast the inflight
+                       members and re-dispatch them to a live host
+    ``host_partition`` fleet RPC blackhole: the bytes vanish and no
+                       RST returns (``fleet/router.py`` ``_rpc``,
+                       heartbeats included) — indistinguishable from a
+                       timeout by design; drives the suspect -> dead
+                       ladder when sustained
+    ``rpc_timeout``    one fleet solve RPC times out transiently
+                       (``fleet/router.py`` ``_rpc``) — absorbed by
+                       the decorrelated-jitter retry ladder
 
 Triggers (exactly one per site): probability ``p=0.2`` (seeded RNG per
 site, so the fire pattern is a pure function of ``seed`` and the call
@@ -206,6 +220,18 @@ SITE_SPECS: Tuple[SiteSpec, ...] = (
         "serve.shed", "serve.rejected_quota", "serve.rejected_share",
         "serve.rejected",
     )),
+    # fleet-tier sites (fired in fleet/router.py): a dead host is
+    # absorbed when its inflight requests were failed fast and
+    # re-dispatched to a live host; a partitioned/blackholed RPC is
+    # absorbed by the bounded-timeout retry ladder and, past it, the
+    # same dead-host machinery
+    SiteSpec("host_death", recovery=(
+        "fleet.redispatched", "fleet.host_dead",
+    )),
+    SiteSpec("host_partition", recovery=(
+        "fleet.rpc_retries", "fleet.redispatched", "fleet.host_dead",
+    )),
+    SiteSpec("rpc_timeout", recovery=("fleet.rpc_retries",)),
 )
 
 SITE_REGISTRY: Dict[str, SiteSpec] = {s.name: s for s in SITE_SPECS}

@@ -362,14 +362,19 @@ def by_trace() -> Dict[str, List[Span]]:
     return out
 
 
-def export_chrome(path: str, extra=None) -> str:
+def export_chrome(path: str, extra=None,
+                  process_name: Optional[str] = None) -> str:
     """Write the ring as Chrome trace-event JSON (the ``traceEvents``
     array format; open in Perfetto / chrome://tracing).  One lane per
     replica/worker: spans with a ``lane`` string share a named tid;
     lane-less spans fall back to one tid per OS thread.  ``extra``
     accepts legacy ``trace.Event``-shaped tuples ``(name, start, stop,
     thread)`` so ``trace.finish()`` can merge both timelines.  Spans
-    carry ``trace``/``span``/``parent`` ids and attrs in ``args``."""
+    carry ``trace``/``span``/``parent`` ids and attrs in ``args``.
+    ``process_name`` labels this process's pid track (Chrome's
+    ``process_name`` metadata) — the fleet tier's per-host exports set
+    it so ``tools/trace_stitch.py`` renders each host as a named
+    process in the stitched view."""
     items = snapshot()
     rows = []  # (name, t0, t1, lane, thread, kind, args)
     seen = set()  # dedup key against the legacy trace-event mirror
@@ -425,6 +430,9 @@ def export_chrome(path: str, extra=None) -> str:
          "args": {"name": key}}
         for key, tid in sorted(tids.items(), key=lambda kv: kv[1])
     ]
+    if process_name is not None:
+        meta.insert(0, {"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": str(process_name)}})
     with open(path, "w") as f:
         json.dump({"traceEvents": meta + evs, "displayTimeUnit": "ms"}, f)
     return path

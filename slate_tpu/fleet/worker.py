@@ -1,0 +1,239 @@
+"""One fleet host: a socket front-end over this process's
+:class:`~slate_tpu.serve.service.SolverService`.
+
+Runnable as ``python -m slate_tpu.fleet.worker [--port N]``.  The
+worker binds (``SLATE_TPU_FLEET_ADDR``, default loopback), announces
+``FLEET_WORKER_PORT=<port>`` on stdout (how the router's ``spawn=``
+mode learns an ephemeral port), and serves one RPC per connection,
+thread-per-connection — the service underneath does the real
+concurrency, a handler thread just parks in ``Future.result()``.
+
+Ops (``header["op"]``):
+
+* ``solve`` — arrays A, B + routine/deadline/retries/precision/tenant/
+  priority/trace.  Runs ``service.submit(...).result()`` and replies
+  ``{"ok": True}`` + X, or ``{"ok": False, "error": <class>,
+  "message": ..., "context": {...}}`` for typed failures — the error
+  taxonomy crosses the wire by name, so the router re-raises the same
+  exception class the single-process path would.  The router's trace
+  id is adopted via ``submit(trace_id=)``: this host's spans join the
+  router's chain and ``tools/trace_stitch.py`` can render one request
+  as one cross-process Perfetto track.
+* ``report`` — heartbeat + stats: queue depth, inflight, phase, the
+  local admission plane's burn EWMA (None when the plane is off).
+* ``dump`` — write this process's metrics JSONL and span ring to the
+  paths the router names (host-tagged observability fan-in).
+* ``drain`` — ``service.stop(drain=True)``: admission closes now,
+  admitted work finishes, then the process exits.
+* ``ping`` — liveness only.
+
+The worker deliberately has NO fleet-specific defense logic: quotas,
+quarantine, hedging and host lifecycle live at the router; this module
+is a dumb, bounded adapter so a host's failure modes stay the
+service's own (plus death, which the router owns).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..aux import metrics, spans
+from ..exceptions import SlateError
+from . import wire
+
+#: worker bind address (the router address knob's worker half);
+#: spawned workers inherit it from the router's environment
+ADDR_ENV = "SLATE_TPU_FLEET_ADDR"
+
+#: stdout announce line prefix (the spawn handshake contract)
+ANNOUNCE = "FLEET_WORKER_PORT="
+
+#: idle-connection bound: a peer that opens a socket and never sends a
+#: full frame must not pin a handler thread forever
+IDLE_TIMEOUT_S = 120.0
+
+
+class FleetWorker:
+    """Socket front-end over one process-wide serve service."""
+
+    def __init__(
+        self,
+        host: Optional[str] = None,
+        port: int = 0,
+        service=None,
+    ):
+        self.host = host or os.environ.get(ADDR_ENV) or "127.0.0.1"
+        self.port = int(port)
+        self._service = service
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    @property
+    def service(self):
+        # lazy: importing jax/building replicas happens on first use,
+        # not at construction (tests build workers without serving)
+        if self._service is None:
+            from ..serve import api as serve_api
+
+            self._service = serve_api.get_service()
+        return self._service
+
+    # -- serving ------------------------------------------------------------
+
+    def bind(self) -> int:
+        """Bind + listen; returns the (possibly ephemeral) port."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self._sock = s
+        self.port = s.getsockname()[1]
+        return self.port
+
+    def serve_forever(self, announce: bool = True) -> None:
+        if self._sock is None:
+            self.bind()
+        if announce:
+            print(f"{ANNOUNCE}{self.port}", flush=True)
+        self._sock.settimeout(0.25)  # poll the stop flag
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed under us (drain)
+            t = threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            )
+            t.start()
+        self._sock.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    # -- one RPC ------------------------------------------------------------
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(IDLE_TIMEOUT_S)
+            header, arrays = wire.recv_msg(conn)
+            op = header.get("op")
+            if op == "solve":
+                reply, out = self._solve(header, arrays)
+            elif op == "report":
+                reply, out = self._report(), {}
+            elif op == "dump":
+                reply, out = self._dump(header), {}
+            elif op == "drain":
+                reply, out = {"ok": True, "op": "drain"}, {}
+            elif op == "ping":
+                reply, out = {"ok": True, "op": "ping"}, {}
+            else:
+                reply, out = {
+                    "ok": False, "error": "ProtocolError",
+                    "message": f"unknown fleet op {op!r}",
+                }, {}
+            wire.send_msg(conn, reply, out)
+            if op == "drain":
+                # reply first (the router is waiting on it), then stop:
+                # admission closes immediately, admitted work finishes
+                self._drain_and_exit(header)
+        except (ConnectionError, OSError, wire.ProtocolError):
+            pass  # peer vanished mid-frame; nothing to answer
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _solve(self, header: dict, arrays: dict):
+        deadline = header.get("deadline")
+        if deadline is not None:
+            # the router ships REMAINING budget: rebase on this host's
+            # clock (wall-clock offsets between processes cancel out)
+            deadline = max(0.0, float(deadline))
+        try:
+            fut = self.service.submit(
+                header["routine"],
+                arrays["A"],
+                arrays["B"],
+                deadline=deadline,
+                retries=int(header.get("retries", 0)),
+                precision=header.get("precision"),
+                tenant=header.get("tenant"),
+                priority=header.get("priority"),
+                trace_id=header.get("trace"),
+            )
+            X = fut.result()
+        except Exception as e:  # typed taxonomy crosses by name
+            metrics.inc("fleet.worker.typed_errors")
+            reply = {
+                "ok": False,
+                "error": type(e).__name__,
+                "message": str(e.args[0]) if e.args else str(e),
+            }
+            if isinstance(e, SlateError):
+                reply["context"] = e.context()
+            return reply, {}
+        metrics.inc("fleet.worker.solved")
+        return {"ok": True, "op": "solve"}, {"X": X}
+
+    def _report(self) -> dict:
+        h = self.service.health()
+        adm = h.get("admission") or {}
+        return {
+            "ok": True,
+            "op": "report",
+            "pid": os.getpid(),
+            "phase": h.get("phase"),
+            "queue_depth": int(h.get("queue_depth", 0)),
+            "inflight": int(h.get("inflight", 0)),
+            "burn": adm.get("burn_ewma"),
+            "t": time.time(),
+        }
+
+    def _dump(self, header: dict) -> dict:
+        out = {"ok": True, "op": "dump", "metrics": None, "trace": None}
+        mpath = header.get("metrics")
+        if mpath and metrics.is_on():
+            out["metrics"] = metrics.dump(mpath)
+        tpath = header.get("trace")
+        if tpath and spans.is_on():
+            out["trace"] = spans.export_chrome(
+                tpath, process_name=header.get("label")
+            )
+        return out
+
+    def _drain_and_exit(self, header: dict) -> None:
+        self.shutdown()
+        svc = self._service
+        if svc is not None:
+            svc.stop(
+                drain=True,
+                drain_timeout=float(header.get("timeout", 10.0)),
+            )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="slate_tpu fleet worker (one host process)"
+    )
+    ap.add_argument("--host", default=None, help="bind address")
+    ap.add_argument("--port", type=int, default=0,
+                    help="bind port (0 = ephemeral, announced on stdout)")
+    args = ap.parse_args(argv)
+    w = FleetWorker(host=args.host, port=args.port)
+    w.bind()
+    w.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

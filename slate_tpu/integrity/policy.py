@@ -195,6 +195,37 @@ def from_options(integrity=None, opts=None) -> Optional[IntegrityPolicy]:
     return parse_spec(spec)
 
 
+def residual_certificate(routine: str, A, X, B) -> bool:
+    """Certify one delivered solve AGAINST ITS CONTRACT: the
+    factor-cache residual fence ``max|A X - B| <= sqrt(eps)(|A||X| +
+    |B|)`` with posv's lower triangle symmetrized first (the api
+    contract — "solves with the LOWER triangle of A" — mirrored from
+    ``serve/service._cert_operand``: certifying against junk above the
+    diagonal would fail every verdict on a correct X).  The fleet
+    router certifies cross-process deliveries through this ONE
+    spelling; routines without a residual contract (gels) pass
+    vacuously.  The check runs in the precision the solve was SERVED
+    at (X's dtype): the caller may hold float64 operands while the
+    service computes in float32, and judging a float32 solve against
+    float64's eps would fail every correct delivery."""
+    import numpy as np
+
+    if routine not in ("gesv", "posv"):
+        return True
+    from ..serve.factor_cache import residual_ok
+
+    X = np.asarray(X)
+    A = np.asarray(A, dtype=X.dtype)
+    B = np.asarray(B, dtype=X.dtype)
+    if B.ndim == 1:
+        B = B[:, None]
+    if X.ndim == 1:
+        X = X[:, None]
+    if routine == "posv":
+        A = np.tril(A) + np.conj(np.tril(A, -1)).T
+    return residual_ok(A, B, X)
+
+
 class IntegrityScore:
     """One lane's certificate-failure EWMA + quarantine state machine
     (class docstring up top: the breaker's recoverable shape, fed by

@@ -29,7 +29,7 @@ import importlib
 _API = (
     "gesv", "posv", "gels", "submit", "warmup", "restore", "wait_ready",
     "configure", "shutdown", "get_service", "get_cache", "health",
-    "InvalidInput",
+    "get_fleet", "InvalidInput",
     # factor cache (factor once, solve many)
     "get_factor_cache", "factor_fingerprint", "invalidate",
     "invalidate_all", "update_factor",

@@ -943,6 +943,16 @@ class AdmissionControl:
                 window_s=round(win, 6), direction=decision,
             )
 
+    def observe_burn(self, burn: float, now: float) -> None:
+        """Fold one EXTERNALLY-measured burn ratio into the overload
+        controller (the fleet router aggregating its hosts' reported
+        burn EWMAs — ``fleet/router.py`` is the consumer).  Same lock,
+        same transition emission as :meth:`observe_finish`, without the
+        per-tenant accounting a remote sample has no identity for."""
+        with self._lock:
+            moved = self.overload.observe(float(burn), now)
+        self._emit_overload(moved)
+
     # -- health -------------------------------------------------------------
 
     def tenants_health(

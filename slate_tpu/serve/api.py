@@ -38,6 +38,7 @@ The default service reads :class:`~slate_tpu.enums.Option` defaults
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future
 from typing import Optional
@@ -58,6 +59,16 @@ from .service import (  # noqa: F401  (re-export: taxonomy)
 
 _lock = threading.Lock()
 _service: Optional[SolverService] = None
+
+# fleet tier (SLATE_TPU_FLEET): with the env unset this stays None and
+# every call below pays exactly one ``is None`` branch — the fleet
+# package is not even imported, so single-process serving is
+# byte-identical to a build without the tier
+_fleet = None
+if os.environ.get("SLATE_TPU_FLEET"):
+    from ..fleet.router import FleetRouter
+
+    _fleet = FleetRouter.from_env()
 
 
 def get_service() -> SolverService:
@@ -136,8 +147,11 @@ def configure(opts: Optional[Options] = None, **kw) -> SolverService:
 
 
 def shutdown() -> None:
-    """Stop the process service (idempotent; a later call re-creates)."""
+    """Stop the process service (idempotent; a later call re-creates).
+    With the fleet tier on, drains the router (and its workers) too."""
     global _service
+    if _fleet is not None:
+        _fleet.stop(drain=True)
     with _lock:
         if _service is not None:
             _service.stop()
@@ -214,7 +228,19 @@ def submit(
     ``tenant``/``priority`` ("high"|"normal"|"low") tag the request
     for the admission plane (``SLATE_TPU_TENANTS`` /
     ``Option.ServeTenantQuota``): per-tenant fair queueing and quotas,
-    priority-ordered overload shedding (typed :class:`Shed`)."""
+    priority-ordered overload shedding (typed :class:`Shed`).
+
+    With ``SLATE_TPU_FLEET`` set the request routes through the
+    process's :class:`~slate_tpu.fleet.FleetRouter` instead — same
+    Future contract and typed taxonomy, plus the fabric's own
+    :class:`~slate_tpu.fleet.HostDead` /
+    :class:`~slate_tpu.fleet.FleetTimeout`."""
+    if _fleet is not None:
+        return _fleet.submit(
+            routine, A, B, deadline=deadline, retries=retries,
+            precision=precision, sharded=sharded, tenant=tenant,
+            priority=priority,
+        )
     return get_service().submit(
         routine, A, B, deadline=deadline, retries=retries,
         precision=precision, sharded=sharded, tenant=tenant,
@@ -278,8 +304,19 @@ def health() -> dict:
     breaker states, recent failure rate, per-replica oldest-queued-age,
     and — with metrics on — the SLO surface: per-bucket p50/p95/p99
     total latency under ``"latency"`` and the deadline-budget burn
-    tiers under ``"slo_burn"`` (see :meth:`SolverService.health`)."""
+    tiers under ``"slo_burn"`` (see :meth:`SolverService.health`).
+    With the fleet tier on, returns the ROUTER's snapshot instead:
+    per-host breaker states + integrity scores, pending count, and the
+    global admission plane (see :meth:`FleetRouter.health`)."""
+    if _fleet is not None:
+        return _fleet.health()
     return get_service().health()
+
+
+def get_fleet():
+    """The process's :class:`~slate_tpu.fleet.FleetRouter`, or None
+    without ``SLATE_TPU_FLEET`` (the single-process path)."""
+    return _fleet
 
 
 def get_cache() -> ExecutableCache:

@@ -1156,6 +1156,7 @@ class SolverService:
         sharded: Optional[bool] = None,
         tenant: Optional[str] = None,
         priority=None,
+        trace_id: Optional[str] = None,
     ) -> Future:
         """Enqueue one solve; returns a Future resolving to the cropped
         solution X (n x nrhs ndarray).
@@ -1186,11 +1187,14 @@ class SolverService:
         ``direct``/``backoff`` children and breaker instants — one
         complete chain per delivered request in the Chrome export.
         On a tenancy-enabled service the root span carries
-        ``tenant``/``priority`` attrs."""
+        ``tenant``/``priority`` attrs.  ``trace_id`` adopts a caller's
+        trace instead of minting one (the fleet worker passes the
+        router's id so this host's spans join the request's
+        cross-process chain); ignored with spans off."""
         if not spans.is_on():
             return self._submit(routine, A, B, deadline, retries,
                                 precision, sharded, tenant, priority)
-        tr = spans.new_trace()
+        tr = trace_id or spans.new_trace()
         root = spans.start("request", trace=tr, lane="client",
                            routine=routine)
         admit = spans.start("admit", trace=tr, parent=root, lane="client")

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Verdict over a fleet drill's merged metrics JSONL.
+
+Reads the ``tools/metrics_merge.py`` fan-in of a fleet run (router +
+per-host dumps, ``--tag``-ed) and judges the cross-process defense
+fabric's core claims:
+
+* **zero hung futures** — ``fleet.submitted`` reconciles EXACTLY
+  against ``fleet.delivered + fleet.typed_errors``: every admitted
+  request resolved, as a value or a typed error, through host death,
+  partitions, hedges and drain.
+* **zero silent wrong answers** — ``fleet.bad_results == 0`` (the
+  drill's client-side reference checks count through
+  ``fleet.note_bad_result``; one nonzero means an SDC crossed the
+  certificate fence and reached a caller).
+* **host death contained** — ``faults.injected.host_death`` implies
+  ``fleet.host_dead`` (detected) and ``fleet.redispatched`` (the
+  inflight work moved) — the SITE_SPECS recovery join, spelled out
+  here because the fleet gate wants the direction, not just presence.
+* **SDC quarantined AND probe-recovered** — ``faults.injected.
+  sdc_solve`` implies ``fleet.cert.fail > 0``, ``fleet.quarantined >=
+  1`` and ``fleet.unquarantined >= 1`` (the cooldown probe brought the
+  host back — quarantine without recovery is capacity loss, not
+  defense).
+* **global quota holds** — ``fleet.rejected_quota > 0`` (the abuser
+  was refused at the ROUTER, fleet-wide) and, with ``--victim``/
+  ``--p99-budget``, the victim tenant's
+  ``fleet.latency.tenant.<victim>.total`` p99 stays within budget.
+* **stitched trace is whole** — the ``fleet.trace_orphans`` gauge
+  (recorded by the drill from ``tools/trace_stitch.py``) is present
+  (``--require-stitch``) and zero.
+* **transient RPC faults absorbed** — ``faults.injected.rpc_timeout``
+  implies ``fleet.rpc_retries > 0``; ``faults.injected.host_partition``
+  implies any of its recovery family (retries, re-dispatch, host-dead
+  detection) fired.
+
+Rows carrying ``"src"`` (the per-host view) are skipped for the global
+checks — the untagged rows ARE the preserved global sums.  Stdlib-only
+by contract.  Exits nonzero when any check fails, so
+``run_tests.py --fleet`` can gate on it.
+
+Usage:
+    python tools/metrics_merge.py --tag router --tag host0 --tag host1 \\
+        router.jsonl host0.metrics.jsonl host1.metrics.jsonl -o merged.jsonl
+    python tools/fleet_report.py merged.jsonl --victim tenant_b \\
+        --p99-budget 2.0 --require-stitch
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def load(path: str) -> Tuple[Dict[str, float], Dict[str, object],
+                             Dict[str, dict]]:
+    """(counters, gauges, hists) — untagged global rows only."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, object] = {}
+    hists: Dict[str, dict] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if "src" in r:
+                continue  # per-host view; globals are the judged rows
+            t = r.get("type")
+            if t == "counter":
+                counters[r["name"]] = (
+                    counters.get(r["name"], 0.0) + float(r["value"])
+                )
+            elif t == "gauge":
+                gauges[r["name"]] = r["value"]
+            elif t == "hist":
+                hists[r["name"]] = r
+    return counters, gauges, hists
+
+
+def checks(counters: Dict[str, float], gauges: Dict[str, object],
+           hists: Dict[str, dict], victim: Optional[str] = None,
+           p99_budget: Optional[float] = None,
+           require_stitch: bool = False) -> List[Tuple[str, bool, str]]:
+    """(name, ok, detail) rows — the verdict table."""
+    c = lambda n: counters.get(n, 0.0)  # noqa: E731
+    out: List[Tuple[str, bool, str]] = []
+
+    sub, dlv, terr = c("fleet.submitted"), c("fleet.delivered"), \
+        c("fleet.typed_errors")
+    out.append((
+        "no hung futures", sub > 0 and sub == dlv + terr,
+        f"submitted={sub:.0f} delivered={dlv:.0f} typed_errors={terr:.0f}",
+    ))
+    out.append((
+        "no silent wrong answers", c("fleet.bad_results") == 0,
+        f"bad_results={c('fleet.bad_results'):.0f}",
+    ))
+    if c("faults.injected.host_death") > 0:
+        out.append((
+            "host death contained",
+            c("fleet.host_dead") >= 1 and c("fleet.redispatched") >= 1,
+            f"host_dead={c('fleet.host_dead'):.0f} "
+            f"redispatched={c('fleet.redispatched'):.0f}",
+        ))
+    if c("faults.injected.sdc_solve") > 0:
+        out.append((
+            "sdc quarantined + probe-recovered",
+            c("fleet.cert.fail") > 0 and c("fleet.quarantined") >= 1
+            and c("fleet.unquarantined") >= 1,
+            f"cert_fail={c('fleet.cert.fail'):.0f} "
+            f"quarantined={c('fleet.quarantined'):.0f} "
+            f"unquarantined={c('fleet.unquarantined'):.0f}",
+        ))
+    if victim is not None:
+        out.append((
+            "abuser refused fleet-wide", c("fleet.rejected_quota") > 0,
+            f"rejected_quota={c('fleet.rejected_quota'):.0f}",
+        ))
+        h = hists.get(f"fleet.latency.tenant.{victim}.total")
+        p99 = h.get("p99") if h else None
+        if p99_budget is not None:
+            out.append((
+                f"victim '{victim}' p99 holds",
+                p99 is not None and float(p99) <= p99_budget,
+                f"p99={p99} budget={p99_budget:g}"
+                + ("" if h else " (hist missing)"),
+            ))
+    orphans = gauges.get("fleet.trace_orphans")
+    if require_stitch or orphans is not None:
+        out.append((
+            "stitched trace whole",
+            orphans is not None and float(orphans) == 0,
+            f"trace_orphans={orphans}"
+            + ("" if orphans is not None else " (gauge missing)"),
+        ))
+    if c("faults.injected.rpc_timeout") > 0:
+        out.append((
+            "rpc timeouts absorbed", c("fleet.rpc_retries") > 0,
+            f"rpc_retries={c('fleet.rpc_retries'):.0f}",
+        ))
+    if c("faults.injected.host_partition") > 0:
+        sig = (c("fleet.rpc_retries") + c("fleet.redispatched")
+               + c("fleet.host_dead"))
+        out.append((
+            "partition contained", sig > 0,
+            f"rpc_retries+redispatched+host_dead={sig:.0f}",
+        ))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="merged metrics JSONL from a fleet run")
+    ap.add_argument("--victim", default=None,
+                    help="victim tenant name (arms the quota checks)")
+    ap.add_argument("--p99-budget", type=float, default=None,
+                    help="victim p99 bound in seconds")
+    ap.add_argument("--require-stitch", action="store_true",
+                    help="fail when the fleet.trace_orphans gauge is "
+                         "absent (the drill must have run trace_stitch)")
+    args = ap.parse_args(argv)
+    counters, gauges, hists = load(args.jsonl)
+    if not any(n.startswith("fleet.") for n in counters):
+        print("no fleet.* counters in this JSONL (fleet drill off?)")
+        return 2
+    rows = checks(counters, gauges, hists, victim=args.victim,
+                  p99_budget=args.p99_budget,
+                  require_stitch=args.require_stitch)
+    failed = 0
+    for name, ok, detail in rows:
+        tag = "PASS" if ok else "FAIL"
+        failed += not ok
+        print(f"{tag}  {name:36} {detail}")
+    if failed:
+        print(f"\n{failed} fleet check(s) failed")
+        return 1
+    print(f"\nall {len(rows)} fleet checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,15 +19,25 @@ judge runs unchanged on the merged view:
   library's own geometric in-bucket interpolation, replicated here.
   An edge not on the lattice is a schema violation and fails loudly.
 * **timeline** rows pass through (tagged ``"src"`` with the dump's
-  basename) and re-sort by ``t`` — N health timelines interleave into
-  one.
+  basename, or its ``--tag`` when given) and re-sort by ``t`` — N
+  health timelines interleave into one.
 * **event** rows are dropped: per-process debug traces do not
   interleave meaningfully across unsynchronized clocks.
 * **cost** rows last-wins per executable name (cumulative snapshots).
 
+``--tag`` (one per input, in order — e.g. ``--tag host0 --tag host1``
+for a fleet's per-host dumps) extends the timeline's src-tagging to
+counters, gauges, timers and histograms: each input's OWN rows are
+also emitted, carrying ``"src": <tag>``, BEFORE the untagged global
+rows — so a per-host judge can attribute a counter to the host that
+emitted it, while every existing report (last-wins loaders included)
+still lands on the preserved global sums.
+
 Usage:
     python tools/metrics_merge.py a.jsonl b.jsonl > merged.jsonl
     python tools/metrics_merge.py shard*.jsonl -o merged.jsonl
+    python tools/metrics_merge.py --tag router --tag host0 \\
+        router.jsonl host0.metrics.jsonl -o merged.jsonl
     python tools/soak_report.py merged.jsonl
 """
 
@@ -139,18 +149,27 @@ class _MergedHist:
         }
 
 
-def merge(paths: List[str]) -> List[dict]:
-    """All merged rows in dump order: meta, timeline, counter, gauge,
-    timer, hist, cost."""
+def merge(paths: List[str],
+          tags: Optional[List[str]] = None) -> List[dict]:
+    """All merged rows in dump order: meta, timeline, [src-tagged
+    per-input rows when ``tags`` is given], counter, gauge, timer,
+    hist, cost.  ``tags`` pairs with ``paths`` positionally."""
+    if tags and len(tags) != len(paths):
+        raise SystemExit(
+            f"metrics_merge: {len(tags)} --tag values for "
+            f"{len(paths)} inputs — they pair positionally"
+        )
     counters: Dict[str, float] = {}
     gauges: Dict[str, object] = {}
     timers: Dict[str, list] = {}
     hists: Dict[str, _MergedHist] = {}
     costs: Dict[str, dict] = {}
     timeline: List[dict] = []
+    tagged: List[dict] = []
     schema = None
-    for path in paths:
-        src = os.path.basename(path)
+    for pi, path in enumerate(paths):
+        src = tags[pi] if tags else os.path.basename(path)
+        mine: Dict[str, dict] = {}  # this input's own rows, by (type, name)
         with open(path) as f:
             for line in f:
                 line = line.strip()
@@ -188,6 +207,44 @@ def merge(paths: List[str]) -> List[dict]:
                     if schema is None:
                         schema = r.get("schema")
                 # event rows: dropped (module docstring)
+                if tags and t in ("counter", "gauge", "timer", "hist"):
+                    # per-input view: the same fold rules applied to
+                    # this input alone (re-dumped files repeat names)
+                    key = (t, r["name"])
+                    cur = mine.get(key)
+                    if t == "counter":
+                        if cur is None:
+                            mine[key] = dict(r)
+                        else:
+                            cur["value"] = (
+                                float(cur["value"]) + float(r["value"])
+                            )
+                    elif t == "gauge":
+                        mine[key] = dict(r)
+                    elif t == "timer":
+                        if cur is None:
+                            mine[key] = dict(r)
+                        else:
+                            cur["count"] = int(cur["count"]) + int(r["count"])
+                            cur["total_s"] = (
+                                float(cur["total_s"]) + float(r["total_s"])
+                            )
+                            cur["min_s"] = min(
+                                float(cur["min_s"]), float(r["min_s"])
+                            )
+                            cur["max_s"] = max(
+                                float(cur["max_s"]), float(r["max_s"])
+                            )
+                    else:  # hist
+                        if cur is None:
+                            mine[key] = _MergedHist()
+                        mine[key].fold(r, path)
+        for (t, name) in sorted(mine):
+            row = mine[(t, name)]
+            if isinstance(row, _MergedHist):
+                row = row.row(name)
+            row["src"] = src
+            tagged.append(row)
     timeline.sort(key=lambda r: float(r.get("t", 0.0)))
     out: List[dict] = [{
         "type": "meta", "schema": schema if schema is not None else 1,
@@ -195,6 +252,9 @@ def merge(paths: List[str]) -> List[dict]:
         "merged_from": [os.path.basename(p) for p in paths],
     }]
     out.extend(timeline)
+    # src-tagged per-input rows FIRST: a last-wins loader that ignores
+    # "src" then still finishes on the untagged global merge below
+    out.extend(tagged)
     out.extend(
         {"type": "counter", "name": n, "value": counters[n]}
         for n in sorted(counters)
@@ -220,8 +280,12 @@ def main(argv=None) -> int:
     ap.add_argument("jsonl", nargs="+", help="metrics dumps to merge")
     ap.add_argument("-o", "--output", default=None,
                     help="write here instead of stdout")
+    ap.add_argument("--tag", action="append", default=None,
+                    help="src tag for the Nth input (repeat per input; "
+                         "emits per-input counter/gauge/timer/hist rows "
+                         "tagged 'src' alongside the global merge)")
     args = ap.parse_args(argv)
-    rows = merge(args.jsonl)
+    rows = merge(args.jsonl, tags=args.tag)
     out = (
         open(args.output, "w") if args.output else sys.stdout
     )

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Stitch per-host Chrome span exports into one cross-process trace.
+
+The fleet tier's observability is per-process by construction: the
+router and every worker dump their own span rings
+(``aux/spans.export_chrome``), so one request's chain — router admit ->
+dispatch -> worker admit/queued/execute -> deliver — lands in N files
+that no trace viewer joins.  This tool folds them into a single
+Perfetto/chrome://tracing JSON keyed by the library's trace ids:
+
+* every input keeps its own ``pid`` track (and its ``process_name``
+  metadata row — the router labels worker dumps ``host<i>``); a pid
+  collision across files (pid reuse after a respawn) is rekeyed to a
+  fresh synthetic pid so tracks never merge silently.
+* span/parent ids are namespaced per input (``<pid>:<sid>``): sids are
+  per-process counters, so two hosts' ``3`` must not alias in the
+  stitched view.  Parent links never cross a process, so namespacing
+  per input keeps every edge intact.
+* trace ids pass through untouched — they are minted process-unique
+  (``t<pidhex>-<n>``, aux/spans.new_trace) and are the join key: click
+  any ``args.trace`` in Perfetto to follow one request across hosts.
+
+**Orphan cross-host chains.**  A trace id names its minting process
+(the ``t<pidhex>-`` prefix — the router, for fleet requests).  A trace
+whose events appear in the stitched set while its MINTING process
+contributed none is an orphan: a worker executed part of a chain whose
+root half is missing (router dump absent, or its ring overwrote the
+root) — an observability hole the fleet gate treats as a failure.  The
+count is printed on the summary line (``orphans=N``) and the exit code
+is 2 when any exist, unless ``--allow-orphans`` (the drill records the
+count into the ``fleet.trace_orphans`` gauge and lets
+``tools/fleet_report.py`` judge it).  A host that died mid-request is
+NOT an orphan — the router half still roots the chain.
+
+Stdlib-only by contract (reports must work when the library itself is
+broken).
+
+Usage:
+    python tools/trace_stitch.py router.trace.json host*.trace.json \\
+        -o stitched.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Set
+
+
+def _mint_pid(trace_id: str) -> Optional[int]:
+    """The pid embedded in a library trace id (``t<pidhex>-<n>``), or
+    None for foreign/legacy ids (which then can't be orphan-checked)."""
+    if not isinstance(trace_id, str) or not trace_id.startswith("t"):
+        return None
+    head, sep, _ = trace_id[1:].partition("-")
+    if not sep:
+        return None
+    try:
+        return int(head, 16)
+    except ValueError:
+        return None
+
+
+def stitch(paths: List[str]) -> dict:
+    """Merge the exports; returns ``{"traceEvents": [...], "stats":
+    {files, events, traces, cross, orphans, orphan_traces}}``."""
+    events: List[dict] = []
+    meta: List[dict] = []
+    used_pids: Set[int] = set()
+    file_pids: Set[int] = set()  # post-rekey pid per input, union
+    trace_pids: Dict[str, Set[int]] = {}
+    for path in paths:
+        with open(path) as f:
+            doc = json.load(f)
+        rows = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+        # one export = one process = one pid (spans.export_chrome);
+        # verify, then rekey on collision with an earlier input
+        pids = {r.get("pid") for r in rows if r.get("pid") is not None}
+        if len(pids) > 1:
+            raise SystemExit(
+                f"trace_stitch: {path}: {len(pids)} pids in one export "
+                "— expected one process per dump"
+            )
+        pid = next(iter(pids), None)
+        if pid is None:
+            continue  # empty export (spans off on that host)
+        new_pid = pid
+        while new_pid in used_pids:
+            new_pid += 1 << 22  # past linux pid_max: synthetic, unique
+        used_pids.add(new_pid)
+        file_pids.add(new_pid)
+        for r in rows:
+            r = dict(r)
+            r["pid"] = new_pid
+            if r.get("ph") == "M":
+                meta.append(r)
+                continue
+            args = r.get("args")
+            if args:
+                args = dict(args)
+                # namespace per-process span counters; trace ids are
+                # already process-unique and join as-is
+                for k in ("span", "parent"):
+                    if k in args:
+                        args[k] = f"{new_pid}:{args[k]}"
+                r["args"] = args
+                tr = args.get("trace")
+                if tr is not None:
+                    trace_pids.setdefault(tr, set()).add(new_pid)
+            events.append(r)
+    events.sort(key=lambda r: (r.get("pid", 0), r.get("ts", 0.0)))
+    orphans = []
+    cross = 0
+    for tr, pids in trace_pids.items():
+        if len(pids) > 1:
+            cross += 1
+        mint = _mint_pid(tr)
+        if mint is None:
+            continue
+        # the minting pid may have been rekeyed — it collided only if
+        # another file already claimed it, in which case the ORIGINAL
+        # claimant is a different process and the check below is still
+        # the right one for that pid value
+        if mint not in pids:
+            orphans.append(tr)
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "stats": {
+            "files": len(paths),
+            "events": len(events),
+            "traces": len(trace_pids),
+            "cross": cross,
+            "orphans": len(orphans),
+            "orphan_traces": sorted(orphans)[:32],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="+",
+                    help="per-process Chrome exports to stitch")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the stitched JSON here")
+    ap.add_argument("--allow-orphans", action="store_true",
+                    help="exit 0 even with orphan chains (the caller "
+                         "judges the printed count)")
+    args = ap.parse_args(argv)
+    doc = stitch(args.traces)
+    stats = doc.pop("stats")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+    print(
+        "TRACE_STITCH files={files} events={events} traces={traces} "
+        "cross={cross} orphans={orphans}".format(**stats)
+    )
+    for tr in stats["orphan_traces"]:
+        print(f"  orphan trace {tr}: no events from its minting process")
+    if stats["orphans"] and not args.allow_orphans:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
