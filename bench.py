@@ -734,6 +734,125 @@ def main(argv=None):
 
     run_entry("factor_solve_many", entry_factor_solve_many)
 
+    # -- gels factor reuse (fabric/): a warmed repeated-A least-squares
+    # stream through the QR-pack solve buckets + device arena vs the
+    # same stream refactoring every request.  speedup_vs_refactor is
+    # the tentpole headline (steady-state O(m n nrhs) vs O(m n^2) per
+    # request); top-level requests_per_s carries the floor ------------
+    def entry_gels_factor_reuse():
+        from slate_tpu.aux import metrics as _m
+        from slate_tpu.fabric.arena import FactorArena
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.factor_cache import FactorCache
+        from slate_tpu.serve.service import SolverService
+
+        ng = 512 if on_tpu else 96
+        mg = 2 * ng
+        reqs = 24
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((mg, ng))
+        Bs = [rng.standard_normal((mg, 4)) for _ in range(8)]
+        out = {"m": mg, "n": ng, "requests": reqs}
+        rates = {}
+        for mode in ("refactor", "fabric"):
+            # False = explicitly off (None would re-resolve the env
+            # and poison the refactor baseline)
+            fabric = mode == "fabric"
+            svc = SolverService(
+                cache=ExecutableCache(manifest_path=None), batch_max=8,
+                batch_window_s=0.001,
+                factor_cache=FactorCache(max_entries=8) if fabric
+                else False,
+                factor_arena=FactorArena() if fabric else False,
+            )
+            svc.submit("gels", A, Bs[0]).result(timeout=600)
+            svc.warmup()  # precompile the registered buckets
+            t0 = time.perf_counter()
+            with _m.deltas() as d:
+                futs = [
+                    svc.submit("gels", A, Bs[i % len(Bs)])
+                    for i in range(reqs)
+                ]
+                for f in futs:
+                    assert np.all(np.isfinite(f.result(timeout=600)))
+                hits = int(d.get("serve.factor_cache.hit") or 0)
+                avoided = int(
+                    d.get("serve.arena.upload_avoided_bytes") or 0
+                )
+            dt = time.perf_counter() - t0
+            svc.stop()
+            rates[mode] = reqs / dt
+            out[mode] = {
+                "requests_per_s": round(reqs / dt, 1),
+                "seconds": round(dt, 3),
+                "hits": hits,
+            }
+            if fabric:
+                out[mode]["upload_avoided_bytes"] = avoided
+        out["requests_per_s"] = round(rates["fabric"], 1)
+        out["speedup_vs_refactor"] = round(
+            rates["fabric"] / max(rates["refactor"], 1e-9), 2
+        )
+        return out
+
+    run_entry("gels_factor_reuse", entry_gels_factor_reuse)
+
+    # -- streaming session updates (fabric/session.py): append k rows,
+    # O(k n^2) Householder fold into R, fenced CSNE solve — vs a full
+    # refactor (lstsq) per step on the grown A.  requests_per_s counts
+    # streamed solves (the floored headline); speedup_vs_refactor is
+    # informational — at the tiny CPU shapes the python-loop update is
+    # slower than LAPACK's refactor, the asymptotics only win at real
+    # sizes.  Parity is asserted every step ---------------------------
+    def entry_session_stream_update():
+        from slate_tpu.fabric.session import FactorSession
+        from slate_tpu.serve.cache import ExecutableCache
+        from slate_tpu.serve.service import SolverService
+
+        ns = 256 if on_tpu else 64
+        m0 = 2 * ns
+        steps, k = 8, 4
+        rng = np.random.default_rng(0)
+        A0 = rng.standard_normal((m0, ns))
+        Cs = [rng.standard_normal((k, ns)) for _ in range(steps)]
+        bs = [
+            rng.standard_normal((m0 + (i + 1) * k, 2))
+            for i in range(steps)
+        ]
+        svc = SolverService(
+            cache=ExecutableCache(manifest_path=None), batch_max=4,
+            batch_window_s=0.001, factor_cache=False,
+        )
+        sess = FactorSession(svc, A0)
+        Xs = []
+        t0 = time.perf_counter()
+        for C, b in zip(Cs, bs):
+            sess.append(C)
+            Xs.append(sess.solve(b))
+        dt_s = time.perf_counter() - t0
+        svc.stop()
+        A_cur = A0
+        t0 = time.perf_counter()
+        refs = []
+        for C, b in zip(Cs, bs):
+            A_cur = np.vstack([A_cur, C])
+            refs.append(np.linalg.lstsq(A_cur, b, rcond=None)[0])
+        dt_r = time.perf_counter() - t0
+        err = max(
+            float(np.abs(x - r).max()) for x, r in zip(Xs, refs)
+        )
+        assert err < 1e-8, f"streamed update drifted: {err}"
+        return {
+            "m0": m0, "n": ns, "steps": steps, "rows_per_step": k,
+            "requests_per_s": round(steps / dt_s, 1),
+            "seconds": round(dt_s, 3),
+            "refactor_seconds": round(dt_r, 3),
+            "speedup_vs_refactor": round(dt_r / max(dt_s, 1e-9), 2),
+            "max_err": err,
+        }
+
+    run_entry("session_stream_update", entry_session_stream_update)
+
     # -- multi-tenant fairness: the SAME burst trace (one abusive
     # flood, then a well-behaved tenant's small stream) through a
     # static config vs the admission plane (tenant quotas + WFQ +

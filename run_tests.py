@@ -586,6 +586,134 @@ def factor_gate() -> int:
             pass
 
 
+# Env-activated repeated-A gels stream for the --fabric gate:
+# SLATE_TPU_FACTOR_CACHE=1 (+ SLATE_TPU_FACTOR_ARENA=1 on the armed
+# leg) are read at service construction — the production activation
+# path.  One gels submit factors the QR pack and caches it; the warmed
+# >= 20-solve pristine-session stream must be hits-only, compile-free
+# and (armed) upload-free; a streamed append + fenced CSNE solve
+# closes the loop.  Every X lands in argv[1] so the gate can prove the
+# arena-off leg byte-identical to the armed one.
+_FABRIC_DRIVER = """
+import os
+import sys
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from slate_tpu.aux import metrics
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.service import SolverService
+from slate_tpu.fabric.session import FactorSession
+
+out = sys.argv[1]
+svc = SolverService(cache=ExecutableCache(manifest_path=None), batch_max=4,
+                    batch_window_s=0.002, dim_floor=16, nrhs_floor=4)
+assert svc.factor_cache is not None, "SLATE_TPU_FACTOR_CACHE must arm it"
+want_arena = bool(os.environ.get("SLATE_TPU_FACTOR_ARENA"))
+assert (svc.arena is not None) == want_arena, svc.arena
+rng = np.random.default_rng(0)
+m, n = 40, 12
+A = rng.standard_normal((m, n))
+B0 = rng.standard_normal((m, 2))
+X0 = svc.submit("gels", A, B0).result(timeout=300)
+assert np.abs(X0 - np.linalg.lstsq(A, B0, rcond=None)[0]).max() < 1e-9
+svc.warmup()  # the miss registered the gels solve bucket; precompile it
+sess = FactorSession(svc, A)
+Bs = [rng.standard_normal((m, 2)) for _ in range(20)]
+with metrics.deltas() as d:
+    Xs = [sess.solve(B) for B in Bs]
+    hits = int(d.get("serve.factor_cache.hit") or 0)
+    comp = int(d.get("jit.compilations") or 0)
+    avoided = int(d.get("serve.arena.upload_avoided_bytes") or 0)
+assert hits >= 19, hits
+assert comp == 0, f"warmed gels session stream compiled: {comp}"
+if want_arena:
+    assert avoided > 0, "arena armed but every hit still re-uploaded"
+else:
+    assert avoided == 0, "arena unarmed but arena counters moved"
+C = rng.standard_normal((5, n))
+sess.append(C)
+B2 = rng.standard_normal((m + 5, 2))
+X2 = sess.solve(B2)
+ref = np.linalg.lstsq(np.vstack([A, C]), B2, rcond=None)[0]
+assert np.abs(X2 - ref).max() < 1e-9, "streamed session solve drifted"
+np.save(out, np.stack([X0, *Xs, X2]))
+svc.stop()
+print(f"fabric driver[arena={'on' if want_arena else 'off'}]: 1 factor "
+      f"+ {len(Xs)} session solves, {hits} hits, 0 compiles, "
+      f"upload_avoided={avoided}")
+"""
+
+
+def fabric_gate() -> int:
+    """Factor-fabric gate, three legs: (1) the fabric suite (arena
+    budgets/spill/cross-replica, session update-vs-refactor parity,
+    breakdown refactor, fence coverage); (2) the env-activated
+    repeated-A gels stream with the arena ARMED (factor once, >= 20
+    warmed session solves, 0 compiles, upload_avoided_bytes > 0),
+    judged by tools/factor_report.py; (3) the same stream with the
+    arena OFF, whose every X must be byte-identical to leg 2's —
+    the unarmed service is provably legacy."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__)) or "."
+    rc = subprocess.call(
+        [sys.executable, "-m", "pytest", "tests/test_fabric.py", "-q",
+         "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=here,
+    )
+    if rc != 0:
+        return rc
+    tmp = tempfile.gettempdir()
+    jsonl = os.path.join(tmp, f"fabric_{os.getpid()}.jsonl")
+    jsonl_off = os.path.join(tmp, f"fabric_off_{os.getpid()}.jsonl")
+    out_on = os.path.join(tmp, f"fabric_on_{os.getpid()}.npy")
+    out_off = os.path.join(tmp, f"fabric_off_{os.getpid()}.npy")
+    base = dict(os.environ, JAX_PLATFORMS="cpu",
+                SLATE_TPU_FACTOR_CACHE="1")
+    for k in ("SLATE_TPU_FAULTS", "SLATE_TPU_FACTOR_ARENA",
+              "SLATE_TPU_METRICS"):
+        base.pop(k, None)
+    try:
+        rc = subprocess.call(
+            [sys.executable, "-c", _FABRIC_DRIVER, out_on],
+            env=dict(base, SLATE_TPU_METRICS=jsonl,
+                     SLATE_TPU_FACTOR_ARENA="1"),
+            cwd=here,
+        )
+        if rc != 0:
+            return rc
+        rc = subprocess.call(
+            [sys.executable, "-c", _FABRIC_DRIVER, out_off],
+            # metrics on (the driver asserts hit counters) but the
+            # arena env stays popped — this is the legacy leg
+            env=dict(base, SLATE_TPU_METRICS=jsonl_off), cwd=here,
+        )
+        if rc != 0:
+            return rc
+        import numpy as np
+
+        a, b = np.load(out_on), np.load(out_off)
+        if a.dtype != b.dtype or a.shape != b.shape \
+                or a.tobytes() != b.tobytes():
+            print("FABRIC GATE: arena-off X stream is not "
+                  "byte-identical to the armed leg — the unarmed "
+                  "service is not legacy")
+            return 1
+        print("fabric gate: arena-off leg byte-identical to armed leg")
+        return subprocess.call(
+            [sys.executable, os.path.join("tools", "factor_report.py"),
+             jsonl],
+            cwd=here,
+        )
+    finally:
+        for p in (jsonl, jsonl_off, out_on, out_off):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
 # Two-leg bursty two-tenant stream for the --adaptive gate.  Same
 # phase-1 trace both legs: an abusive tenant floods 48 requests, then a
 # well-behaved tenant submits 8 on its own bucket; every dispatch pays
@@ -2611,6 +2739,14 @@ def main() -> int:
                          "env-activated repeated-A stream gated by "
                          "tools/factor_report.py (zero hits on a "
                          "repeated-A stream fails)")
+    ap.add_argument("--fabric", action="store_true",
+                    help="run the factor-fabric gate: the fabric suite "
+                         "(arena + streaming sessions) + an "
+                         "env-activated repeated-A gels session stream "
+                         "(1 factor, >= 20 warmed solves, 0 compiles, "
+                         "upload_avoided_bytes > 0; factor_report "
+                         "verdict) + an arena-off leg proving "
+                         "byte-identical legacy serving")
     ap.add_argument("--adaptive", action="store_true",
                     help="run the admission suite + the bursty "
                          "two-tenant stream (static config misses the "
@@ -2693,6 +2829,8 @@ def main() -> int:
         return latency_gate()
     if args.factor:
         return factor_gate()
+    if args.fabric:
+        return fabric_gate()
     if args.adaptive:
         return adaptive_gate()
     if args.perf:

@@ -44,7 +44,7 @@ METRIC_FNS = ("inc", "gauge", "observe", "observe_hist", "record_cost")
 METRIC_ROOTS = (
     "serve.", "faults.", "jit.", "precision.", "fallbacks.",
     "refine.", "transfer.", "stedc.", "devmon.", "soak.", "scale.",
-    "factor.", "fleet.",
+    "factor.", "fleet.", "fabric.",
 )
 
 #: files whose string literals must never feed the emitted set (the
@@ -57,7 +57,7 @@ _ANALYSIS_PREFIX = "slate_tpu/analysis/"
 #: at their inner segments.
 _README_TOKEN_RE = re.compile(
     r"(?<![.\w])(?:serve|faults|jit|precision|fallbacks|refine|transfer|"
-    r"stedc|soak|scale|factor|fleet)\.[A-Za-z0-9_.{}<>,*]+"
+    r"stedc|soak|scale|factor|fleet|fabric)\.[A-Za-z0-9_.{}<>,*]+"
 )
 
 

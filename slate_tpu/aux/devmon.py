@@ -208,6 +208,26 @@ def default_device_kind() -> str:
 # ---------------------------------------------------------------------------
 
 
+def bytes_in_use(device=None) -> Optional[int]:
+    """Instantaneous HBM bytes in use on one device (default: the
+    default backend's first device), or None on backends without
+    ``memory_stats`` (XLA:CPU) — the fabric arena's budget-pressure
+    probe; graceful degradation, never a crash."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        fn = getattr(device, "memory_stats", None)
+        stats = fn() if fn is not None else None
+        if stats:
+            v = stats.get("bytes_in_use")
+            return int(v) if v is not None else None
+    except Exception:  # noqa: BLE001 — telemetry must never crash
+        pass
+    return None
+
+
 def sample_devices(devices=None) -> List[dict]:
     """One memory snapshot per device: ``{"id", "platform", "kind",
     "bytes_in_use", "bytes_limit", "peak_bytes_in_use"}`` with the

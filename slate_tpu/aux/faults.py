@@ -40,6 +40,12 @@ Sites (:data:`SITES`) and where they are checked:
                        hit path's residual validation must catch it,
                        bump ``serve.factor_cache.stale``, and re-solve
                        direct (``serve.service`` solve-phase dispatch)
+    ``session_update`` silent corruption of a streaming session's
+                       in-place Householder R update: one element is
+                       perturbed to a FINITE wrong value after the
+                       fold (``fabric.session.FactorSession.append``)
+                       — the per-solve residual fence must catch it
+                       and pay a counted refactor, never a wrong X
     ``sdc_factor``     silent data corruption in a freshly computed
                        factorization: one element of the factor is
                        perturbed to a FINITE wrong value
@@ -189,6 +195,12 @@ SITE_SPECS: Tuple[SiteSpec, ...] = (
     # counted stale means the residual validation caught the mismatched
     # factor and the item was re-solved direct, never delivered wrong
     SiteSpec("factor_stale", recovery=("serve.factor_cache.stale",)),
+    # detection == containment for streaming sessions: the per-solve
+    # residual fence catches a poisoned in-place R update and the
+    # counted refactor rebuilds it from A — never a silent wrong X
+    SiteSpec("session_update", recovery=(
+        "fabric.session.fence_fail", "fabric.session.refactor",
+    )),
     # detection == containment for the integrity plane: a counted
     # certificate failure means the wrong X was re-executed instead of
     # delivered (serve.integrity.recovered / a typed error — never a

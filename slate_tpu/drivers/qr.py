@@ -263,6 +263,35 @@ def cholqr(
     return Q, Rtri, info
 
 
+def gels_solve_from_global(
+    Fg: jnp.ndarray, Bg: jnp.ndarray, m: int, nb: int
+) -> jnp.ndarray:
+    """gels-style solve-only entry point over global arrays: least
+    squares against a PRE-COMPUTED packed QR factor.  ``Fg`` is the
+    serve factor cache's pack (``serve/buckets.solve_factor_shape``):
+    rows [0, m) hold the padded V/R global (Householder vectors below
+    the diagonal, R on/above), and each nb-wide column panel's
+    compact-WY T factor is flattened below (panel at column offset k
+    in rows [m + k, m + k + w), cols [0, w)).  Applies Q^H to B one
+    block reflector per panel — no larft rebuild, the cached T rides
+    in the pack — then one triangular solve against R: O(m n nrhs)
+    against the full phase's O(m n^2) refactor.  Fully traceable
+    (jit/vmap over B), so the warmed ``phase="solve"`` gels bucket
+    serves a whole coalesced batch from ONE unbatched factor operand."""
+    n = Fg.shape[1]
+    VR = Fg[:m]
+    C = Bg
+    for k in range(0, n, nb):
+        w = min(nb, n - k)
+        Vk = materialize_v(VR[:, k : k + w], offset=k)
+        Tk = Fg[m + k : m + k + w, :w]
+        C = apply_block_reflector(Vk, Tk, C, trans=True)
+    R = jnp.triu(VR[:n, :n])
+    return lax.linalg.triangular_solve(
+        R, C[:n], left_side=True, lower=False
+    )
+
+
 @accurate_matmul
 @instrumented("gels")
 def gels(

@@ -368,6 +368,7 @@ class Option(enum.Enum):
     ServeFactorCache = "serve_factor_cache"  # enable the factorization cache
     ServeFactorCacheEntries = "serve_factor_cache_entries"  # LRU entry cap
     ServeFactorCacheBytes = "serve_factor_cache_bytes"  # LRU byte budget
+    ServeFactorArena = "serve_factor_arena"  # device factor arena (fabric/)
     ServeTenantQuota = "serve_tenant_quota"  # tenant spec (admission grammar)
     ServeAdaptiveWindow = "serve_adaptive_window"  # AIMD batch-window control
     ServeLatencyBudget = "serve_latency_budget"  # p99 budget, s (0 = off)

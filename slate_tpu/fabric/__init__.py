@@ -1,0 +1,29 @@
+"""Streaming factor fabric: device-resident factor reuse for the
+serving tier.
+
+Two halves (ROADMAP item 4, the repeated-A perf frontier):
+
+- :mod:`~slate_tpu.fabric.arena` — a byte-budgeted per-lane HBM cache
+  beside the host :class:`~slate_tpu.serve.factor_cache.FactorCache`
+  LRU.  The host cache answers *what* factor serves a hit; the arena
+  answers *where it already lives*: a hot factor stays device-resident
+  so the warmed ``phase="solve"`` bucket dispatches with zero
+  host->device factor transfer.
+- :mod:`~slate_tpu.fabric.session` — first-class streaming
+  least-squares sessions (``serve.session(routine="gels")``): factor
+  once, append rows in O(k n^2) via Householder updates on R, solve on
+  demand — with a residual fence on every solve and breakdown ->
+  counted refactor, never a wrong X.
+
+Both are OFF by default: a service without an arena has
+``service.arena is None`` (one branch on the hot path), and sessions
+are created only by explicit API calls.
+"""
+
+from .arena import (  # noqa: F401
+    ARENA_ENV,
+    FactorArena,
+    arena_from_options,
+    parse_arena_spec,
+)
+from .session import FactorSession  # noqa: F401

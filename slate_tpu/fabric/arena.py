@@ -1,0 +1,337 @@
+"""Device factor arena: byte-budgeted per-lane HBM residency for hot
+factors.
+
+The serve factor cache (serve/factor_cache.py) made repeated-A solves
+O(n^2) — but its entries are host numpy, so every hit still pays one
+host->device transfer of an O(n^2) factor before a trsm-only solve.
+For a hot factor that transfer IS the latency.  The arena is the
+Clipper lesson (PAPERS.md) applied one level down: cache where the
+consumer runs.  Each replica lane keeps an LRU of device-resident
+factor buffers keyed by the host cache's fingerprint; a hit hands the
+solve dispatch the buffer already on the lane's device
+(``serve.arena.upload_avoided_bytes``), a miss uploads once and
+installs.
+
+Budget & pressure
+-----------------
+Per-lane byte ledger (``bytes=<N>`` in the ``SLATE_TPU_FACTOR_ARENA``
+grammar): inserting past the budget evicts LRU buffers
+(``serve.arena.evict``).  Independently, :meth:`FactorArena.pressure`
+consults the devmon HBM gauge (``aux/devmon.bytes_in_use``) and spills
+the lane's LRU half back to host-only when the DEVICE — not just the
+arena — is under memory pressure (``serve.arena.spill``); on backends
+without memory stats (XLA:CPU) the probe degrades to a no-op.  Spill
+and evict both only drop device residency: the host FactorCache entry
+survives, so the next hit re-uploads — never a refactor, never a
+wrong X.
+
+Cross-replica sharing
+---------------------
+A factor homed on a cooling/quarantined lane can serve from a healthy
+one: :meth:`FactorArena.get` with ``any_lane=True`` finds the buffer
+on a peer lane and installs a device->device copy on the requesting
+lane (``serve.arena.cross_replica``) — no host round trip.
+
+Activation: ``SLATE_TPU_FACTOR_ARENA=1`` / ``bytes=2e9`` env, or
+``Option.ServeFactorArena`` — default OFF; the service hot path pays
+one ``is None`` branch.  Metrics: ``serve.arena.{hit,miss,
+upload_avoided_bytes,upload_bytes,spill,evict,cross_replica,drop}``
+global + per-lane (``serve.arena.lane.<lane>.*``), plus the
+``serve.arena.bytes`` / ``serve.arena.lane.<lane>.bytes`` gauges —
+the ``tools/factor_report.py`` arena columns.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..aux import devmon, metrics, sync
+
+ARENA_ENV = "SLATE_TPU_FACTOR_ARENA"
+
+DEFAULT_MAX_BYTES = 1 << 30  # 1 GiB of device-resident factors per lane
+
+#: devmon pressure threshold: spill when the device reports more than
+#: this fraction of its HBM limit in use (the arena sheds residency
+#: BEFORE the allocator starts failing dispatches)
+PRESSURE_FRAC = 0.9
+
+
+def _record(event: str, lane: Optional[str] = None, n: int = 1) -> None:
+    """One arena event: global + per-lane, mirroring the factor-cache
+    naming scheme (lane cardinality is the replica count — bounded)."""
+    if not metrics.is_on():
+        return  # hit-path caller: no f-string names built while off
+    metrics.inc(f"serve.arena.{event}", n)
+    if lane is not None:
+        metrics.inc(f"serve.arena.lane.{lane}.{event}", n)
+
+
+@dataclass(eq=False)
+class _Slot:
+    """One device-resident factor buffer (identity, not value —
+    ``eq=False`` for the same ndarray-truthiness hazard FactorEntry
+    documents)."""
+
+    buf: object  # jax.Array committed to the lane's device
+    nbytes: int
+
+
+class FactorArena:
+    """Per-lane LRU of device-resident factor buffers under one byte
+    budget per lane.  Thread-safe: every replica worker (and the
+    service's invalidation paths) touch it."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
+        self.max_bytes = max(int(max_bytes), 1)
+        # sync.RLock: plain threading.RLock unless SLATE_TPU_SYNC_CHECK
+        # armed the race plane.  The annotations are ground truth for
+        # the lock-discipline / race-guarded-by lint rules
+        self._lock = sync.RLock(name="fabric.FactorArena._lock")
+        self._lane_slots: Dict[str, "OrderedDict[str, _Slot]"] = {}  # guarded by: _lock
+        self._bytes: Dict[str, int] = {}  # guarded by: _lock
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._lane_slots.values())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "max_bytes": self.max_bytes,
+                "bytes": sum(self._bytes.values()),
+                "entries": sum(len(d) for d in self._lane_slots.values()),
+                "lanes": {
+                    lane: {
+                        "entries": len(d),
+                        "bytes": self._bytes.get(lane, 0),
+                    }
+                    for lane, d in self._lane_slots.items()
+                },
+            }
+
+    def _gauges_locked(self, lane: str) -> None:
+        if not metrics.is_on():
+            return
+        metrics.gauge(
+            "serve.arena.bytes", sum(self._bytes.values())
+        )
+        metrics.gauge(
+            f"serve.arena.lane.{lane}.bytes", self._bytes.get(lane, 0)
+        )
+
+    # -- core --------------------------------------------------------------
+
+    def get(self, fp: str, lane: str, device=None, any_lane: bool = True):
+        """The device-resident buffer for one fingerprint on one lane
+        (refreshing its LRU position), or None.  A same-lane hit counts
+        ``hit`` + ``upload_avoided_bytes`` — the factor bytes that did
+        NOT cross the host->device link.  When ``any_lane`` and a peer
+        lane holds the buffer, a device->device copy installs it here
+        (``cross_replica``; requires ``device``, the requesting lane's
+        placement) — still no host round trip."""
+        with self._lock:
+            sync.guarded(self, "_lane_slots")  # race-plane probe (no-op off)
+            slots = self._lane_slots.get(lane)
+            if slots is not None:
+                slot = slots.get(fp)
+                if slot is not None:
+                    slots.move_to_end(fp)
+                    _record("hit", lane)
+                    _record("upload_avoided_bytes", lane, slot.nbytes)
+                    return slot.buf
+            src = None
+            if any_lane:
+                for peer, pslots in self._lane_slots.items():
+                    if peer != lane and fp in pslots:
+                        src = pslots[fp]
+                        break
+        if src is not None and device is not None:
+            import jax
+
+            buf = jax.device_put(src.buf, device)
+            _record("cross_replica", lane)
+            self._install(fp, lane, buf, int(src.nbytes))
+            return buf
+        _record("miss", lane)
+        return None
+
+    def put(self, fp: str, lane: str, F: np.ndarray, device=None):
+        """Upload one host factor to the lane's device and install it
+        (``upload_bytes``); returns the committed device buffer — the
+        caller dispatches THIS, so the upload it just paid is the last
+        one the fingerprint pays on this lane.  A buffer alone past the
+        byte budget is returned uncached (the next hit re-uploads:
+        the budget doing its job)."""
+        import jax
+
+        nbytes = int(np.asarray(F).nbytes)
+        buf = (
+            jax.device_put(F, device) if device is not None
+            else jax.numpy.asarray(F)
+        )
+        _record("upload_bytes", lane, nbytes)
+        if nbytes <= self.max_bytes:
+            self._install(fp, lane, buf, nbytes)
+        return buf
+
+    def _install(self, fp: str, lane: str, buf, nbytes: int) -> None:
+        with self._lock:
+            sync.guarded(self, "_lane_slots")  # race-plane probe (no-op off)
+            slots = self._lane_slots.setdefault(lane, OrderedDict())
+            old = slots.pop(fp, None)
+            if old is not None:
+                self._bytes[lane] = self._bytes.get(lane, 0) - old.nbytes
+            slots[fp] = _Slot(buf=buf, nbytes=nbytes)
+            self._bytes[lane] = self._bytes.get(lane, 0) + nbytes
+            while slots and self._bytes.get(lane, 0) > self.max_bytes:
+                vfp, victim = slots.popitem(last=False)
+                self._bytes[lane] -= victim.nbytes
+                _record("evict", lane)
+            self._gauges_locked(lane)
+
+    # -- pressure / lifecycle ----------------------------------------------
+
+    def pressure(self, lane: str, device=None) -> int:
+        """Devmon-driven spill: sample the device's HBM gauge and, past
+        :data:`PRESSURE_FRAC` of its reported limit, drop the LRU half
+        of the lane's residency back to host-only (``spill``; the host
+        FactorCache entries survive — a later hit re-uploads).  Returns
+        the number of buffers spilled; 0 on backends without memory
+        stats (XLA:CPU) — graceful degradation, never a crash."""
+        in_use = devmon.bytes_in_use(device)
+        if in_use is None:
+            return 0
+        limit = None
+        try:
+            fn = getattr(device, "memory_stats", None)
+            stats = fn() if fn is not None else None
+            if stats:
+                limit = stats.get("bytes_limit")
+        except Exception:  # noqa: BLE001 — telemetry must never crash
+            limit = None
+        if metrics.is_on():
+            metrics.gauge(
+                f"serve.arena.lane.{lane}.hbm_bytes_in_use", in_use
+            )
+        if limit is None or in_use <= PRESSURE_FRAC * int(limit):
+            return 0
+        return self.spill(lane)
+
+    def spill(self, lane: str, keep_frac: float = 0.5) -> int:
+        """Drop the LRU ``1 - keep_frac`` of one lane's residency
+        (``spill`` per buffer dropped); returns the count."""
+        spilled = 0
+        with self._lock:
+            slots = self._lane_slots.get(lane)
+            if not slots:
+                return 0
+            target = int(len(slots) * float(keep_frac))
+            while len(slots) > target:
+                _, victim = slots.popitem(last=False)
+                self._bytes[lane] -= victim.nbytes
+                _record("spill", lane)
+                spilled += 1
+            self._gauges_locked(lane)
+        return spilled
+
+    def drop(self, fp: str) -> int:
+        """Drop one fingerprint's buffers on EVERY lane (``drop``) —
+        the invalidation/staleness hook: a host-cache invalidate must
+        take the device copies with it or a stale factor would keep
+        serving from HBM.  Returns the count dropped."""
+        dropped = 0
+        with self._lock:
+            for lane, slots in self._lane_slots.items():
+                slot = slots.pop(fp, None)
+                if slot is not None:
+                    self._bytes[lane] -= slot.nbytes
+                    _record("drop", lane)
+                    self._gauges_locked(lane)
+                    dropped += 1
+        return dropped
+
+    def drop_lane(self, lane: str) -> int:
+        """Drop one lane's entire residency (scale-down: the device is
+        leaving the fleet).  Returns the count dropped."""
+        with self._lock:
+            slots = self._lane_slots.pop(lane, None)
+            self._bytes.pop(lane, None)
+            if not slots:
+                return 0
+            n = len(slots)
+            _record("drop", lane, n)
+            self._gauges_locked(lane)
+            return n
+
+    def clear(self) -> int:
+        """Drop everything on every lane; returns the count dropped."""
+        with self._lock:
+            n = sum(len(d) for d in self._lane_slots.values())
+            lanes = list(self._lane_slots)
+            self._lane_slots.clear()
+            self._bytes.clear()
+            for lane in lanes:
+                self._gauges_locked(lane)
+            return n
+
+
+# ---------------------------------------------------------------------------
+# env/options activation: SLATE_TPU_FACTOR_ARENA=1 | bytes=N
+# ---------------------------------------------------------------------------
+
+
+def parse_arena_spec(spec: str) -> Optional[dict]:
+    """Parse the ``SLATE_TPU_FACTOR_ARENA`` grammar: empty/``0``/``off``
+    -> None (disabled), ``1``/``on`` -> enabled with defaults, or a
+    comma list of ``bytes=<float>`` overrides — the factor cache's env
+    grammar, one knob."""
+    spec = (spec or "").strip()
+    if not spec or spec.lower() in ("0", "off", "false", "no"):
+        return None
+    if spec.lower() in ("1", "on", "true", "yes"):
+        return {}
+    out: dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        k, sep, v = item.partition("=")
+        k, v = k.strip().lower(), v.strip()
+        if not sep:
+            raise ValueError(
+                f"{ARENA_ENV}={spec!r}: expected k=v, got {item!r}"
+            )
+        if k == "bytes":
+            out["max_bytes"] = int(float(v))
+        else:
+            raise ValueError(
+                f"{ARENA_ENV}={spec!r}: unknown key {k!r} (bytes)"
+            )
+    return out
+
+
+def arena_from_options(opts=None) -> Optional[FactorArena]:
+    """Resolve the process/service default: ``SLATE_TPU_FACTOR_ARENA``
+    wins, else the ``Option.ServeFactorArena`` spec string (same
+    grammar).  None = disabled — the service hot path stays one
+    branch."""
+    from ..enums import Option
+    from ..options import get_option
+
+    env = os.environ.get(ARENA_ENV, "")
+    kw = parse_arena_spec(env)
+    if kw is None:
+        if env.strip():
+            return None  # env explicitly off: it wins over options
+        kw = parse_arena_spec(str(get_option(opts, Option.ServeFactorArena)))
+        if kw is None:
+            return None
+    return FactorArena(**kw)

@@ -1,0 +1,294 @@
+"""Streaming least-squares sessions: factor once, append rows, solve
+on demand.
+
+A :class:`FactorSession` owns one evolving tall system ``min ||A x -
+B||``.  Its lifecycle has two regimes:
+
+- **pristine** (no rows appended yet): every ``solve`` routes through
+  the owning service's normal ``submit("gels", ...)`` path, so the
+  repeated-A factor cache, the warmed ``phase="solve"`` bucket, and
+  the device arena (:mod:`~slate_tpu.fabric.arena`) all apply — the
+  steady state is compile-free and upload-free.
+- **streamed** (after ``append``): the session maintains the n x n
+  triangular factor R of the growing A host-side and folds each
+  appended row block in via Householder reflections restricted to the
+  new rows — O(k n^2) per k-row append instead of the O(m n^2)
+  refactor.  Dirty solves use the corrected seminormal equations
+  (R^H y = A^H B, R x = y, plus one refinement sweep), which is
+  backward-stable for the well-conditioned systems the fence admits.
+
+Every dirty solve is fenced by the same componentwise-backward-error
+residual check the serving tier uses
+(:func:`~slate_tpu.serve.factor_cache.residual_ok`, gels branch).  A
+fence failure — or an update breakdown (non-finite / collapsed
+diagonal) — triggers a **counted refactor** (``fabric.session.
+refactor``) and a retry; the session never returns a wrong X.  If even
+the fresh factor fails the fence the solve raises
+:class:`~slate_tpu.exceptions.NumericalError`.
+
+Metrics (all under ``fabric.session.``): ``factor`` (full R builds),
+``update`` (append calls), ``update_rows`` (rows folded in),
+``solve``, ``refactor``, ``fence_fail``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..aux import faults, metrics, sync
+from ..exceptions import DimensionError, InvalidInput, NumericalError
+
+__all__ = ["FactorSession"]
+
+
+def _solve_upper(R: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Back-substitution X for upper-triangular R X = B (B: n x nrhs)."""
+    n = R.shape[0]
+    X = np.array(B, dtype=np.result_type(R.dtype, B.dtype))
+    for i in range(n - 1, -1, -1):
+        if i + 1 < n:
+            X[i] -= R[i, i + 1:] @ X[i + 1:]
+        X[i] /= R[i, i]
+    return X
+
+
+def _solve_upper_h(R: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Forward-substitution X for R^H X = B (R upper => R^H lower)."""
+    n = R.shape[0]
+    X = np.array(B, dtype=np.result_type(R.dtype, B.dtype))
+    for i in range(n):
+        if i:
+            X[i] -= R[:i, i].conj() @ X[:i]
+        X[i] /= np.conj(R[i, i])
+    return X
+
+
+def _update_r(R: np.ndarray, C: np.ndarray) -> None:
+    """Fold k appended rows C into the triangular factor R in place.
+
+    One Householder reflection per column, restricted to the pivot
+    R[j, j] and the k new rows — the classical row-append QR update:
+    after the sweep, R is the triangular factor of [[R_old], [C]]
+    (equivalently of the grown A), and C is destroyed.  O(k n^2).
+    """
+    n = R.shape[1]
+    for j in range(n):
+        alpha = R[j, j]
+        x = C[:, j]
+        xnorm2 = float(np.vdot(x, x).real)
+        if xnorm2 == 0.0:
+            continue
+        mu = math.sqrt(abs(alpha) ** 2 + xnorm2)
+        if alpha == 0:
+            beta = -mu
+            tau = 1.0
+        else:
+            beta = -(alpha / abs(alpha)) * mu
+            tau = (beta - alpha) / beta
+        v2 = x / (alpha - beta)
+        if j + 1 < n:
+            s = R[j, j + 1:] + v2.conj() @ C[:, j + 1:]
+            R[j, j + 1:] -= tau * s
+            C[:, j + 1:] -= np.outer(v2, tau * s)
+        R[j, j] = beta
+
+
+class FactorSession:
+    """One streaming gels system bound to a serving tier.
+
+    Created via ``serve.session(routine="gels")`` (serve/api.py) or
+    directly with a :class:`~slate_tpu.serve.service.SolverService`.
+    Thread-safe: one lock serializes append/solve/refactor.
+    """
+
+    def __init__(self, service, A, routine: str = "gels",
+                 schedule: str = "auto"):
+        if routine != "gels":
+            raise InvalidInput(
+                f"session: routine must be 'gels', got {routine!r} "
+                "(streaming row appends are a least-squares notion)"
+            ).with_context(routine=routine)
+        A = np.array(A)  # owned host copy — the session's A grows
+        if A.ndim != 2 or A.shape[0] < A.shape[1]:
+            raise DimensionError(
+                "session: A must be 2-D with m >= n (tall least "
+                f"squares), got shape {A.shape}"
+            ).with_context(routine="gels")
+        if not np.all(np.isfinite(A)):
+            raise InvalidInput(
+                "session: A contains non-finite entries"
+            ).with_context(routine="gels")
+        self._svc = service
+        self._schedule = schedule
+        self._lock = sync.RLock(name="fabric.FactorSession._lock")
+        # guarded by: _lock
+        self._A = A
+        self._R: Optional[np.ndarray] = None  # lazy — built on append
+        self._pristine = True
+        self._solves = 0
+        self._updates = 0
+        self._refactors = 0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def shape(self):
+        with self._lock:
+            return tuple(self._A.shape)
+
+    @property
+    def pristine(self) -> bool:
+        """True until the first ``append`` — pristine solves ride the
+        service's factor-cache/arena fast path."""
+        with self._lock:
+            return self._pristine
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rows": int(self._A.shape[0]),
+                "n": int(self._A.shape[1]),
+                "pristine": self._pristine,
+                "solves": self._solves,
+                "updates": self._updates,
+                "refactors": self._refactors,
+            }
+
+    # -- factor maintenance ------------------------------------------------
+
+    def _factor_locked(self) -> None:
+        """(Re)build R from the full current A — the counted fallback."""
+        # mode="r" gives the n x n triangle; sign conventions are
+        # irrelevant downstream (CSNE only uses R^H R = A^H A)
+        self._R = np.array(np.linalg.qr(self._A, mode="r")[:self._A.shape[1]])
+        metrics.inc("fabric.session.factor")
+
+    def _breakdown_locked(self) -> bool:
+        """True when the maintained R can no longer be trusted: a
+        non-finite entry or a collapsed diagonal (rank loss the
+        Householder sweep cannot see across columns)."""
+        R = self._R
+        if R is None:
+            return False
+        if not np.all(np.isfinite(R)):
+            return True
+        d = np.abs(np.diagonal(R))
+        scale = float(np.max(np.abs(R))) if R.size else 0.0
+        eps = float(np.finfo(R.dtype).eps)
+        return bool(d.size and float(np.min(d)) <= R.shape[1] * eps * scale)
+
+    def _refactor_locked(self) -> None:
+        metrics.inc("fabric.session.refactor")
+        self._refactors += 1
+        self._factor_locked()
+
+    def append(self, C) -> None:
+        """Append k rows to A and fold them into R in O(k n^2).
+
+        Marks the session dirty: subsequent solves use the maintained
+        factor host-side (fenced) instead of the service bucket path.
+        An update breakdown is repaired immediately by a counted
+        refactor — ``append`` never leaves a corrupt R behind.
+        """
+        C = np.atleast_2d(np.asarray(C))
+        with self._lock:
+            n = self._A.shape[1]
+            if C.ndim != 2 or C.shape[1] != n:
+                raise DimensionError(
+                    f"session.append: rows must have {n} columns, got "
+                    f"shape {C.shape}"
+                ).with_context(routine="gels")
+            if not np.all(np.isfinite(C)):
+                raise InvalidInput(
+                    "session.append: rows contain non-finite entries"
+                ).with_context(routine="gels")
+            dt = np.result_type(self._A.dtype, C.dtype)
+            if self._R is None:
+                if self._A.dtype != dt:
+                    self._A = self._A.astype(dt)
+                self._factor_locked()
+            elif self._R.dtype != dt:
+                self._R = self._R.astype(dt)
+                self._A = self._A.astype(dt)
+            self._A = np.vstack([self._A, C.astype(dt, copy=False)])
+            _update_r(self._R, np.array(C, dtype=dt))  # destroys its C copy
+            if faults.is_on():
+                self._R = faults.perturb("session_update", self._R)
+            metrics.inc("fabric.session.update")
+            metrics.inc("fabric.session.update_rows", C.shape[0])
+            self._updates += 1
+            self._pristine = False
+            if self._breakdown_locked():
+                self._refactor_locked()
+
+    def refactor(self) -> None:
+        """Force a counted full refactor of the maintained R."""
+        with self._lock:
+            self._refactor_locked()
+
+    # -- solves ------------------------------------------------------------
+
+    def solve(self, B) -> np.ndarray:
+        """Least-squares solve against the session's current A.
+
+        Pristine sessions dispatch through the owning service (factor
+        cache + arena + warmed solve bucket); streamed sessions solve
+        host-side via corrected seminormal equations against the
+        O(k n^2)-maintained R.  Every streamed solve passes the
+        componentwise residual fence or escalates refactor -> raise —
+        a wrong X is never returned.
+        """
+        B = np.asarray(B)
+        vec = B.ndim == 1
+        Bm = B[:, None] if vec else B
+        with self._lock:
+            m = self._A.shape[0]
+            if Bm.ndim != 2 or Bm.shape[0] != m:
+                raise DimensionError(
+                    f"session.solve: B must have {m} rows (current A "
+                    f"is {self._A.shape}), got shape {B.shape}"
+                ).with_context(routine="gels")
+            metrics.inc("fabric.session.solve")
+            self._solves += 1
+            if self._pristine:
+                X = self._svc.submit("gels", self._A, Bm).result()
+            else:
+                X = self._solve_dirty_locked(Bm)
+        return X[:, 0] if vec else X
+
+    def _solve_dirty_locked(self, B: np.ndarray) -> np.ndarray:
+        from ..serve.factor_cache import residual_ok
+
+        if self._breakdown_locked():
+            self._refactor_locked()
+        X = self._csne_locked(B)
+        if residual_ok(self._A, B, X, routine="gels"):
+            return X
+        metrics.inc("fabric.session.fence_fail")
+        self._refactor_locked()
+        X = self._csne_locked(B)
+        if residual_ok(self._A, B, X, routine="gels"):
+            return X
+        metrics.inc("fabric.session.fence_fail")
+        raise NumericalError(
+            "session solve failed the residual fence even after a "
+            "full refactor — the streamed system is numerically "
+            "unservable", info=1,
+        ).with_context(routine="gels")
+
+    def _csne_locked(self, B: np.ndarray) -> np.ndarray:
+        """Corrected seminormal equations against the maintained R:
+        R^H y = A^H B, R x = y, then one refinement sweep — recovers
+        (nearly) QR-grade backward error without Q."""
+        A, R = self._A, self._R
+        dt = np.result_type(A.dtype, B.dtype, R.dtype)
+        B = B.astype(dt, copy=False)
+        Ah = A.conj().T
+        X = _solve_upper(R, _solve_upper_h(R, Ah @ B))
+        # one CSNE refinement: r = B - A X, R^H w = A^H r, R dx = w
+        r = B - A @ X
+        X = X + _solve_upper(R, _solve_upper_h(R, Ah @ r))
+        return np.asarray(X, dtype=dt)

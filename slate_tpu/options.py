@@ -70,6 +70,12 @@ _DEFAULTS = {
     Option.ServeFactorCache: False,
     Option.ServeFactorCacheEntries: 32,  # LRU entry cap
     Option.ServeFactorCacheBytes: 1 << 30,  # LRU byte budget (1 GiB)
+    # device factor arena (fabric/arena.py): "" = off — hot factors
+    # stay host numpy and every solve-phase hit re-uploads; armed, the
+    # arena keeps them device-resident under a per-lane HBM byte
+    # budget (SLATE_TPU_FACTOR_ARENA env overrides; grammar
+    # off|1|bytes=<N>)
+    Option.ServeFactorArena: "",
     # admission control (serve/admission.py): all three default
     # degenerate — no tenant spec, static batch window, no latency
     # budget — which keeps the service byte-identical to the

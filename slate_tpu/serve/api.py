@@ -124,6 +124,21 @@ def _make_service(opts: Optional[Options], **kw) -> SolverService:
         from .factor_cache import cache_from_options
 
         cfg["factor_cache"] = cache_from_options(opts)
+    if cfg.get("factor_arena") is None and opts:
+        # same shape for the device arena: an explicit opts spec builds
+        # (or explicitly disables) the arena here; otherwise the
+        # service resolves env/process defaults itself
+        fa = get_option(opts, Option.ServeFactorArena, _unset)
+        if fa is not _unset:
+            if isinstance(fa, str):
+                from ..fabric.arena import FactorArena, parse_arena_spec
+
+                spec = parse_arena_spec(fa)
+                cfg["factor_arena"] = (
+                    FactorArena(**spec) if spec is not None else False
+                )
+            else:
+                cfg["factor_arena"] = fa or False
     if cfg.get("placement") is None:
         # build the policy AFTER kw lands so the replicas shorthand is
         # honored (an eager placement= in cfg would make SolverService
@@ -350,16 +365,56 @@ def factor_fingerprint(routine: str, A) -> str:
 def invalidate(fp: str) -> bool:
     """Drop one fingerprint's cached factor — the next same-A request
     pays a counted refactor (``serve.factor_cache.invalidate``).
-    Returns whether it was cached; False too when the cache is off."""
-    fc = get_service().factor_cache
+    Drops the fingerprint's device-arena residency too.  Returns
+    whether it was cached; False too when the cache is off."""
+    svc = get_service()
+    if svc.arena is not None:
+        svc.arena.drop(fp)
+    fc = svc.factor_cache
     return fc.invalidate(fp) if fc is not None else False
 
 
 def invalidate_all() -> int:
-    """Drop every cached factor; returns the count dropped (0 when the
-    cache is off)."""
-    fc = get_service().factor_cache
+    """Drop every cached factor (and all device-arena residency);
+    returns the count dropped (0 when the cache is off)."""
+    svc = get_service()
+    if svc.arena is not None:
+        svc.arena.clear()
+    fc = svc.factor_cache
     return fc.invalidate_all() if fc is not None else 0
+
+
+# -- factor fabric (device arena + streaming sessions) -----------------------
+
+
+def get_arena():
+    """The process service's :class:`~slate_tpu.fabric.arena.
+    FactorArena`, or None when unarmed (the default —
+    ``SLATE_TPU_FACTOR_ARENA=1`` / ``bytes=<N>`` /
+    ``Option.ServeFactorArena`` turn it on; requires the factor cache
+    to be enabled too)."""
+    return get_service().arena
+
+
+def session(A, routine: str = "gels", schedule: Optional[str] = None):
+    """Open a streaming factor-reuse session on the process service
+    (:class:`~slate_tpu.fabric.session.FactorSession`)::
+
+        s = serve.session(A)          # min ||A x - b||, m >= n
+        x0 = s.solve(b)               # pristine: factor-cache/arena path
+        s.append(rows)                # O(k n^2) Householder update on R
+        x1 = s.solve(b_grown)         # fenced CSNE against updated R
+
+    Every streamed solve passes the componentwise residual fence or
+    pays a counted refactor (``fabric.session.refactor``) — never a
+    wrong X."""
+    from ..fabric.session import FactorSession
+
+    svc = get_service()
+    return FactorSession(
+        svc, A, routine=routine,
+        schedule=svc.schedule if schedule is None else schedule,
+    )
 
 
 def update_factor(fp: str, A_new, U, downdate: bool = False):

@@ -55,9 +55,11 @@ DEFAULT_SHARD_THRESHOLD = 2048
 PRECISIONS = ("full", "mixed")
 
 #: accepted BucketKey.phase values: "full" runs the whole factor+solve
-#: pipeline; "solve" is the trsm-only family the factor cache
+#: pipeline; "solve" is the solve-only family the factor cache
 #: dispatches on a hit (gesv: pre-permuted rows + two trsm sweeps,
-#: posv: two trsm sweeps) — O(n^2 nrhs) against the full phase's O(n^3)
+#: posv: two trsm sweeps, gels: blocked Q^H apply from the packed
+#: compact-WY factor + one trsm) — O(n^2 nrhs) / O(m n nrhs) against
+#: the full phase's O(n^3) / O(m n^2)
 PHASES = ("full", "solve")
 
 #: request priority classes at admission (serve/admission.py), highest
@@ -397,19 +399,20 @@ def bucket_for(
     submesh (gesv/posv full-precision only — the sharded solvers have
     no mixed or least-squares trace; serve/placement enforces the
     routing policy, this validates the combination).  ``phase`` keys
-    the pipeline slice: the ``"solve"`` (trsm-only) family exists for
-    gesv/posv at full precision on a single device only — the factor
-    cache owns the factor, the mesh and mixed tiers have no
+    the pipeline slice: the ``"solve"`` (solve-only) family exists for
+    gesv/posv/gels at full precision on a single device only — the
+    factor cache owns the factor, the mesh and mixed tiers have no
     factor-reuse trace."""
     check_precision(precision)
     check_phase(phase)
     mesh = check_mesh(mesh)
     if phase != "full" and (
-        routine not in ("gesv", "posv") or precision != "full" or mesh
+        routine not in ("gesv", "posv", "gels")
+        or precision != "full" or mesh
     ):
         raise ValueError(
             "solve-phase buckets exist for single-device full-precision "
-            f"gesv/posv only (routine={routine!r}, "
+            f"gesv/posv/gels only (routine={routine!r}, "
             f"precision={precision!r}, mesh={mesh!r})"
         )
     dt = np.dtype(dtype).name
@@ -434,9 +437,29 @@ def bucket_for(
             raise ValueError("gels has no sharded serving path")
         Mb, Nb = bucket_mn(m, n, floor)
         return BucketKey(
-            routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule, "full"
+            routine, Mb, Nb, rb, dt, _serve_nb(Nb), tag, schedule, "full",
+            "", phase,
         )
     raise ValueError(f"unknown serving routine: {routine!r}")
+
+
+def gels_pack_kt(key: BucketKey) -> int:
+    """Number of compact-WY T panels in a gels solve-phase factor pack
+    (one per nb-wide column panel of the padded (Mb, Nb) global)."""
+    return -(-key.n // key.nb)
+
+
+def solve_factor_shape(key: BucketKey) -> Tuple[int, int]:
+    """Shape of the solve-phase executable's (unbatched) factor
+    operand.  gesv/posv: the (Mb, Nb) bucket-padded factor global.
+    gels: the packed QR representation — V/R in rows [0, Mb), then the
+    kt compact-WY T panels flattened below (panel k's (w, w) T lands
+    in rows [Mb + k*nb, Mb + k*nb + w), cols [0, w)), so one array
+    carries everything the Q^H apply + trsm needs and a hit dispatches
+    with no host-side reassembly."""
+    if key.routine == "gels":
+        return (key.m + gels_pack_kt(key) * key.nb, key.n)
+    return (key.m, key.n)
 
 
 def batch_bucket(count: int, batch_max: int) -> int:
@@ -505,10 +528,14 @@ def phase_flops(key: BucketKey, batch: int = 1) -> float:
     less than its full-phase sibling).  Full phase: the factorization
     (gesv 2/3 n^3, posv 1/3 n^3) plus the two trsm sweeps; solve
     phase: the trsm sweeps alone (2 n^2 nrhs — the row permute is a
-    gather, FLOP-free).  Per-item, times the batch point."""
+    gather, FLOP-free), or for gels the blocked Q^H apply from the
+    packed compact-WY factor (~4 m n nrhs) plus one trsm.  Per-item,
+    times the batch point."""
     n, r = float(key.n), float(key.nrhs)
     solve = 2.0 * n * n * r
     if key.phase == "solve":
+        if key.routine == "gels":
+            return batch * (4.0 * float(key.m) * n * r + n * n * r)
         return batch * solve
     if key.routine == "gesv":
         return batch * (2.0 / 3.0 * n**3 + solve)

@@ -60,6 +60,7 @@ from .buckets import (
     manifest_loads,
     mesh_fits,
     phase_flops,
+    solve_factor_shape,
 )
 
 WARMUP_ENV = "SLATE_TPU_WARMUP"
@@ -96,9 +97,12 @@ def _build_core(key: BucketKey) -> Callable:
         # sharded bucket: the core is the explicit spmd program on the
         # key's submesh (parallel/spmd_core — distributed LU/Cholesky +
         # trsm pipelines under shard_map), wrapped to the cache's
-        # batched calling convention at its single batch point (1):
-        # shape parallelism comes from the mesh, throughput from the
-        # replica scale-out, never a vmap over shard_map.
+        # batched calling convention by an unrolled trace-time loop —
+        # never a vmap over shard_map (jax would replicate the mesh
+        # axes).  Batch points beyond 1 exist so same-mesh-bucket
+        # requests coalesce like the single-device lane; each item
+        # still runs the full spmd pipeline, the loop just amortizes
+        # the dispatch.
         import jax.numpy as jnp
 
         from ..parallel import spmd_core
@@ -106,8 +110,10 @@ def _build_core(key: BucketKey) -> Callable:
         core1 = spmd_core.serve_core(key)
 
         def core(Ab, Bb):
-            X, info = core1(Ab[0], Bb[0])
-            return X[None], jnp.reshape(info, (1,))
+            outs = [core1(Ab[i], Bb[i]) for i in range(Ab.shape[0])]
+            X = jnp.stack([o[0] for o in outs])
+            info = jnp.stack([jnp.reshape(o[1], ()) for o in outs])
+            return X, info
 
         return core
 
@@ -138,8 +144,20 @@ def _build_core(key: BucketKey) -> Callable:
 
             return core
 
+        if key.routine == "gels":
+            # least squares from the packed QR factor (V/R + cached
+            # compact-WY T panels, buckets.solve_factor_shape): blocked
+            # Q^H apply + one trsm — O(m n nrhs) per solve against the
+            # full family's O(m n^2) refactor
+            def core(Fg, Bg):
+                X = _qr.gels_solve_from_global(Fg, Bg, key.m, key.nb)
+                return X, jnp.zeros((), jnp.int32)
+
+            return core
+
         raise ValueError(
-            f"solve-phase serving supports gesv/posv, not {key.routine!r}"
+            f"solve-phase serving supports gesv/posv/gels, "
+            f"not {key.routine!r}"
         )
 
     if key.tag == "abft" and key.routine in ("gesv", "posv"):
@@ -256,11 +274,13 @@ def _warm_inputs(key: BucketKey, batch: int) -> Tuple[np.ndarray, np.ndarray]:
     """Well-conditioned dummy operands for a warmup compile: identity A
     (SPD, pivot-free, full rank — and a valid LU/Cholesky factor for
     the solve-phase family, whose first operand is the unbatched
-    factor) and zero B."""
+    factor; for the gels pack the identity V/R with zero T panels is a
+    valid QR of the identity — zero T makes every block reflector the
+    identity apply) and zero B."""
     dt = np.dtype(key.dtype)
     d = min(key.m, key.n)
     if key.phase == "solve":
-        A = np.zeros((key.m, key.n), dtype=dt)
+        A = np.zeros(solve_factor_shape(key), dtype=dt)
         A[np.arange(d), np.arange(d)] = 1
     else:
         A = np.zeros((batch, key.m, key.n), dtype=dt)
@@ -486,7 +506,7 @@ class ExecutableCache:
 
         dt = np.dtype(key.dtype)
         A_spec = (
-            jax.ShapeDtypeStruct((key.m, key.n), dt)
+            jax.ShapeDtypeStruct(solve_factor_shape(key), dt)
             if key.phase == "solve"
             else jax.ShapeDtypeStruct((batch, key.m, key.n), dt)
         )
@@ -494,6 +514,14 @@ class ExecutableCache:
             A_spec,
             jax.ShapeDtypeStruct((batch, key.m, key.nrhs), dt),
         )
+
+    def is_live(self, key: BucketKey, batch: int) -> bool:
+        """Whether the (key, batch) executable is already built —
+        a cheap probe (never triggers a build) for callers that must
+        stay compile-free, e.g. the sharded lane's coalescer, which
+        batches only at batch points a warmup has already realized."""
+        with self._lock:
+            return (key, batch) in self._exes
 
     def executable(self, key: BucketKey, batch: int) -> Callable:
         """Get the compiled executable: memory cache, then the artifact
@@ -558,15 +586,10 @@ class ExecutableCache:
                 origin = "artifact"
         if jitted is None:
             faults.check("compile")  # cold builds only: loads never fire
-            if key.mesh and batch != 1:
-                raise ValueError(
-                    f"sharded bucket {key.label} has one batch point (1), "
-                    f"got {batch}"
-                )
             core = _build_core(key)
             if key.mesh:
-                # sharded core: already batched at its single batch
-                # point; no donation (the spmd program's operands are
+                # sharded core: batching is the core's own unrolled
+                # loop; no donation (the spmd program's operands are
                 # resharded at the shard_map boundary) and no vmap
                 jitted = jax.jit(core)
                 jit_kw = {}
@@ -577,11 +600,16 @@ class ExecutableCache:
                 # instead of paying a batch-sized copy per dispatch
                 # (XLA:CPU has no donation and would warn).  Solve-
                 # phase cores map over B only: the factor is ONE
-                # unbatched operand shared by the whole batch.
+                # unbatched operand shared by the whole batch — and
+                # possibly the fabric arena's device-resident copy, so
+                # it is never donated (donation would invalidate the
+                # arena's buffer after one dispatch).
                 in_axes = (None, 0) if key.phase == "solve" else (0, 0)
                 jit_kw = {}
                 if jax.default_backend() != "cpu":
-                    jit_kw["donate_argnums"] = (0, 1)
+                    jit_kw["donate_argnums"] = (
+                        (1,) if key.phase == "solve" else (0, 1)
+                    )
                 jitted = jax.jit(jax.vmap(core, in_axes=in_axes), **jit_kw)
             if self.artifacts is not None and not (
                 self.artifacts.verified_cache_seed(key, batch)
@@ -701,11 +729,8 @@ class ExecutableCache:
         ndev = None
         for key, batch in todo:
             if key.mesh:
-                if batch != 1:
-                    # malformed entry (hand-edited / foreign writer):
-                    # sharded buckets have one batch point — distinct
-                    # from a device-capacity skip, or the operator
-                    # would hunt for missing devices that exist
+                if batch < 1:
+                    # malformed entry (hand-edited / foreign writer)
                     metrics.inc("serve.manifest_bad_batch")
                     continue
                 if ndev is None:
@@ -716,7 +741,7 @@ class ExecutableCache:
                     unfit += 1
                     metrics.inc("serve.mesh_unfit_skipped")
                     continue
-            elif batch_max is not None and batch > batch_max:
+            if batch_max is not None and batch > batch_max:
                 continue
             out.append((key, batch))
         return out, unfit

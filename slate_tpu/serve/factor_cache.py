@@ -156,11 +156,15 @@ class FactorEntry:
     the same hazard PR12 fixed on ``service._Request``."""
 
     fp: str  # matrix_fingerprint of the A it was computed from
-    routine: str  # gesv | posv
+    routine: str  # gesv | posv | gels
     key: BucketKey  # the FULL-phase bucket key of the request stream
-    factor: np.ndarray  # (S, S) bucket-padded factor global (LU or L)
+    # bucket-padded factor global: (S, S) LU or L for gesv/posv, the
+    # (Mb + kt*nb, Nb) packed V/R + compact-WY T pack for gels
+    # (buckets.solve_factor_shape) — always the EXACT first operand of
+    # the solve-phase bucket executable
+    factor: np.ndarray
     perm: Optional[np.ndarray]  # (n,) forward row permutation (gesv)
-    n: int  # true dimension of A
+    n: int  # true solution dimension (rows of X: n of A, gels columns)
     replica: Optional[str] = None  # lane that factored it (device affinity)
 
     @property
@@ -224,6 +228,40 @@ def factor_only(routine: str, A: np.ndarray, schedule: str = "auto"):
     raise ValueError(f"factor cache supports gesv/posv, not {routine!r}")
 
 
+def gels_factor_pack(
+    A: np.ndarray, key: BucketKey, schedule: str = "auto"
+) -> np.ndarray:
+    """Factor one TRUE-shape tall A (m >= n) for the gels solve-phase
+    bucket: pad to the bucket's (Mb, Nb) tall shape (zero rows + unit
+    pad columns keep full column rank, so factoring the PADDED A
+    directly is correct — see buckets.pad_tall), geqrf it once, and
+    pack the V/R global together with every panel's compact-WY T
+    factor into one ``buckets.solve_factor_shape(key)`` array.  The
+    pack is the EXACT first operand of the gels solve executable
+    (``drivers/qr.gels_solve_from_global``): each later same-A solve
+    applies the cached block reflectors (no larft rebuild) plus one
+    trsm — O(m n nrhs) instead of the O(m n^2) refactor."""
+    from ..drivers import qr as _qr
+    from ..enums import Option
+    from ..matrix.matrix import Matrix
+    from .buckets import gels_pack_kt, pad_tall, solve_factor_shape
+
+    Ap = pad_tall(np.ascontiguousarray(A), key.m, key.n)
+    fac, T = _qr.geqrf(
+        Matrix.from_global(Ap, key.nb), {Option.Schedule: schedule}
+    )
+    VR = np.asarray(fac.to_global())
+    Ts = np.asarray(T.T)
+    pack = np.zeros(solve_factor_shape(key), dtype=VR.dtype)
+    pack[: key.m] = VR
+    for k in range(gels_pack_kt(key)):
+        w = min(key.nb, key.n - k * key.nb)
+        pack[
+            key.m + k * key.nb : key.m + k * key.nb + w, :w
+        ] = Ts[k][:w, :w]
+    return pack
+
+
 def solve_from_factor(entry: FactorEntry, B: np.ndarray) -> np.ndarray:
     """Direct (unbatched, eager) trsm-only solve from a cached entry —
     the same math as the solve-phase bucket executable, used when a
@@ -231,10 +269,20 @@ def solve_from_factor(entry: FactorEntry, B: np.ndarray) -> np.ndarray:
     member just factored) and by parity checks."""
     from ..drivers import chol as _chol
     from ..drivers import lu as _lu
+    from ..drivers import qr as _qr
 
     n = entry.n
-    F = entry.factor[:n, :n]
     B = np.asarray(B)
+    if entry.routine == "gels":
+        # pack solve: pad B rows to the bucket height (pad rows carry
+        # zeros, so the pad columns contribute nothing to the cropped X)
+        Bp = np.zeros((entry.key.m, B.shape[1]), dtype=B.dtype)
+        Bp[: B.shape[0]] = B
+        X = _qr.gels_solve_from_global(
+            entry.factor, Bp, entry.key.m, entry.key.nb
+        )
+        return np.asarray(X)[:n]
+    F = entry.factor[:n, :n]
     if entry.routine == "gesv":
         X = _lu.getrs_from_global(F, B[entry.perm])
     else:
@@ -242,23 +290,34 @@ def solve_from_factor(entry: FactorEntry, B: np.ndarray) -> np.ndarray:
     return np.asarray(X)
 
 
-def residual_ok(A: np.ndarray, B: np.ndarray, X: np.ndarray) -> bool:
+def residual_ok(
+    A: np.ndarray, B: np.ndarray, X: np.ndarray, routine: str = "gesv"
+) -> bool:
     """Normwise backward-residual check of one served solve:
     ``max|A X - B| <= sqrt(eps) * (|A|_inf |X|_inf + |B|_inf)``.  A
     numerically stable solve sits at ~n*eps regardless of cond(A); a
     factor that no longer matches A (the ``factor_stale`` chaos site,
     bit rot, a mis-applied update) lands at O(1) — orders past the
     sqrt(eps) fence, so the hit path re-solves direct instead of
-    delivering a wrong X."""
+    delivering a wrong X.
+
+    gels: the least-squares residual ``A X - B`` is legitimately
+    nonzero at the minimizer, so the fence moves to the normal
+    equations — ``max|A^H (A X - B)|`` vanishes at the true LS
+    solution and lands at O(|A| scale) for a stale factor."""
     if not np.all(np.isfinite(X)):
         return False
     dt = np.result_type(A, X)
     eps = np.finfo(np.dtype(dt).type(0).real.dtype).eps
-    R = A @ X - B
-    scale = (
-        np.abs(A).max(initial=0.0) * np.abs(X).max(initial=0.0)
-        + np.abs(B).max(initial=0.0)
-    )
+    anrm = np.abs(A).max(initial=0.0)
+    xmax = np.abs(X).max(initial=0.0)
+    bmax = np.abs(B).max(initial=0.0)
+    if routine == "gels":
+        R = A.conj().T @ (A @ X - B)
+        scale = anrm * (anrm * xmax + bmax)
+    else:
+        R = A @ X - B
+        scale = anrm * xmax + bmax
     return float(np.abs(R).max(initial=0.0)) <= np.sqrt(eps) * max(
         scale, eps
     )
@@ -451,6 +510,15 @@ class FactorCache:
         fingerprint, or None when ``fp`` is not cached (the caller
         should just submit A_new and let the miss path factor it)."""
         with self._lock:
+            entry = self._entries.get(fp)
+            if entry is not None and entry.routine == "gels":
+                # rank-k A +- U U^H edits are square-matrix semantics;
+                # row-streamed least-squares updating lives in
+                # fabric.session (Householder row appends on R)
+                raise ValueError(
+                    "update: gels factors are row-streamed via "
+                    "serve.session(routine='gels'), not rank-k updated"
+                )
             entry = self._entries.pop(fp, None)
             if entry is not None:
                 self._bytes -= entry.nbytes

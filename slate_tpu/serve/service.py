@@ -181,6 +181,7 @@ from .factor_cache import (
     FactorEntry,
     cache_from_options,
     factor_only,
+    gels_factor_pack,
     matrix_fingerprint,
     residual_ok,
     solve_from_factor,
@@ -532,6 +533,7 @@ class SolverService:
         placement: Optional[PlacementPolicy] = None,
         replicas: Optional[int] = None,
         factor_cache: Union[FactorCache, bool, None] = None,
+        factor_arena=None,
         tenants=None,
         adaptive: Optional[bool] = None,
         latency_budget_s: Optional[float] = None,
@@ -604,6 +606,22 @@ class SolverService:
             else factor_cache if factor_cache is not None
             else cache_from_options()
         )
+        # device factor arena (fabric/): default OFF and meaningless
+        # without the host cache — armed, solve-phase hits dispatch the
+        # lane's device-resident factor buffer instead of re-uploading.
+        # ``False`` is the explicit off-switch (wins over the env); the
+        # fabric package is only imported when something arms it, so
+        # the unarmed service is byte-identical to a build without it
+        self.arena = None
+        if factor_arena is not False and self.factor_cache is not None:
+            if factor_arena is not None:
+                self.arena = factor_arena
+            elif os.environ.get("SLATE_TPU_FACTOR_ARENA") or get_option(
+                None, Option.ServeFactorArena
+            ):
+                from ..fabric.arena import arena_from_options
+
+                self.arena = arena_from_options()
         if self.placement.mesh:
             # fail FAST, and against the SAME device pool the sharded
             # lane will actually bind (parallel/spmd_core.grid_for uses
@@ -1111,6 +1129,10 @@ class SolverService:
             refactored = self.factor_cache.rehome(
                 rep.name, survivor.name
             )
+        if self.arena is not None:
+            # device residency is lane-affine and the lane's device is
+            # going away — free its HBM; survivors re-upload on next hit
+            self.arena.drop_lane(rep.lane)
         with self._cond:
             # the worker exits through its drain branch; anything that
             # STILL landed here (a requeue racing the join bound)
@@ -1363,7 +1385,7 @@ class SolverService:
         full_key = key
         if (
             fc is not None and key is not None and not key.mesh
-            and prec == "full" and routine in ("gesv", "posv")
+            and prec == "full" and routine in ("gesv", "posv", "gels")
         ):
             fp = matrix_fingerprint(
                 A, routine, schedule=self.schedule, precision=prec
@@ -1487,11 +1509,33 @@ class SolverService:
                         if b is not None and b.cooling_down(
                             time.monotonic(), self.breaker_cooldown_s
                         ):
-                            _fc_record(
-                                "spill", fp=fp, label=full_key.label
-                            )
-                            req.key = key = full_key
-                            req.factor_miss = True
+                            now_cl = time.monotonic()
+                            alt_b = rep.breakers.get(key)
+                            if rep is not own and not (
+                                alt_b is not None and alt_b.cooling_down(
+                                    now_cl, self.breaker_cooldown_s
+                                )
+                            ):
+                                # cross-lane hit: the factor is host
+                                # numpy (and arena sharing is device->
+                                # device), so the least-loaded healthy
+                                # lane serves the SAME cached factor
+                                # through its own warmed solve bucket —
+                                # reuse survives the sick lane instead
+                                # of demoting to a direct re-solve
+                                _fc_record(
+                                    "cross_lane_hit", fp=fp,
+                                    label=key.label,
+                                )
+                            else:
+                                # single lane (or every lane cooling):
+                                # spill off the batched solve executable
+                                # onto the direct factor path
+                                _fc_record(
+                                    "spill", fp=fp, label=full_key.label
+                                )
+                                req.key = key = full_key
+                                req.factor_miss = True
                         elif (
                             self._scaler is not None
                             and own is not rep
@@ -1795,6 +1839,11 @@ class SolverService:
                 self.factor_cache.stats()
                 if self.factor_cache is not None else None
             ),
+            # the device factor arena (fabric/; None when unarmed):
+            # per-lane residency + byte ledger vs budget
+            "arena": (
+                self.arena.stats() if self.arena is not None else None
+            ),
             # the admission plane (both None when unconfigured):
             # per-tenant depth/quota/burn/shed/rejected, and the
             # controller state (overload level, shed classes, per-bucket
@@ -1962,10 +2011,31 @@ class SolverService:
         if first.expired():
             self._miss_queued(first)
             return []
-        if first.key is None or first.key.mesh:
-            # keyless requests run direct; sharded buckets never
-            # coalesce — their batch point is 1 (the mesh owns shape
-            # parallelism, replica scale-out owns throughput)
+        if first.key is None:
+            # keyless requests run direct
+            return [first]
+        if first.key.mesh and not (
+            self.batch_max > 1
+            and self.cache.is_live(first.key, self.batch_max)
+        ):
+            # the sharded lane coalesces only at batch points a warmup
+            # has already realized: a cold batched spmd variant would
+            # compile mid-traffic, breaking the steady-state contract.
+            # When company is actually queued, record the batch point
+            # in the manifest so the NEXT warmup brings the batched
+            # variant live and coalescing activates from then on.
+            if self.batch_max > 1:
+                with self._cond:
+                    company = any(
+                        r.key == first.key
+                        and r.factor_fp == first.factor_fp
+                        for r in rep.q
+                    )
+                if company:
+                    self.cache.ensure_manifest(
+                        first.key, (1, self.batch_max)
+                    )
+                    metrics.inc("serve.mesh_batch_deferred")
             return [first]
         csp = spans.start("coalesce", trace=first.trace, parent=first.span,
                           lane=rep.lane) if first.trace is not None else None
@@ -2217,10 +2287,12 @@ class SolverService:
         if key.phase == "solve":
             return self._execute_solve_batched(rep, key, batch)
         if key.mesh:
-            # sharded buckets have one batch point: the executable is
-            # the spmd program, not a vmap
+            # sharded buckets batch via the core's unrolled spmd loop
+            # (never vmap over shard_map); the coalescer only builds a
+            # multi-item batch when the batched variant is already
+            # live, so bb > 1 here never compiles mid-traffic
             self.cache.ensure_manifest(key, (1,))
-            bb = 1
+            bb = _bk.batch_bucket(len(batch), self.batch_max)
         else:
             self.cache.ensure_manifest(key, (1, self.batch_max))
             bb = _bk.batch_bucket(len(batch), self.batch_max)
@@ -2383,12 +2455,28 @@ class SolverService:
             return deliver, None
         self.cache.ensure_manifest(key, (1, self.batch_max))
         bb = _bk.batch_bucket(len(batch), self.batch_max)
-        F = np.asarray(entry.factor)
-        if faults.is_on():
-            # factor_stale: serve a factor whose fingerprint silently
-            # no longer matches A — finite, wrong, and caught only by
-            # the residual validation below
-            F = faults.perturb("factor_stale", F)
+        ar = self.arena
+        F = None
+        if ar is not None and not faults.is_on():
+            # device arena (fabric/): a resident buffer serves the
+            # dispatch with zero host->device factor transfer.  Chaos
+            # bypasses the arena entirely — the factor_stale perturb
+            # below must reach the operand actually dispatched, and a
+            # perturbed host copy must never be installed as resident
+            F = ar.get(entry.fp, rep.lane, device=rep.device)
+        if F is None:
+            F = np.asarray(entry.factor)
+            if faults.is_on():
+                # factor_stale: serve a factor whose fingerprint
+                # silently no longer matches A — finite, wrong, and
+                # caught only by the residual validation below
+                F = faults.perturb("factor_stale", F)
+            elif ar is not None:
+                # miss: upload once, dispatch the committed buffer —
+                # the LAST upload this fingerprint pays on this lane
+                F = ar.put(entry.fp, rep.lane, F, device=rep.device)
+                if devmon.is_on():
+                    ar.pressure(rep.lane, rep.device)
         Bs = []
         for r in batch:
             B = np.asarray(r.B)
@@ -2455,7 +2543,7 @@ class SolverService:
                     corrupt += 1
                 deliver.append(functools.partial(self._direct, r))
                 continue
-            if not residual_ok(r.A, r.B, X):
+            if not residual_ok(r.A, r.B, X, routine=r.routine):
                 # finite but WRONG: the factor no longer matches A —
                 # drop it and re-solve through the factor path (which
                 # refactors and re-caches a fresh entry)
@@ -2472,6 +2560,10 @@ class SolverService:
             deliver.append(functools.partial(_resolve, r.future, X, r))
         if stale and fc is not None:
             fc.invalidate(entry.fp)
+            if ar is not None:
+                # the device copies go with the host entry: a stale
+                # factor must not keep serving from HBM residency
+                ar.drop(entry.fp)
         if len(batch) > 1:
             metrics.inc("serve.batched")
             metrics.inc("serve.batched_requests", len(batch))
@@ -2514,7 +2606,9 @@ class SolverService:
                         # door; a mis-keyed update would slip through
                         # here otherwise)
                         X = solve_from_factor(entry, req.B)
-                        if residual_ok(req.A, req.B, X):
+                        if residual_ok(
+                            req.A, req.B, X, routine=req.routine
+                        ):
                             _fc_record("hit", fp=fp, label=entry.key.label)
                             spans.annotate(factor_hit=True)
                         else:
@@ -2522,22 +2616,37 @@ class SolverService:
                                 "stale", fp=fp, label=entry.key.label
                             )
                             fc.invalidate(fp)
+                            if self.arena is not None:
+                                self.arena.drop(fp)
                             entry, X = None, None
                     if entry is None:
-                        raw, perm = factor_only(
-                            req.routine, req.A, schedule=self.schedule
-                        )
-                        # sdc_factor: silent corruption of the freshly
-                        # computed factor (finite wrong value) — this
-                        # request's X goes wrong through the solve
-                        # below (delivery certification must catch
-                        # it), and the poisoned entry is CACHED, so
-                        # later hits must fall to the residual fence
-                        # (counted stale -> invalidate -> refactor)
-                        raw = faults.perturb("sdc_factor", raw)
+                        if req.routine == "gels":
+                            # tall QR: the cached factor is the packed
+                            # V/R + compact-WY T pack of the bucket-
+                            # padded A (factor_cache.gels_factor_pack)
+                            # — the exact solve-executable operand
+                            factor = gels_factor_pack(
+                                req.A, fkey, schedule=self.schedule
+                            )
+                            factor = faults.perturb("sdc_factor", factor)
+                            perm = None
+                        else:
+                            raw, perm = factor_only(
+                                req.routine, req.A, schedule=self.schedule
+                            )
+                            # sdc_factor: silent corruption of the
+                            # freshly computed factor (finite wrong
+                            # value) — this request's X goes wrong
+                            # through the solve below (delivery
+                            # certification must catch it), and the
+                            # poisoned entry is CACHED, so later hits
+                            # must fall to the residual fence (counted
+                            # stale -> invalidate -> refactor)
+                            raw = faults.perturb("sdc_factor", raw)
+                            factor = _bk.pad_square(raw, fkey.n)
                         entry = FactorEntry(
                             fp=fp, routine=req.routine, key=fkey,
-                            factor=_bk.pad_square(raw, fkey.n), perm=perm,
+                            factor=factor, perm=perm,
                             n=req.n,
                         )
                         if fc is not None and fp:
@@ -2731,7 +2840,7 @@ class SolverService:
             A = _cert_operand(req)
             ok = (
                 _abft.checksum_certificate(A, req.B, X) if is_abft
-                else residual_ok(A, req.B, X)
+                else residual_ok(A, req.B, X, routine=req.routine)
             )
         else:
             return True  # unsampled delivery: no verdict, no score move
@@ -2874,7 +2983,7 @@ class SolverService:
         except Exception as e:  # noqa: BLE001 — futures carry the error
             _resolve_exc(req.future, e, req=req)
             return
-        if residual_ok(_cert_operand(req), req.B, X):
+        if residual_ok(_cert_operand(req), req.B, X, routine=req.routine):
             metrics.inc("serve.integrity.recovered")
             if req.reexec_hedged:
                 metrics.inc("serve.hedge.won")

@@ -1,0 +1,531 @@
+"""Factor-fabric tests: slate_tpu/fabric (device arena + streaming
+gels sessions) and their serving-tier integration.
+
+Covers the ISSUE acceptance set: arena budget/LRU/cross-replica/spill
+semantics, streamed update-vs-refactor parity (f64/c128, rank 1 and
+rank k), breakdown -> counted refactor with a correct X, session
+serving under arena eviction pressure, the warmed gels-solve-bucket
+steady state (compile-free, hits-only, upload-free), and the residual
+fence on every streamed solve.  A module-scoped ExecutableCache is
+shared so each gels bucket compiles once for the whole file (the
+test_factor_cache pattern).
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.aux import faults, metrics
+from slate_tpu.exceptions import DimensionError, InvalidInput
+from slate_tpu.fabric.arena import (
+    ARENA_ENV,
+    FactorArena,
+    arena_from_options,
+    parse_arena_spec,
+)
+from slate_tpu.fabric.session import FactorSession, _update_r
+from slate_tpu.serve import buckets as bk
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.factor_cache import (
+    FactorCache,
+    FactorEntry,
+    gels_factor_pack,
+    matrix_fingerprint,
+    residual_ok,
+    solve_from_factor,
+)
+from slate_tpu.serve.placement import PlacementPolicy
+from slate_tpu.serve.service import SolverService
+
+FLOOR = 16
+NRHS_FLOOR = 4
+
+
+@pytest.fixture(autouse=True)
+def metrics_on():
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    yield
+    metrics.off()
+    metrics.reset()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def shared_cache():
+    return ExecutableCache(manifest_path=None)
+
+
+def _svc(shared_cache, **kw):
+    kw.setdefault("batch_max", 4)
+    kw.setdefault("batch_window_s", 0.002)
+    kw.setdefault("dim_floor", FLOOR)
+    kw.setdefault("nrhs_floor", NRHS_FLOOR)
+    return SolverService(cache=shared_cache, **kw)
+
+
+def _tall(m, n, seed=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        A = A + 1j * rng.standard_normal((m, n))
+    return A.astype(dtype)
+
+
+def _lstsq(A, B):
+    return np.linalg.lstsq(A, B, rcond=None)[0]
+
+
+# ---------------------------------------------------------------------------
+# arena: activation grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_arena_spec():
+    for off in ("", "0", "off", "false", "no", "OFF"):
+        assert parse_arena_spec(off) is None
+    for on in ("1", "on", "true", "yes", "ON"):
+        assert parse_arena_spec(on) == {}
+    assert parse_arena_spec("bytes=4096") == {"max_bytes": 4096}
+    assert parse_arena_spec("bytes=1e6") == {"max_bytes": 1000000}
+    with pytest.raises(ValueError):
+        parse_arena_spec("entries=4")
+    with pytest.raises(ValueError):
+        parse_arena_spec("bytes")
+
+
+def test_arena_from_env_and_options(monkeypatch):
+    from slate_tpu.enums import Option
+
+    monkeypatch.setenv(ARENA_ENV, "bytes=2048")
+    ar = arena_from_options()
+    assert ar is not None and ar.max_bytes == 2048
+    # an explicitly-off env wins over an armed option spec
+    monkeypatch.setenv(ARENA_ENV, "off")
+    assert arena_from_options({Option.ServeFactorArena: "1"}) is None
+    # env unset: the option spec decides
+    monkeypatch.delenv(ARENA_ENV)
+    assert arena_from_options() is None  # default spec "" = off
+    ar = arena_from_options({Option.ServeFactorArena: "bytes=512"})
+    assert ar is not None and ar.max_bytes == 512
+
+
+def test_service_default_has_no_arena(shared_cache):
+    """OFF by default: a factor-cache service without the env/option
+    carries arena=None (the one-branch hot path), and an arena is
+    never constructed without a factor cache to feed it."""
+    svc = _svc(shared_cache, factor_cache=FactorCache(max_entries=4),
+               start=False)
+    assert svc.arena is None
+    svc.stop()
+    svc = _svc(shared_cache, factor_cache=False,
+               factor_arena=FactorArena(), start=False)
+    assert svc.arena is None  # no cache -> nothing to make resident
+    svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# arena: residency semantics
+# ---------------------------------------------------------------------------
+
+
+def test_arena_hit_counts_upload_avoided():
+    ar = FactorArena(max_bytes=1 << 20)
+    F = np.ones((8, 8))
+    buf = ar.put("fp-a", "lane0", F)
+    assert buf is not None and len(ar) == 1
+    with metrics.deltas() as d:
+        got = ar.get("fp-a", "lane0")
+        assert got is buf
+        assert d.get("serve.arena.hit") == 1
+        assert d.get("serve.arena.upload_avoided_bytes") == F.nbytes
+        assert d.get("serve.arena.lane.lane0.hit") == 1
+    with metrics.deltas() as d:
+        assert ar.get("fp-b", "lane0", any_lane=False) is None
+        assert d.get("serve.arena.miss") == 1
+
+
+def test_arena_lru_budget_eviction():
+    F = np.ones((8, 8))  # 512 B each
+    ar = FactorArena(max_bytes=2 * F.nbytes)
+    ar.put("a", "l", F)
+    ar.put("b", "l", F)
+    ar.get("a", "l")  # refresh a: b becomes LRU
+    with metrics.deltas() as d:
+        ar.put("c", "l", F)
+        assert d.get("serve.arena.evict") == 1
+    assert ar.get("b", "l", any_lane=False) is None  # evicted
+    assert ar.get("a", "l") is not None
+    assert ar.get("c", "l") is not None
+    assert ar.stats()["bytes"] <= ar.max_bytes
+
+
+def test_arena_oversize_uncacheable():
+    F = np.ones((16, 16))
+    ar = FactorArena(max_bytes=F.nbytes - 1)
+    buf = ar.put("big", "l", F)
+    assert buf is not None  # caller still dispatches this upload
+    assert len(ar) == 0  # but it never became resident
+    assert ar.get("big", "l", any_lane=False) is None
+
+
+def test_arena_cross_replica_share():
+    import jax
+
+    ar = FactorArena(max_bytes=1 << 20)
+    F = np.arange(16.0).reshape(4, 4)
+    ar.put("fp", "lane0", F)
+    dev = jax.devices()[0]
+    with metrics.deltas() as d:
+        buf = ar.get("fp", "lane1", device=dev)
+        assert buf is not None
+        assert d.get("serve.arena.cross_replica") == 1
+    assert np.asarray(buf).tolist() == F.tolist()
+    # the copy installed on the requesting lane: next get is a hit
+    with metrics.deltas() as d:
+        assert ar.get("fp", "lane1") is not None
+        assert d.get("serve.arena.hit") == 1
+
+
+def test_arena_drop_spill_drop_lane():
+    F = np.ones((4, 4))
+    ar = FactorArena(max_bytes=1 << 20)
+    for i in range(4):
+        ar.put(f"fp{i}", "l0", F)
+    ar.put("fp0", "l1", F)
+    assert ar.drop("fp0") == 2  # both lanes
+    assert ar.get("fp0", "l0", any_lane=False) is None
+    with metrics.deltas() as d:
+        # 3 resident: keep floor(3 * 0.5) = 1, spill the 2 LRU
+        n = ar.spill("l0", keep_frac=0.5)
+        assert n == 2 and d.get("serve.arena.spill") == 2
+    assert ar.drop_lane("l0") == 1  # the MRU survivor
+    assert ar.stats()["lanes"].get("l0", {}).get("entries", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# gels factor pack (factor-cache layer)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_gels_pack_solve_parity(dtype):
+    m, n, nrhs = 20, 12, 2
+    key = bk.bucket_for("gels", m, n, nrhs, dtype, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    A = _tall(m, n, seed=1, dtype=dtype)
+    pack = gels_factor_pack(A, key)
+    assert pack.shape == bk.solve_factor_shape(key)
+    entry = FactorEntry(fp="x", routine="gels", key=key, factor=pack,
+                        perm=None, n=n)
+    B = _tall(m, nrhs, seed=2, dtype=dtype)
+    X = solve_from_factor(entry, B)
+    assert X.shape == (n, nrhs)
+    assert np.abs(X - _lstsq(A, B)).max() < 1e-9
+    assert residual_ok(A, B, X, routine="gels")
+    # a finite-but-wrong X fails the gels (normal-equations) fence
+    bad = np.array(X)
+    bad[0, 0] = bad[0, 0] * 2 + 1
+    assert not residual_ok(A, B, bad, routine="gels")
+
+
+def test_factor_cache_update_rejects_gels():
+    key = bk.bucket_for("gels", 20, 12, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    A = _tall(20, 12, seed=3)
+    fc = FactorCache(max_entries=4)
+    entry = FactorEntry(fp="g1", routine="gels", key=key,
+                        factor=gels_factor_pack(A, key), perm=None, n=12)
+    assert fc.put(entry)
+    with pytest.raises(ValueError, match="session"):
+        fc.update("g1", A, np.ones(12))
+
+
+# ---------------------------------------------------------------------------
+# session: streamed update vs refactor parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("k", [1, 5])
+def test_update_r_matches_refactor(dtype, k):
+    """The O(k n^2) Householder fold keeps R^H R = A^H A to sqrt(eps)
+    — rank-1 and rank-k appends, real and complex."""
+    m, n = 40, 13
+    A = _tall(m, n, seed=4, dtype=dtype)
+    R = np.array(np.linalg.qr(A, mode="r")[:n])
+    C = _tall(k, n, seed=5, dtype=dtype)
+    _update_r(R, np.array(C))
+    A2 = np.vstack([A, C])
+    G, G2 = R.conj().T @ R, A2.conj().T @ A2
+    tol = np.sqrt(np.finfo(np.dtype(dtype)).eps)
+    assert np.abs(G - G2).max() <= tol * np.abs(G2).max()
+    # and the factor stayed upper triangular
+    assert np.abs(np.tril(R, -1)).max() == 0.0
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("k", [1, 4])
+def test_session_update_vs_refactor_parity(dtype, k):
+    m, n = 30, 10
+    A = _tall(m, n, seed=6, dtype=dtype)
+    s = FactorSession(None, A)
+    C = _tall(k, n, seed=7, dtype=dtype)
+    with metrics.deltas() as d:
+        s.append(C)
+        assert d.get("fabric.session.factor") == 1
+        assert d.get("fabric.session.update") == 1
+        assert d.get("fabric.session.update_rows") == k
+    A2 = np.vstack([A, C])
+    B = _tall(m + k, 3, seed=8, dtype=dtype)
+    with metrics.deltas() as d:
+        X = s.solve(B)
+        assert d.get("fabric.session.solve") == 1
+        assert d.get("fabric.session.fence_fail") == 0
+    ref = _lstsq(A2, B)
+    tol = np.sqrt(np.finfo(np.dtype(dtype)).eps)
+    assert np.abs(X - ref).max() <= tol * max(np.abs(ref).max(), 1.0)
+    assert not s.pristine and s.shape == (m + k, n)
+
+
+def test_session_many_appends_stay_fenced():
+    """Every streamed solve is fenced (fabric.session.solve counts
+    them all; zero fence failures on a well-conditioned stream)."""
+    rng = np.random.default_rng(9)
+    A = _tall(25, 8, seed=9)
+    s = FactorSession(None, A)
+    A_cur = A
+    with metrics.deltas() as d:
+        for i in range(6):
+            C = rng.standard_normal((2, 8))
+            s.append(C)
+            A_cur = np.vstack([A_cur, C])
+            B = rng.standard_normal((A_cur.shape[0], 2))
+            assert np.abs(s.solve(B) - _lstsq(A_cur, B)).max() < 1e-9
+        assert d.get("fabric.session.solve") == 6
+        assert d.get("fabric.session.fence_fail") == 0
+        assert d.get("fabric.session.refactor") == 0
+        assert d.get("fabric.session.update_rows") == 12
+
+
+def test_session_fence_failure_pays_counted_refactor():
+    """A corrupted maintained factor must never surface as a wrong X:
+    the fence trips, a counted refactor repairs R, and the delivered
+    X is correct."""
+    A = _tall(30, 10, seed=10)
+    s = FactorSession(None, A)
+    s.append(_tall(3, 10, seed=11))
+    # bit-rot the maintained triangle behind the session's back
+    with s._lock:
+        s._R = np.array(s._R)
+        s._R[0, 0] = s._R[0, 0] * 2 + 1
+    B = _tall(33, 2, seed=12)
+    with metrics.deltas() as d:
+        X = s.solve(B)
+        assert d.get("fabric.session.fence_fail") == 1
+        assert d.get("fabric.session.refactor") == 1
+    assert np.abs(X - _lstsq(np.asarray(s._A), B)).max() < 1e-9
+
+
+def test_session_update_fault_site_recovers():
+    """The session_update chaos site perturbs R after a fold; the next
+    solve's fence catches it and the refactor path delivers a correct
+    X — never a silent wrong answer."""
+    A = _tall(30, 10, seed=13)
+    s = FactorSession(None, A)
+    s.append(_tall(2, 10, seed=14))  # builds R (un-faulted)
+    faults.arm("session_update", once=True)
+    faults.on()
+    try:
+        s.append(_tall(2, 10, seed=15))  # the fold this site poisons
+        B = _tall(34, 2, seed=16)
+        with metrics.deltas() as d:
+            X = s.solve(B)
+            assert d.get("fabric.session.refactor") == 1
+        assert np.abs(X - _lstsq(np.asarray(s._A), B)).max() < 1e-9
+    finally:
+        faults.reset()
+
+
+def test_session_breakdown_on_rank_collapse_refactors():
+    """An update that collapses a diagonal (rank-deficient fold) is a
+    breakdown: append itself repairs via a counted refactor."""
+    A = np.eye(12, 8) + 0.01 * _tall(12, 8, seed=17)
+    s = FactorSession(None, A)
+    s.append(_tall(1, 8, seed=18))
+    with s._lock:  # simulate a collapsed pivot from a degenerate fold
+        s._R = np.array(s._R)
+        s._R[3, 3] = 0.0
+    with metrics.deltas() as d:
+        # an all-zero row leaves every column untouched, so the
+        # collapsed pivot survives the fold and trips the breakdown
+        # check inside append itself
+        s.append(np.zeros((1, 8)))
+        assert d.get("fabric.session.refactor") == 1
+    B = _tall(14, 2, seed=20)
+    assert np.abs(s.solve(B) - _lstsq(np.asarray(s._A), B)).max() < 1e-9
+
+
+def test_session_validation():
+    with pytest.raises(InvalidInput):
+        FactorSession(None, _tall(20, 10), routine="gesv")
+    with pytest.raises(DimensionError):
+        FactorSession(None, _tall(8, 10))  # wide
+    with pytest.raises(InvalidInput):
+        FactorSession(None, np.full((10, 4), np.nan))
+    s = FactorSession(None, _tall(20, 10, seed=21))
+    with pytest.raises(DimensionError):
+        s.append(np.ones((2, 7)))  # wrong column count
+    with pytest.raises(InvalidInput):
+        s.append(np.full((1, 10), np.inf))
+    s.append(np.ones((1, 10)))
+    with pytest.raises(DimensionError):
+        s.solve(np.ones((20, 2)))  # stale m after append
+
+
+# ---------------------------------------------------------------------------
+# serving-tier integration
+# ---------------------------------------------------------------------------
+
+
+def test_warmed_session_stream_compile_free(shared_cache):
+    """The acceptance steady state: pristine session solves ride the
+    warmed gels solve bucket — hits only, zero compiles, zero factor
+    re-uploads (the arena holds the pack device-resident)."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc, factor_arena=FactorArena())
+    try:
+        rng = np.random.default_rng(22)
+        A = _tall(20, 12, seed=22)
+        svc.submit("gels", A, rng.standard_normal((20, 2))).result(
+            timeout=300
+        )
+        svc.warmup()  # the miss registered the solve bucket
+        s = FactorSession(svc, A)
+        with metrics.deltas() as d:
+            for _ in range(5):
+                B = rng.standard_normal((20, 2))
+                X = s.solve(B)
+                assert np.abs(X - _lstsq(A, B)).max() < 1e-9
+            assert d.get("serve.factor_cache.hit") == 5
+            assert d.get("jit.compilations") == 0
+            assert d.get("serve.arena.upload_avoided_bytes") > 0
+            # zero per-hit re-upload once resident: exactly one upload
+            assert d.get("serve.arena.upload_bytes") == 0 or (
+                d.get("serve.arena.hit") >= 4
+            )
+        assert s.pristine
+    finally:
+        svc.stop()
+
+
+def test_arena_upload_avoided_accounting(shared_cache):
+    """upload_avoided_bytes = factor pack bytes x device hits — the
+    zero-per-hit-transfer acceptance, by arithmetic."""
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc, factor_arena=FactorArena())
+    try:
+        rng = np.random.default_rng(23)
+        A = _tall(20, 12, seed=23)
+        svc.submit("gels", A, rng.standard_normal((20, 2))).result(
+            timeout=300
+        )
+        svc.warmup()
+        fp = matrix_fingerprint(A, "gels", schedule=svc.schedule)
+        nbytes = fc.get(fp).factor.nbytes
+        with metrics.deltas() as d:
+            for _ in range(4):
+                svc.submit(
+                    "gels", A, rng.standard_normal((20, 2))
+                ).result(timeout=300)
+            hits = int(d.get("serve.arena.hit"))
+            assert hits >= 3
+            assert d.get("serve.arena.upload_avoided_bytes") == \
+                hits * nbytes
+    finally:
+        svc.stop()
+
+
+def test_session_survives_arena_eviction_pressure(shared_cache):
+    """Arena eviction under byte pressure only costs a re-upload:
+    alternating same-bucket sessions whose packs cannot co-reside keep
+    solving correctly while serve.arena.evict counts the churn."""
+    fc = FactorCache(max_entries=8)
+    key = bk.bucket_for("gels", 20, 12, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR)
+    pack_bytes = int(np.prod(bk.solve_factor_shape(key))) * 8
+    svc = _svc(shared_cache, factor_cache=fc,
+               factor_arena=FactorArena(max_bytes=pack_bytes))
+    try:
+        rng = np.random.default_rng(24)
+        As = [_tall(20, 12, seed=30 + i) for i in range(2)]
+        sessions = [FactorSession(svc, A) for A in As]
+        with metrics.deltas() as d:
+            for _ in range(3):
+                for A, s in zip(As, sessions):
+                    B = rng.standard_normal((20, 2))
+                    assert np.abs(s.solve(B) - _lstsq(A, B)).max() < 1e-9
+            assert d.get("serve.arena.evict") >= 1
+    finally:
+        svc.stop()
+
+
+def test_cross_lane_hit_on_cooling_breaker(shared_cache):
+    """Satellite: a hit whose owning lane's solve-bucket breaker is
+    cooling re-routes to the least-loaded healthy lane and STILL
+    reuses the cached factor through that lane's solve bucket —
+    counted cross_lane_hit, not a direct-path spill."""
+    import time as _time
+
+    fc = FactorCache(max_entries=8)
+    svc = _svc(shared_cache, factor_cache=fc,
+               placement=PlacementPolicy(replicas=2))
+    try:
+        rng = np.random.default_rng(25)
+        n = 12
+        A = rng.standard_normal((n, n)) + n * np.eye(n)
+        B = rng.standard_normal((n, 2))
+        svc.submit("gesv", A, B).result(timeout=300)
+        svc.warmup()
+        fp = matrix_fingerprint(A, "gesv", schedule=svc.schedule)
+        entry = fc.get(fp)
+        own = next(r for r in svc._replicas if r.name == entry.replica)
+        br = svc._breaker(own, entry.solve_key)
+        br.state = bk.BREAKER_OPEN
+        br.opened_at = _time.monotonic()
+        with metrics.deltas() as d:
+            X = svc.submit("gesv", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.cross_lane_hit") == 1
+            assert d.get("serve.factor_cache.spill") == 0
+            assert d.get("serve.factor_cache.hit") == 1
+        assert np.abs(X - np.linalg.solve(A, B)).max() < 1e-9
+        br.state = bk.BREAKER_CLOSED
+    finally:
+        svc.stop()
+
+
+def test_invalidation_drops_arena_residency(shared_cache):
+    """fc invalidation and arena residency stay coherent: the service
+    drops the fingerprint's device buffers with the host entry."""
+    fc = FactorCache(max_entries=8)
+    ar = FactorArena()
+    svc = _svc(shared_cache, factor_cache=fc, factor_arena=ar)
+    try:
+        rng = np.random.default_rng(26)
+        A = _tall(20, 12, seed=26)
+        svc.submit("gels", A, rng.standard_normal((20, 2))).result(
+            timeout=300
+        )
+        svc.warmup()
+        svc.submit("gels", A, rng.standard_normal((20, 2))).result(
+            timeout=300
+        )
+        assert len(ar) == 1
+        fp = matrix_fingerprint(A, "gels", schedule=svc.schedule)
+        fc.invalidate(fp)
+        ar.drop(fp)  # what serve.api.invalidate() does
+        assert len(ar) == 0
+        h = svc.health()
+        assert h["arena"]["entries"] == 0
+    finally:
+        svc.stop()

@@ -99,8 +99,12 @@ def test_legacy_manifest_defaults_phase_full():
 
 def test_bucket_for_phase_validation():
     kw = dict(floor=FLOOR, nrhs_floor=NRHS_FLOOR)
-    with pytest.raises(ValueError):
-        bk.bucket_for("gels", 32, 16, 2, np.float64, phase="solve", **kw)
+    # gels gained a solve phase (fabric tier): Q^H b + trsm against the
+    # cached (V/R + T-stack) pack, whose operand is taller than A
+    kg = bk.bucket_for("gels", 32, 16, 2, np.float64, phase="solve", **kw)
+    assert kg.phase == "solve" and kg.label.endswith(".solve")
+    assert bk.solve_factor_shape(kg) == (
+        kg.m + bk.gels_pack_kt(kg) * kg.nb, kg.n)
     with pytest.raises(ValueError):
         bk.bucket_for("gesv", 16, 16, 2, np.float64, phase="solve",
                       precision="mixed", **kw)
@@ -554,9 +558,10 @@ def test_hit_with_different_nrhs_bucket(shared_cache):
         svc.stop()
 
 
-def test_mixed_and_gels_ineligible(shared_cache):
-    """Mixed-precision and gels traffic never touches the factor cache
-    (no fingerprint, no counters)."""
+def test_gels_factors_once_then_hits(shared_cache):
+    """Gels joined the factor-cache family (fabric tier): repeated-A
+    least squares factors once (QR pack) and every later same-A request
+    is a counted hit served from the pack — with X matching lstsq."""
     fc = FactorCache(max_entries=8)
     svc = _svc(shared_cache, factor_cache=fc)
     try:
@@ -564,9 +569,17 @@ def test_mixed_and_gels_ineligible(shared_cache):
         A = rng.standard_normal((20, 12))
         B = rng.standard_normal((20, 2))
         with metrics.deltas() as d:
-            svc.submit("gels", A, B).result(timeout=300)
-            assert not d.get("serve.factor_cache.miss")
-        assert len(fc) == 0
+            X0 = svc.submit("gels", A, B).result(timeout=300)
+            assert d.get("serve.factor_cache.miss") == 1
+        assert len(fc) == 1
+        B2 = rng.standard_normal((20, 2))
+        with metrics.deltas() as d:
+            X1 = svc.submit("gels", A, B2).result(timeout=300)
+            assert d.get("serve.factor_cache.hit") == 1
+        ref0, ref1 = (np.linalg.lstsq(A, b, rcond=None)[0]
+                      for b in (B, B2))
+        assert np.abs(X0 - ref0).max() < 1e-9
+        assert np.abs(X1 - ref1).max() < 1e-9
     finally:
         svc.stop()
 

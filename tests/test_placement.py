@@ -498,6 +498,57 @@ def test_unfit_mesh_fails_fast_at_construction(shared_cache):
         )
 
 
+def test_sharded_lane_batches_after_deferred_warmup():
+    """Same-bucket coalescing on the sharded lane activates only at
+    batch points a warmup has realized.  A cold stream with queued
+    company dispatches singly, RECORDS the batch point
+    (``serve.mesh_batch_deferred`` + manifest), and after the next
+    warmup the same traffic coalesces compile-free
+    (``serve.batched`` + one sharded dispatch for two requests)."""
+    cache = ExecutableCache(manifest_path=None)
+    policy = PlacementPolicy(replicas=2, mesh="2x2", shard_threshold=40)
+    n = 50
+    key = bk.bucket_for("gesv", n, n, 2, np.float64, floor=FLOOR,
+                        nrhs_floor=NRHS_FLOOR, mesh="2x2")
+    problems = [_gesv_problem(n, seed=200 + i) for i in range(2)]
+
+    # cold phase: two same-bucket sharded requests queued before start
+    svc = SolverService(cache=cache, batch_max=4, batch_window_s=0.002,
+                        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+                        placement=policy, start=False)
+    assert not cache.is_live(key, 4)
+    with metrics.deltas() as d:
+        futs = [svc.submit("gesv", A, B) for A, B in problems]
+        svc.start()
+        for (A, B), f in zip(problems, futs):
+            assert np.abs(f.result(timeout=600)
+                          - np.linalg.solve(A, B)).max() < 1e-8
+        assert d.get("serve.mesh_batch_deferred") == 1
+        assert (d.get("serve.batched") or 0) == 0
+        assert d.get("serve.replica.sharded.dispatched") == 2
+    svc.warmup()  # realizes the recorded (1, batch_max) batch point
+    assert cache.is_live(key, 4)
+    svc.stop()
+
+    # warmed phase: the identical stream now coalesces, compile-free
+    svc = SolverService(cache=cache, batch_max=4, batch_window_s=0.002,
+                        dim_floor=FLOOR, nrhs_floor=NRHS_FLOOR,
+                        placement=policy, start=False)
+    with metrics.deltas() as d:
+        futs = [svc.submit("gesv", A, B) for A, B in problems]
+        svc.start()
+        for (A, B), f in zip(problems, futs):
+            assert np.abs(f.result(timeout=600)
+                          - np.linalg.solve(A, B)).max() < 1e-8
+        assert d.get("serve.batched") == 1
+        assert d.get("serve.batched_requests") == 2
+        # per-request counter: 2 requests, but one coalesced execution
+        assert d.get("serve.replica.sharded.dispatched") == 2
+        assert d.get("jit.compilations") == 0
+        assert (d.get("serve.mesh_batch_deferred") or 0) == 0
+    svc.stop()
+
+
 def test_single_replica_service_unchanged(shared_cache):
     """The default policy (1 replica, no mesh) is the pre-placement
     service: everything lands on replica 0, nothing routes sharded,

@@ -37,10 +37,17 @@ PREFIX = "serve.factor_cache.fp."
 
 #: per-fp columns, in display order (counter suffixes under PREFIX)
 EVENTS = ("hit", "miss", "evict", "invalidate", "update",
-          "update_refactor", "stale", "refactor", "spill", "uncacheable")
+          "update_refactor", "stale", "refactor", "spill",
+          "cross_lane_hit", "uncacheable")
 
 #: global counters summarized under the table
 GLOBALS = tuple(f"serve.factor_cache.{e}" for e in EVENTS)
+
+#: device-arena lifecycle counters (fabric/arena.py), global +
+#: ``serve.arena.lane.<lane>.*``
+ARENA_PREFIX = "serve.arena."
+ARENA_EVENTS = ("hit", "miss", "upload_bytes", "upload_avoided_bytes",
+                "cross_replica", "spill", "evict", "drop")
 
 
 def _rows(path: str):
@@ -91,6 +98,40 @@ def analyze(path: str):
     return table, tot, flagged
 
 
+def analyze_arena(path: str) -> dict:
+    """Device-arena summary of a dump: global + per-lane event
+    counters, the residency byte gauge, and the devmon HBM gauge each
+    lane last sampled.  ``legacy`` is True for a pre-arena dump —
+    factor-cache counters present but not one ``serve.arena.*`` name
+    (an old JSONL or an unarmed arena), which the report marks rather
+    than fails."""
+    counters, gauges = _rows(path)
+    present = any(
+        n.startswith(ARENA_PREFIX) for n in (*counters, *gauges)
+    )
+    lanes: Dict[str, dict] = defaultdict(lambda: {e: 0 for e in ARENA_EVENTS})
+    lane_prefix = ARENA_PREFIX + "lane."
+    for name, v in counters.items():
+        if not name.startswith(lane_prefix):
+            continue
+        lane, _, event = name[len(lane_prefix):].rpartition(".")
+        if lane and event in ARENA_EVENTS:
+            lanes[lane][event] = int(v)
+    for name, v in gauges.items():
+        if name.startswith(lane_prefix):
+            lane, _, g = name[len(lane_prefix):].rpartition(".")
+            if lane and g in ("bytes", "hbm_bytes_in_use"):
+                lanes[lane][g] = int(v)
+    return {
+        "legacy": not present,
+        "totals": {
+            e: int(counters.get(ARENA_PREFIX + e, 0)) for e in ARENA_EVENTS
+        },
+        "bytes": int(gauges.get(ARENA_PREFIX + "bytes", 0)),
+        "lanes": dict(sorted(lanes.items())),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("jsonl", help="metrics JSONL from a factor-cache run")
@@ -118,6 +159,32 @@ def main(argv=None) -> int:
         "\ntotals: "
         + " ".join(f"{k}={v}" for k, v in sorted(tot.items()) if v)
     )
+    arena = analyze_arena(args.jsonl)
+    if arena["legacy"]:
+        print("\narena: legacy(arena) — no serve.arena.* counters in "
+              "this dump (pre-arena JSONL or arena unarmed)")
+    else:
+        acols = ("hit", "miss", "upload_avoided_bytes", "upload_bytes",
+                 "cross_replica", "spill", "evict")
+        print("\narena (device-resident factors):")
+        awidths = [max(len(c) + 2, 7) for c in acols]
+        ahdr = (f"{'lane':14}"
+                + "".join(f"{c:>{w}}" for c, w in zip(acols, awidths))
+                + f"{'bytes':>11}{'hbm_in_use':>12}")
+        print(ahdr)
+        print("-" * len(ahdr))
+        for lane, row in arena["lanes"].items():
+            print(
+                f"{lane:14}"
+                + "".join(f"{row.get(c, 0):{w}d}"
+                          for c, w in zip(acols, awidths))
+                + f"{row.get('bytes', 0):11d}"
+                + f"{row.get('hbm_bytes_in_use', 0):12d}"
+            )
+        atot = arena["totals"]
+        print("arena totals: "
+              + " ".join(f"{k}={v}" for k, v in sorted(atot.items()) if v)
+              + f" resident_bytes={arena['bytes']}")
     if flagged:
         print(
             "\nFLAG: repeated-A stream (same fingerprint missed >= 2x "
